@@ -1,0 +1,24 @@
+"""pilosa_tpu — a TPU-native distributed bitmap index.
+
+A ground-up rebuild of the capabilities of Pilosa (the Go distributed bitmap
+index) designed for TPU hardware: bitmap rows live as dense uint32 word blocks
+in HBM, set-algebra and popcount run as XLA/Pallas kernels on the VPU, the
+per-shard map/reduce runs as ``shard_map`` over a ``jax.sharding.Mesh`` with
+ICI all-reduce, and the cluster layer speaks multi-host JAX over DCN instead
+of HTTP+gossip.
+
+Layering (mirrors SURVEY.md §1 of the reference):
+
+- :mod:`pilosa_tpu.ops`      — bitmap math kernels (reference: ``roaring/``)
+- :mod:`pilosa_tpu.core`     — fragment/row/view/field/index/holder data model
+- :mod:`pilosa_tpu.pql`      — PQL parser (reference: ``pql/``)
+- :mod:`pilosa_tpu.exec`     — query executor + fused planner (``executor.go``)
+- :mod:`pilosa_tpu.parallel` — mesh, placement, shard_map execution
+- :mod:`pilosa_tpu.storage`  — WAL + snapshot persistence
+- :mod:`pilosa_tpu.server`   — HTTP API surface (``api.go``, ``http/``)
+- :mod:`pilosa_tpu.cluster`  — membership/replication/anti-entropy
+"""
+
+__version__ = "0.1.0"
+
+from pilosa_tpu.config import SHARD_WIDTH, shard_width_exponent  # noqa: F401

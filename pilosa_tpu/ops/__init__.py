@@ -1,0 +1,10 @@
+"""Bitmap math kernels — the compute layer.
+
+This is the TPU-native replacement for the reference's ``roaring/`` package
+(roaring/roaring.go:3121-5196, the per-container-type-pair op kernels).
+Instead of branchy array/bitmap/run kernels over uint16 slices, every bitmap
+row is a dense block of uint32 words and every op is a vectorized
+bitwise+popcount expression the VPU eats whole.
+"""
+
+from pilosa_tpu.ops import bitops  # noqa: F401

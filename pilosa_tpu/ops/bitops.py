@@ -1,0 +1,225 @@
+"""Dense bitmap primitives: numpy (host) and jax.numpy (device) variants.
+
+Replaces the reference's roaring container kernels (roaring/roaring.go:
+intersect* :3260, union* :3482, difference* :4119, xor* :4466, shift* :4579,
+popcount :5291, Count :407, CountRange :438). A bitmap row here is a dense
+vector of ``WORDS_PER_SHARD`` uint32 words, LSB-first within each word:
+column ``c`` lives at word ``c >> 5``, bit ``c & 31``.
+
+Host (`np_*`) functions are the mutation/import path; device functions are
+pure, jit-friendly and shape-stable, and operate on arrays of shape
+``[..., W]`` so the same code serves one row, a stack of rows, or a stack of
+shards under ``shard_map``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pilosa_tpu.config import SHARD_WIDTH, WORD_BITS, WORDS_PER_SHARD
+
+# ---------------------------------------------------------------------------
+# Host-side (numpy): positions <-> dense words, single-bit mutation
+# ---------------------------------------------------------------------------
+
+
+def np_zero_row(words: int = WORDS_PER_SHARD) -> np.ndarray:
+    return np.zeros(words, dtype=np.uint32)
+
+
+def positions_to_words(positions: np.ndarray, words: int = WORDS_PER_SHARD) -> np.ndarray:
+    """Scatter sorted bit positions into a dense uint32 word block."""
+    out = np.zeros(words, dtype=np.uint32)
+    if len(positions) == 0:
+        return out
+    positions = np.asarray(positions, dtype=np.uint64)
+    word_idx = (positions >> np.uint64(5)).astype(np.int64)
+    bit = np.left_shift(np.uint32(1), (positions & np.uint64(31)).astype(np.uint32))
+    np.bitwise_or.at(out, word_idx, bit)
+    return out
+
+
+def words_to_positions(words: np.ndarray) -> np.ndarray:
+    """Dense block -> sorted uint64 bit positions (the 'columns' of a row)."""
+    words = np.ascontiguousarray(words, dtype=np.uint32)
+    # unpackbits works on uint8 little-end-first per byte with bitorder='little',
+    # which matches LSB-first-within-word once viewed as little-endian bytes.
+    bits = np.unpackbits(words.view(np.uint8), bitorder="little")
+    return np.nonzero(bits)[0].astype(np.uint64)
+
+
+def np_get_bit(words: np.ndarray, pos: int) -> bool:
+    return bool((int(words[pos >> 5]) >> (pos & 31)) & 1)
+
+
+def np_set_bit(words: np.ndarray, pos: int) -> bool:
+    """Set bit in place; returns True if the bit changed."""
+    w, b = pos >> 5, np.uint32(1 << (pos & 31))
+    if words[w] & b:
+        return False
+    words[w] |= b
+    return True
+
+
+def np_clear_bit(words: np.ndarray, pos: int) -> bool:
+    w, b = pos >> 5, np.uint32(1 << (pos & 31))
+    if not (words[w] & b):
+        return False
+    words[w] &= ~b
+    return True
+
+
+_POPCNT8 = np.array([bin(i).count("1") for i in range(256)], dtype=np.uint8)
+
+
+def np_count(words: np.ndarray) -> int:
+    return int(_POPCNT8[words.view(np.uint8)].sum())
+
+
+def np_range_mask(start: int, stop: int, words: int = WORDS_PER_SHARD) -> np.ndarray:
+    """Dense mask with bits [start, stop) set. Reference: CountRange's
+    partial-word handling (roaring.go:438)."""
+    out = np.zeros(words, dtype=np.uint32)
+    start = max(0, start)
+    stop = min(stop, words * WORD_BITS)
+    if start >= stop:
+        return out
+    w0, w1 = start >> 5, (stop - 1) >> 5
+    out[w0 : w1 + 1] = np.uint32(0xFFFFFFFF)
+    out[w0] &= np.uint32(0xFFFFFFFF) << np.uint32(start & 31)
+    tail = stop & 31
+    if tail:
+        out[w1] &= np.uint32(0xFFFFFFFF) >> np.uint32(32 - tail)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Device-side (jax.numpy): set algebra + popcount reductions
+# ---------------------------------------------------------------------------
+# These are deliberately tiny: XLA fuses the bitwise op into the popcount
+# reduction into one VPU loop over HBM, which is the whole performance model
+# (one pass, bandwidth-bound). The Pallas variants in pallas_kernels.py pin
+# the fusion explicitly for the hot Count(Intersect) path.
+
+
+def b_and(a, b):
+    return jnp.bitwise_and(a, b)
+
+
+def b_or(a, b):
+    return jnp.bitwise_or(a, b)
+
+
+def b_xor(a, b):
+    return jnp.bitwise_xor(a, b)
+
+
+def b_andnot(a, b):
+    """a AND NOT b — reference Difference (roaring.go:891)."""
+    return jnp.bitwise_and(a, jnp.bitwise_not(b))
+
+
+def b_not(a):
+    """Full-width complement. Callers must intersect with an existence row
+    (the reference's Not() requires trackExistence, executor.go)."""
+    return jnp.bitwise_not(a)
+
+
+def popcount_words(a):
+    """Per-word popcount, uint32 -> int32."""
+    return jax.lax.population_count(a).astype(jnp.int32)
+
+
+def count(a):
+    """Total set bits over the last axis. int64-safe via int32 partials:
+    a shard row has at most 2^20 bits so int32 never overflows per-row;
+    callers summing across shards promote to int64."""
+    return jnp.sum(popcount_words(a), axis=-1, dtype=jnp.int32)
+
+
+def intersection_count(a, b):
+    """Fused popcount(a & b) — THE hot kernel (reference
+    intersectionCount* roaring.go:3121-3258)."""
+    return jnp.sum(popcount_words(jnp.bitwise_and(a, b)), axis=-1, dtype=jnp.int32)
+
+
+def union_count(a, b):
+    return jnp.sum(popcount_words(jnp.bitwise_or(a, b)), axis=-1, dtype=jnp.int32)
+
+
+def difference_count(a, b):
+    return jnp.sum(popcount_words(b_andnot(a, b)), axis=-1, dtype=jnp.int32)
+
+
+def xor_count(a, b):
+    return jnp.sum(popcount_words(jnp.bitwise_xor(a, b)), axis=-1, dtype=jnp.int32)
+
+
+def any_bit(a):
+    """True if any bit set (reference Any(), used by existence checks)."""
+    return jnp.any(a != 0)
+
+
+def shift_left(a, n: int = 1):
+    """Shift every bit toward higher column ids by ``n`` (< 32), carrying
+    across word boundaries along the last axis; bits shifted past the shard
+    edge fall off (reference Shift, roaring.go:946 — per-shard semantics,
+    executor.go executeShiftShard)."""
+    if n == 0:
+        return a
+    if not 0 < n < WORD_BITS:
+        raise ValueError("shift amount must be in [0, 32)")
+    n_ = jnp.uint32(n)
+    hi = a << n_
+    carry = a >> jnp.uint32(WORD_BITS - n)
+    carry = jnp.concatenate(
+        [jnp.zeros(a.shape[:-1] + (1,), a.dtype), carry[..., :-1]], axis=-1
+    )
+    return hi | carry
+
+
+def range_mask(start, stop, words: int = WORDS_PER_SHARD):
+    """Jit-friendly mask with bits [start, stop) set; start/stop traced."""
+    idx = jnp.arange(words * WORD_BITS, dtype=jnp.int32)
+    bits = (idx >= start) & (idx < stop)
+    return pack_bits(bits)
+
+
+def pack_bits(bits):
+    """Pack a [..., W*32] bool array into [..., W] uint32 words, LSB-first."""
+    shape = bits.shape[:-1] + (bits.shape[-1] // WORD_BITS, WORD_BITS)
+    b = bits.reshape(shape).astype(jnp.uint32)
+    weights = (jnp.uint32(1) << jnp.arange(WORD_BITS, dtype=jnp.uint32))
+    return jnp.sum(b * weights, axis=-1, dtype=jnp.uint32)
+
+
+def unpack_bits(words):
+    """[..., W] uint32 -> [..., W*32] bool, LSB-first (inverse of pack_bits)."""
+    shifts = jnp.arange(WORD_BITS, dtype=jnp.uint32)
+    bits = (words[..., :, None] >> shifts) & jnp.uint32(1)
+    return bits.reshape(words.shape[:-1] + (-1,)).astype(jnp.bool_)
+
+
+# Jitted single-row entry points used by the per-shard executor path. The
+# fused planner (exec/planner.py) builds whole call-trees instead.
+jit_count = jax.jit(count)
+jit_intersection_count = jax.jit(intersection_count)
+jit_and = jax.jit(b_and)
+jit_or = jax.jit(b_or)
+jit_xor = jax.jit(b_xor)
+jit_andnot = jax.jit(b_andnot)
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def jit_shift(a, n: int = 1):
+    return shift_left(a, n)
+
+
+def columns_of(words: np.ndarray | jax.Array, base: int = 0) -> np.ndarray:
+    """Materialize a dense block to sorted absolute column ids (host)."""
+    w = np.asarray(words)
+    return words_to_positions(w) + np.uint64(base)

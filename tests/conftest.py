@@ -1,0 +1,24 @@
+"""Test harness config.
+
+Runs the whole suite on the JAX CPU backend with 8 virtual devices — the
+in-process analog of the reference's ``test.MustRunCluster(t, 3)``
+(test/pilosa.go:343): multi-device semantics without TPU hardware.
+Must run before any jax import.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
