@@ -1,0 +1,5 @@
+"""Data model: Holder -> Index -> Field -> view -> Fragment, plus the Row
+result algebra. Mirrors the reference's root package containment hierarchy
+(holder.go:50, index.go:37, field.go:65, view.go:36, fragment.go:99,
+row.go:27) rebuilt around sparse-at-rest host storage and dense-on-device
+query math."""

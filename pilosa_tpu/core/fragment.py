@@ -1,0 +1,458 @@
+"""Fragment — one (field, view, shard) bitmap matrix.
+
+Reference: fragment.go (struct :100, setBit/clearBit :645/:729, row :602,
+pos encoding :3090, sum/min/max :1111-1227, rangeOp :1272, top :1570,
+bulkImport :1997, importValue :2205, Blocks/checksums :1762-1841,
+mutex/bool vectors :3094-3164).
+
+Design split (TPU-first):
+- **Host truth**: ``rows[row_id] -> HostRow`` — sparse positions at rest,
+  dense past cutoff. Mutations are host ops (the device never scatters
+  single bits; cf. SURVEY §7 "mutation on TPU").
+- **Device cache**: dense uint32 blocks uploaded lazily per row / per row
+  stack, invalidated by a generation counter. Query math (set algebra,
+  BSI, popcounts) runs on-device over these blocks.
+- **Row-count vector**: per-row popcounts maintained incrementally on
+  host; TopN/Rows read it directly. This *replaces* the reference's
+  rankCache machinery (cache.go:136) — recompute is exact and cheap, so
+  there is no threshold staleness to manage.
+
+The reference's positional flattening pos = row*ShardWidth + col%ShardWidth
+(fragment.go:3090) survives only in the WAL/serialized format; in memory the
+row dimension is explicit (it is the device batch axis).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pilosa_tpu.config import (
+    DEFAULT_CACHE_SIZE,
+    HASH_BLOCK_SIZE,
+    SHARD_WIDTH,
+    WORDS_PER_SHARD,
+)
+from pilosa_tpu.core.hostrow import HostRow
+from pilosa_tpu.core.row import Row
+from pilosa_tpu.ops import bitops, bsi as bsi_ops, pallas_kernels
+
+# BSI row layout, reference fragment.go:87-93.
+FALSE_ROW_ID = 0
+TRUE_ROW_ID = 1
+BSI_EXISTS_BIT = 0
+BSI_SIGN_BIT = 1
+BSI_OFFSET_BIT = 2
+
+
+class Fragment:
+    """One shard of one view of one field."""
+
+    def __init__(self, index: str, field: str, view: str, shard: int,
+                 cache_type: str = "ranked", cache_size: int = DEFAULT_CACHE_SIZE,
+                 stats=None, op_writer: Callable | None = None):
+        self.index = index
+        self.field = field
+        self.view = view
+        self.shard = shard
+        self.cache_type = cache_type
+        self.cache_size = cache_size
+        self.stats = stats
+        #: WAL hook: called as op_writer(op, rows, cols) on mutation.
+        self.op_writer = op_writer
+
+        self.rows: dict[int, HostRow] = {}
+        self.generation = 0
+        self._lock = threading.RLock()
+        # device caches: row_id -> (gen, jax.Array[W]); stack key -> (gen, ids, jax.Array[n, W])
+        self._dev_rows: dict[int, tuple[int, jax.Array]] = {}
+        self._dev_stacks: dict[object, tuple[int, tuple, jax.Array]] = {}
+
+    # -- position encoding -------------------------------------------------
+
+    def _local(self, column_id: int) -> int:
+        lo = self.shard * SHARD_WIDTH
+        if not (lo <= column_id < lo + SHARD_WIDTH):
+            raise ValueError(f"column:{column_id} out of bounds")
+        return column_id - lo
+
+    # -- mutation ----------------------------------------------------------
+
+    def _invalidate(self):
+        self.generation += 1
+        # Stale device blocks would never be re-hit (generation mismatch) but
+        # would pin HBM forever; drop them eagerly.
+        self._dev_rows.clear()
+        self._dev_stacks.clear()
+
+    def set_bit(self, row_id: int, column_id: int) -> bool:
+        with self._lock:
+            pos = self._local(column_id)
+            hr = self.rows.get(row_id)
+            if hr is None:
+                hr = self.rows[row_id] = HostRow()
+            changed = hr.add(pos)
+            if changed:
+                self._invalidate()
+                if self.op_writer:
+                    self.op_writer("add", [row_id], [column_id])
+            return changed
+
+    def clear_bit(self, row_id: int, column_id: int) -> bool:
+        with self._lock:
+            pos = self._local(column_id)
+            hr = self.rows.get(row_id)
+            if hr is None:
+                return False
+            changed = hr.remove(pos)
+            if changed:
+                self._invalidate()
+                if self.op_writer:
+                    self.op_writer("remove", [row_id], [column_id])
+            return changed
+
+    def contains(self, row_id: int, column_id: int) -> bool:
+        hr = self.rows.get(row_id)
+        return hr is not None and hr.contains(self._local(column_id))
+
+    def clear_row(self, row_id: int) -> bool:
+        """Reference clearRow (fragment.go, used by ClearRow/Store)."""
+        with self._lock:
+            hr = self.rows.pop(row_id, None)
+            if hr is None or hr.count() == 0:
+                return False
+            self._invalidate()
+            if self.op_writer:
+                cols = (hr.to_positions() + np.uint64(self.shard * SHARD_WIDTH))
+                self.op_writer("removeBatch", [row_id] * len(cols), cols.tolist())
+            return True
+
+    def set_row(self, row: Row, row_id: int) -> bool:
+        """Replace a row wholesale (reference setRow, used by Store)."""
+        with self._lock:
+            seg = row.segment(self.shard)
+            words = np.asarray(seg) if seg is not None else bitops.np_zero_row()
+            self.rows[row_id] = HostRow.from_words(words)
+            self._invalidate()
+            if self.op_writer:
+                cols = bitops.words_to_positions(words) + np.uint64(self.shard * SHARD_WIDTH)
+                self.op_writer("setRow", [row_id], cols.tolist())
+            return True
+
+    def bulk_import(self, row_ids: Iterable[int], column_ids: Iterable[int],
+                    clear: bool = False) -> int:
+        """Batched set/clear (reference bulkImport fragment.go:1997).
+        Returns number of changed bits."""
+        with self._lock:
+            row_ids = np.asarray(list(row_ids), dtype=np.uint64)
+            column_ids = np.asarray(list(column_ids), dtype=np.uint64)
+            if len(row_ids) != len(column_ids):
+                raise ValueError("row/column length mismatch")
+            if len(row_ids) == 0:
+                return 0
+            local = column_ids - np.uint64(self.shard * SHARD_WIDTH)
+            if (local >= SHARD_WIDTH).any():
+                raise ValueError("column out of shard bounds")
+            changed = 0
+            for rid in np.unique(row_ids):
+                mask = row_ids == rid
+                hr = self.rows.get(int(rid))
+                if hr is None:
+                    if clear:
+                        continue
+                    hr = self.rows[int(rid)] = HostRow()
+                if clear:
+                    changed += hr.remove_many(local[mask])
+                else:
+                    changed += hr.add_many(local[mask])
+            if changed:
+                self._invalidate()
+                if self.op_writer:
+                    self.op_writer("removeBatch" if clear else "addBatch",
+                                   row_ids.tolist(), column_ids.tolist())
+            return changed
+
+    def bulk_import_mutex(self, row_ids, column_ids) -> int:
+        """Mutex-field import: setting (row, col) clears any other row's bit
+        in that column; last write per column wins (reference
+        bulkImportMutex fragment.go:2108). Batched: one pass over existing
+        rows to find steals, then grouped add/remove."""
+        with self._lock:
+            base = np.uint64(self.shard * SHARD_WIDTH)
+            desired: dict[int, int] = {}
+            for rid, cid in zip(row_ids, column_ids):
+                self._local(int(cid))  # bounds check
+                desired[int(cid)] = int(rid)
+            cols = np.asarray(sorted(desired), dtype=np.uint64)
+            local = cols - base
+            changed = 0
+            # Clear any column whose bit currently lives in a different row.
+            for rid in list(self.rows):
+                hr = self.rows[rid]
+                present = local[np.isin(local, hr.to_positions(), assume_unique=True)]
+                steal = np.asarray(
+                    [p for p in present.tolist() if desired[int(p + base)] != rid],
+                    dtype=np.uint64,
+                )
+                if len(steal):
+                    changed += hr.remove_many(steal)
+                    if self.op_writer:
+                        self.op_writer("removeBatch", [rid] * len(steal),
+                                       (steal + base).tolist())
+            # Set the desired bits, grouped by row.
+            by_row: dict[int, list[int]] = {}
+            for cid, rid in desired.items():
+                by_row.setdefault(rid, []).append(cid - int(base))
+            for rid, lpos in by_row.items():
+                hr = self.rows.get(rid)
+                if hr is None:
+                    hr = self.rows[rid] = HostRow()
+                added = hr.add_many(np.asarray(lpos, dtype=np.uint64))
+                changed += added
+                if added and self.op_writer:
+                    self.op_writer("addBatch", [rid] * len(lpos),
+                                   [p + int(base) for p in lpos])
+            if changed:
+                self._invalidate()
+            return changed
+
+    # -- reads -------------------------------------------------------------
+
+    def row_ids(self) -> list[int]:
+        return sorted(self.rows)
+
+    def max_row_id(self) -> int | None:
+        return max(self.rows) if self.rows else None
+
+    def min_row_id(self) -> int | None:
+        return min(self.rows) if self.rows else None
+
+    def row_words(self, row_id: int) -> np.ndarray:
+        """Host dense block for one row (zeros if absent)."""
+        hr = self.rows.get(row_id)
+        if hr is None:
+            return bitops.np_zero_row()
+        return hr.to_words()
+
+    def device_row(self, row_id: int) -> jax.Array:
+        """Device block for one row, cached until next mutation."""
+        with self._lock:
+            ent = self._dev_rows.get(row_id)
+            if ent is not None and ent[0] == self.generation:
+                return ent[1]
+            arr = jnp.asarray(self.row_words(row_id))
+            self._dev_rows[row_id] = (self.generation, arr)
+            return arr
+
+    def device_stack(self, row_ids: tuple[int, ...], key: object = None) -> jax.Array:
+        """[len(row_ids), W] device block stack; cached by key until mutation.
+        This is the unit the fused planner and BSI ops consume."""
+        key = key if key is not None else row_ids
+        with self._lock:
+            ent = self._dev_stacks.get(key)
+            if ent is not None and ent[0] == self.generation and ent[1] == row_ids:
+                return ent[2]
+            mat = np.stack([self.row_words(r) for r in row_ids]) if row_ids else \
+                np.zeros((0, WORDS_PER_SHARD), dtype=np.uint32)
+            arr = jnp.asarray(mat)
+            self._dev_stacks[key] = (self.generation, row_ids, arr)
+            return arr
+
+    def row(self, row_id: int) -> Row:
+        """Row result for one bitmap row (reference fragment.row :602)."""
+        return Row({self.shard: self.device_row(row_id)})
+
+    def row_counts(self) -> tuple[np.ndarray, np.ndarray]:
+        """(row_ids, counts) from the incrementally-maintained host counts."""
+        ids = np.asarray(sorted(self.rows), dtype=np.uint64)
+        counts = np.asarray([self.rows[int(i)].count() for i in ids], dtype=np.int64)
+        return ids, counts
+
+    def row_for_column(self, column_id: int) -> int | None:
+        """Mutex/bool vector Get (fragment.go:3117): which row holds this
+        column's bit, if any."""
+        pos = self._local(column_id)
+        for rid, hr in self.rows.items():
+            if hr.contains(pos):
+                return rid
+        return None
+
+    # -- BSI ---------------------------------------------------------------
+
+    def _bsi_stacks(self, bit_depth: int):
+        """(exists, sign, bits[depth, W]) device arrays."""
+        ids = tuple(range(BSI_OFFSET_BIT, BSI_OFFSET_BIT + bit_depth))
+        bits = self.device_stack(ids, key=("bsi", bit_depth))
+        return self.device_row(BSI_EXISTS_BIT), self.device_row(BSI_SIGN_BIT), bits
+
+    def set_value(self, column_id: int, bit_depth: int, value: int) -> bool:
+        """Sign-magnitude BSI write (reference setValueBase fragment.go:939)."""
+        with self._lock:
+            changed = False
+            changed |= self.set_bit(BSI_EXISTS_BIT, column_id)
+            if value < 0:
+                changed |= self.set_bit(BSI_SIGN_BIT, column_id)
+            else:
+                changed |= self.clear_bit(BSI_SIGN_BIT, column_id)
+            mag = abs(value)
+            for i in range(bit_depth):
+                if (mag >> i) & 1:
+                    changed |= self.set_bit(BSI_OFFSET_BIT + i, column_id)
+                else:
+                    changed |= self.clear_bit(BSI_OFFSET_BIT + i, column_id)
+            return changed
+
+    def value(self, column_id: int, bit_depth: int) -> tuple[int, bool]:
+        """(value, exists) — reference fragment.value (fragment.go:897)."""
+        if not self.contains(BSI_EXISTS_BIT, column_id):
+            return 0, False
+        mag = 0
+        for i in range(bit_depth):
+            if self.contains(BSI_OFFSET_BIT + i, column_id):
+                mag |= 1 << i
+        if self.contains(BSI_SIGN_BIT, column_id):
+            mag = -mag
+        return mag, True
+
+    def import_values(self, column_ids, values, bit_depth: int, clear: bool = False) -> None:
+        """Batched BSI write (reference importValue fragment.go:2205)."""
+        for cid, val in zip(column_ids, values):
+            if clear:
+                self.clear_bit(BSI_EXISTS_BIT, cid)
+            else:
+                self.set_value(cid, bit_depth, val)
+
+    def _filter_seg(self, filter_row: Row | None) -> jax.Array:
+        if filter_row is None:
+            return jnp.full((WORDS_PER_SHARD,), jnp.uint32(0xFFFFFFFF))
+        seg = filter_row.segment(self.shard)
+        if seg is None:
+            return jnp.zeros((WORDS_PER_SHARD,), jnp.uint32)
+        return seg if isinstance(seg, jax.Array) else jnp.asarray(seg)
+
+    def sum(self, filter_row: Row | None, bit_depth: int) -> tuple[int, int]:
+        """(sum, count) — reference fragment.sum (fragment.go:1111)."""
+        exists, sign, bits = self._bsi_stacks(bit_depth)
+        return bsi_ops.host_sum(exists, sign, bits, self._filter_seg(filter_row), bit_depth)
+
+    def min(self, filter_row: Row | None, bit_depth: int) -> tuple[int, int]:
+        exists, sign, bits = self._bsi_stacks(bit_depth)
+        return bsi_ops.host_min(exists, sign, bits, self._filter_seg(filter_row), bit_depth)
+
+    def max(self, filter_row: Row | None, bit_depth: int) -> tuple[int, int]:
+        exists, sign, bits = self._bsi_stacks(bit_depth)
+        return bsi_ops.host_max(exists, sign, bits, self._filter_seg(filter_row), bit_depth)
+
+    def range_op(self, op: str, bit_depth: int, predicate: int) -> Row:
+        """op in {eq, neq, lt, lte, gt, gte} (reference rangeOp :1274)."""
+        exists, sign, bits = self._bsi_stacks(bit_depth)
+        if op == "eq":
+            seg = bsi_ops.range_eq(exists, sign, bits, predicate, bit_depth)
+        elif op == "neq":
+            seg = bsi_ops.range_neq(exists, sign, bits, predicate, bit_depth)
+        elif op in ("lt", "lte"):
+            seg = bsi_ops.range_lt(exists, sign, bits, predicate, bit_depth, op == "lte")
+        elif op in ("gt", "gte"):
+            seg = bsi_ops.range_gt(exists, sign, bits, predicate, bit_depth, op == "gte")
+        else:
+            raise ValueError(f"invalid range op {op!r}")
+        return Row({self.shard: seg})
+
+    def range_between(self, bit_depth: int, pmin: int, pmax: int) -> Row:
+        exists, sign, bits = self._bsi_stacks(bit_depth)
+        seg = bsi_ops.range_between(exists, sign, bits, pmin, pmax, bit_depth)
+        return Row({self.shard: seg})
+
+    def not_null(self) -> Row:
+        return self.row(BSI_EXISTS_BIT)
+
+    # -- TopN / Rows -------------------------------------------------------
+
+    def top(self, n: int = 0, src: Row | None = None,
+            row_ids: Iterable[int] | None = None) -> list[tuple[int, int]]:
+        """Top rows by count, optionally filtered to rows intersecting src
+        or an explicit row-id set. Exact (device intersection counts), not
+        cache-approximate like the reference (fragment.go:1570).
+        Returns [(row_id, count)] sorted by count desc, id asc."""
+        if row_ids is not None:
+            ids = np.asarray(sorted(set(int(r) for r in row_ids)), dtype=np.uint64)
+        else:
+            ids = np.asarray(sorted(self.rows), dtype=np.uint64)
+        if len(ids) == 0:
+            return []
+        if src is not None:
+            seg = self._filter_seg(src)
+            stack = self.device_stack(tuple(int(i) for i in ids))
+            counts = np.asarray(pallas_kernels.pair_count(stack, seg, "and"))
+        else:
+            counts = np.asarray([self.rows[int(i)].count() if int(i) in self.rows else 0
+                                 for i in ids], dtype=np.int64)
+        order = np.lexsort((ids, -counts))
+        pairs = [(int(ids[i]), int(counts[i])) for i in order if counts[i] > 0]
+        if n > 0:
+            pairs = pairs[:n]
+        return pairs
+
+    def rows_list(self, start_row: int = 0, column: int | None = None,
+                  limit: int | None = None) -> list[int]:
+        """Row IDs present, from start_row, optionally only rows with a bit
+        in `column` (reference rows + filters fragment.go:2618-2724)."""
+        if column is not None:
+            pos = self._local(column)
+            out = [r for r in sorted(self.rows)
+                   if r >= start_row and self.rows[r].contains(pos)]
+        else:
+            out = [r for r in sorted(self.rows) if r >= start_row and self.rows[r].n > 0]
+        if limit is not None:
+            out = out[:limit]
+        return out
+
+    # -- anti-entropy checksums -------------------------------------------
+
+    def checksum_blocks(self, block_rows: int = HASH_BLOCK_SIZE) -> dict[int, bytes]:
+        """Block id -> content hash over 100-row blocks (reference
+        Blocks/Checksum fragment.go:1762-1841, xxhash over containers).
+        Used by the replica-repair sync protocol. Each row is framed as
+        (row id, bit count, positions) so distinct row partitions of the
+        same positions can't collide."""
+        import hashlib
+        blocks: dict[int, "hashlib._Hash"] = {}
+        for rid in sorted(self.rows):
+            hr = self.rows[rid]
+            if hr.n == 0:
+                continue
+            b = rid // block_rows
+            h = blocks.get(b)
+            if h is None:
+                h = blocks[b] = hashlib.blake2b(digest_size=16)
+            h.update(np.uint64(rid).tobytes())
+            h.update(np.uint64(hr.n).tobytes())
+            h.update(hr.to_positions().tobytes())
+        return {b: h.digest() for b, h in blocks.items()}
+
+    def block_data(self, block: int, block_rows: int = HASH_BLOCK_SIZE) -> tuple[np.ndarray, np.ndarray]:
+        """(row_ids, column_ids) of all bits in a checksum block."""
+        rows_out, cols_out = [], []
+        base = np.uint64(self.shard * SHARD_WIDTH)
+        for rid in sorted(self.rows):
+            if rid // block_rows != block:
+                continue
+            pos = self.rows[rid].to_positions()
+            rows_out.append(np.full(len(pos), rid, dtype=np.uint64))
+            cols_out.append(pos + base)
+        if not rows_out:
+            return np.empty(0, np.uint64), np.empty(0, np.uint64)
+        return np.concatenate(rows_out), np.concatenate(cols_out)
+
+    # -- stats -------------------------------------------------------------
+
+    def bit_count(self) -> int:
+        return sum(hr.count() for hr in self.rows.values())
+
+    def __repr__(self):
+        return (f"Fragment({self.index}/{self.field}/{self.view}/{self.shard} "
+                f"rows={len(self.rows)} bits={self.bit_count()})")

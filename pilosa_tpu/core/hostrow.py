@@ -1,0 +1,165 @@
+"""Host-side row storage: sparse positions at rest, dense words when hot.
+
+This replaces roaring's array/bitmap/run container adaptivity
+(roaring/container_stash.go:39, conversions roaring.go:2599-2878) with a
+two-state scheme chosen for the TPU split-brain design: rows live on the
+host as sorted uint64 position arrays (cheap mutation, tiny for sparse
+rows) and flip to dense uint32 word blocks past DENSE_CUTOFF — the dense
+block being exactly the HBM layout the device kernels consume, so upload
+is a straight copy, no re-encode.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pilosa_tpu.config import DENSE_CUTOFF, SHARD_WIDTH, WORDS_PER_SHARD
+from pilosa_tpu.ops import bitops
+
+
+class HostRow:
+    """One bitmap row (2^20 columns) of one fragment, host resident."""
+
+    __slots__ = ("positions", "dense", "n")
+
+    def __init__(self):
+        self.positions: np.ndarray | None = np.empty(0, dtype=np.uint64)
+        self.dense: np.ndarray | None = None
+        self.n: int = 0  # set-bit count, maintained incrementally
+
+    # -- state ------------------------------------------------------------
+
+    @property
+    def is_dense(self) -> bool:
+        return self.dense is not None
+
+    def _maybe_densify(self) -> None:
+        if self.positions is not None and len(self.positions) > DENSE_CUTOFF:
+            self.dense = bitops.positions_to_words(self.positions)
+            self.positions = None
+
+    # -- mutation ---------------------------------------------------------
+
+    def add(self, pos: int) -> bool:
+        """Set one bit; True if changed. pos is shard-relative."""
+        if self.dense is not None:
+            if bitops.np_set_bit(self.dense, pos):
+                self.n += 1
+                return True
+            return False
+        i = np.searchsorted(self.positions, pos)
+        if i < len(self.positions) and self.positions[i] == pos:
+            return False
+        self.positions = np.insert(self.positions, i, np.uint64(pos))
+        self.n += 1
+        self._maybe_densify()
+        return True
+
+    def remove(self, pos: int) -> bool:
+        if self.dense is not None:
+            if bitops.np_clear_bit(self.dense, pos):
+                self.n -= 1
+                return True
+            return False
+        i = np.searchsorted(self.positions, pos)
+        if i < len(self.positions) and self.positions[i] == pos:
+            self.positions = np.delete(self.positions, i)
+            self.n -= 1
+            return True
+        return False
+
+    def add_many(self, positions: np.ndarray) -> int:
+        """Bulk-or of sorted-or-not positions; returns number of new bits.
+        The reference analog is bulkImport's importPositions
+        (fragment.go:2053, roaring AddN)."""
+        positions = np.unique(np.asarray(positions, dtype=np.uint64))
+        if len(positions) == 0:
+            return 0
+        if self.dense is None and len(positions) + len(self.positions) > DENSE_CUTOFF:
+            self.dense = bitops.positions_to_words(self.positions)
+            self.positions = None
+        if self.dense is not None:
+            before = self.n
+            word_idx = (positions >> np.uint64(5)).astype(np.int64)
+            bit = np.left_shift(np.uint32(1), (positions & np.uint64(31)).astype(np.uint32))
+            np.bitwise_or.at(self.dense, word_idx, bit)
+            self.n = bitops.np_count(self.dense)
+            return self.n - before
+        merged = np.union1d(self.positions, positions)
+        changed = len(merged) - len(self.positions)
+        self.positions = merged
+        self.n = len(merged)
+        self._maybe_densify()
+        return changed
+
+    def remove_many(self, positions: np.ndarray) -> int:
+        positions = np.unique(np.asarray(positions, dtype=np.uint64))
+        if len(positions) == 0:
+            return 0
+        if self.dense is not None:
+            before = self.n
+            word_idx = (positions >> np.uint64(5)).astype(np.int64)
+            bit = np.left_shift(np.uint32(1), (positions & np.uint64(31)).astype(np.uint32))
+            np.bitwise_and.at(self.dense, word_idx, ~bit)
+            self.n = bitops.np_count(self.dense)
+            return before - self.n
+        kept = np.setdiff1d(self.positions, positions, assume_unique=True)
+        removed = len(self.positions) - len(kept)
+        self.positions = kept
+        self.n = len(kept)
+        return removed
+
+    # -- reads ------------------------------------------------------------
+
+    def contains(self, pos: int) -> bool:
+        if self.dense is not None:
+            return bitops.np_get_bit(self.dense, pos)
+        i = np.searchsorted(self.positions, pos)
+        return i < len(self.positions) and self.positions[i] == pos
+
+    def count(self) -> int:
+        return self.n
+
+    def count_range(self, start: int, stop: int) -> int:
+        """Set bits in [start, stop) — reference CountRange (roaring.go:438)."""
+        if self.dense is not None:
+            mask = bitops.np_range_mask(start, stop)
+            return bitops.np_count(self.dense & mask)
+        lo = np.searchsorted(self.positions, start)
+        hi = np.searchsorted(self.positions, stop)
+        return int(hi - lo)
+
+    def to_words(self) -> np.ndarray:
+        """Dense uint32[W] block (the device upload format). Copy-safe."""
+        if self.dense is not None:
+            return self.dense.copy()
+        return bitops.positions_to_words(self.positions)
+
+    def to_positions(self) -> np.ndarray:
+        if self.dense is not None:
+            return bitops.words_to_positions(self.dense)
+        return self.positions.copy()
+
+    @classmethod
+    def from_positions(cls, positions: np.ndarray) -> "HostRow":
+        r = cls()
+        positions = np.unique(np.asarray(positions, dtype=np.uint64))
+        if len(positions) > DENSE_CUTOFF:
+            r.dense = bitops.positions_to_words(positions)
+            r.positions = None
+        else:
+            r.positions = positions
+        r.n = len(positions)
+        return r
+
+    @classmethod
+    def from_words(cls, words: np.ndarray) -> "HostRow":
+        r = cls()
+        n = bitops.np_count(words)
+        if n > DENSE_CUTOFF:
+            r.dense = np.array(words, dtype=np.uint32)
+            r.positions = None
+        else:
+            r.positions = bitops.words_to_positions(words)
+        r.n = n
+        return r

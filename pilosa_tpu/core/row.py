@@ -1,0 +1,173 @@
+"""Row — the cross-shard query result algebra.
+
+Reference: row.go (Row :27, rowSegment :332, Union k-way merge :153,
+Intersect :107, Difference :198, Xor :133, Shift :217). A Row is a sorted
+list of per-shard segments; here each segment is one dense uint32[W] block,
+typically a device (jax) array so chained set algebra stays on-device and
+only Columns()/Count() materialization syncs to host.
+
+Segments are immutable (functional ops return new Rows) — the reference's
+copy-on-write ``Freeze``/``ensureWritable`` (row.go:479) machinery
+disappears because jax arrays are immutable by construction.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pilosa_tpu.config import SHARD_WIDTH, WORDS_PER_SHARD
+from pilosa_tpu.ops import bitops
+
+
+def _as_device(words) -> jax.Array:
+    if isinstance(words, jax.Array):
+        return words
+    return jnp.asarray(words)
+
+
+class Row:
+    """Set of column IDs spanning shards, plus result attrs/keys."""
+
+    __slots__ = ("segments", "attrs", "keys")
+
+    def __init__(self, segments: dict[int, object] | None = None, attrs=None, keys=None):
+        #: shard -> uint32[W] block (jax array or numpy; converted lazily)
+        self.segments: dict[int, object] = dict(segments or {})
+        self.attrs = attrs or {}
+        self.keys = keys or []
+
+    @classmethod
+    def from_columns(cls, columns: Iterable[int]) -> "Row":
+        cols = np.asarray(sorted(set(int(c) for c in columns)), dtype=np.uint64)
+        shards = (cols // SHARD_WIDTH).astype(np.int64)
+        segs = {}
+        for shard in np.unique(shards):
+            local = cols[shards == shard] % SHARD_WIDTH
+            segs[int(shard)] = bitops.positions_to_words(local)
+        return cls(segs)
+
+    def segment(self, shard: int):
+        return self.segments.get(shard)
+
+    def shards(self) -> list[int]:
+        return sorted(self.segments)
+
+    # -- algebra ----------------------------------------------------------
+
+    def _binary(self, other: "Row", op: Callable, keep: str) -> "Row":
+        """keep: which side's unmatched shards survive ('both'|'left'|'none')."""
+        out = {}
+        a, b = self.segments, other.segments
+        for shard in set(a) | set(b):
+            sa, sb = a.get(shard), b.get(shard)
+            if sa is not None and sb is not None:
+                out[shard] = op(_as_device(sa), _as_device(sb))
+            elif sa is not None and keep in ("both", "left"):
+                out[shard] = sa
+            elif sb is not None and keep == "both":
+                out[shard] = sb
+        return Row(out)
+
+    def intersect(self, other: "Row") -> "Row":
+        out = {}
+        for shard in set(self.segments) & set(other.segments):
+            out[shard] = bitops.b_and(
+                _as_device(self.segments[shard]), _as_device(other.segments[shard])
+            )
+        return Row(out)
+
+    def union(self, *others: "Row") -> "Row":
+        """k-way union (reference row.go:153 merges segment lists by shard)."""
+        rows = (self,) + others
+        by_shard: dict[int, list] = {}
+        for r in rows:
+            for shard, seg in r.segments.items():
+                by_shard.setdefault(shard, []).append(seg)
+        out = {}
+        for shard, segs in by_shard.items():
+            if len(segs) == 1:
+                out[shard] = segs[0]
+            else:
+                acc = _as_device(segs[0])
+                for s in segs[1:]:
+                    acc = bitops.b_or(acc, _as_device(s))
+                out[shard] = acc
+        return Row(out)
+
+    def difference(self, *others: "Row") -> "Row":
+        out = dict(self.segments)
+        for other in others:
+            for shard, seg in other.segments.items():
+                if shard in out:
+                    out[shard] = bitops.b_andnot(_as_device(out[shard]), _as_device(seg))
+        return Row(out)
+
+    def xor(self, other: "Row") -> "Row":
+        return self._binary(other, bitops.b_xor, keep="both")
+
+    def shift(self, n: int = 1) -> "Row":
+        """Per-shard shift; bits do NOT carry across shard boundaries
+        (reference executeShiftShard semantics)."""
+        return Row({s: bitops.jit_shift(_as_device(seg), n) for s, seg in self.segments.items()})
+
+    # -- reductions --------------------------------------------------------
+
+    def count(self) -> int:
+        total = 0
+        for seg in self.segments.values():
+            if isinstance(seg, np.ndarray):
+                total += bitops.np_count(seg)
+            else:
+                total += int(bitops.jit_count(seg))
+        return total
+
+    def intersection_count(self, other: "Row") -> int:
+        total = 0
+        for shard in set(self.segments) & set(other.segments):
+            total += int(
+                bitops.jit_intersection_count(
+                    _as_device(self.segments[shard]), _as_device(other.segments[shard])
+                )
+            )
+        return total
+
+    def any(self) -> bool:
+        return any(
+            (bitops.np_count(seg) if isinstance(seg, np.ndarray) else int(bitops.jit_count(seg))) > 0
+            for seg in self.segments.values()
+        )
+
+    def columns(self) -> np.ndarray:
+        """Materialize sorted absolute column IDs (host sync point)."""
+        parts = []
+        for shard in self.shards():
+            seg = np.asarray(self.segments[shard])
+            parts.append(bitops.columns_of(seg, base=shard * SHARD_WIDTH))
+        if not parts:
+            return np.empty(0, dtype=np.uint64)
+        return np.concatenate(parts)
+
+    def is_empty(self) -> bool:
+        return not self.any()
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, Row):
+            return NotImplemented
+        return np.array_equal(self.columns(), other.columns())
+
+    def __repr__(self) -> str:
+        cols = self.columns()
+        head = ", ".join(str(c) for c in cols[:8])
+        more = "..." if len(cols) > 8 else ""
+        return f"Row([{head}{more}] n={len(cols)})"
+
+    def to_json(self) -> dict:
+        """Reference Row.MarshalJSON shape (row.go:302): attrs + columns."""
+        out = {"attrs": self.attrs, "columns": [int(c) for c in self.columns()]}
+        if self.keys:
+            out["keys"] = self.keys
+        return out

@@ -1,0 +1,139 @@
+"""BSI range/aggregate ops vs a reference-semantics oracle.
+
+The oracle encodes the *reference's* branch structure exactly — including
+its pred==-1 strict-compare quirks (fragment.go:1343,:1412) — so parity is
+with observed Go behavior, not idealized arithmetic."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core.fragment import Fragment
+
+
+def ref_lt(values: dict, pred: int, allow_eq: bool) -> set:
+    up = abs(pred)  # reference always compares against the magnitude
+    if (pred >= 0 and allow_eq) or (pred >= -1 and not allow_eq):
+        neg = {c for c, v in values.items() if v < 0}
+        pos = {c for c, v in values.items()
+               if v >= 0 and (v < up or (allow_eq and v == up))}
+        return neg | pos
+    return {c for c, v in values.items()
+            if v < 0 and (abs(v) > up or (allow_eq and abs(v) == up))}
+
+
+def ref_gt(values: dict, pred: int, allow_eq: bool) -> set:
+    up = abs(pred)
+    if (pred >= 0 and allow_eq) or (pred >= -1 and not allow_eq):
+        return {c for c, v in values.items()
+                if v >= 0 and (v > up or (allow_eq and v == up))}
+    neg = {c for c, v in values.items()
+           if v < 0 and (abs(v) < up or (allow_eq and abs(v) == up))}
+    pos = {c for c, v in values.items() if v >= 0}
+    return neg | pos
+
+
+def ref_between(values: dict, pmin: int, pmax: int) -> set:
+    if pmin >= 0:
+        return {c for c, v in values.items() if v >= 0 and pmin <= v <= pmax}
+    if pmax < 0:
+        return {c for c, v in values.items()
+                if v < 0 and abs(pmax) <= abs(v) <= abs(pmin)}
+    pos = {c for c, v in values.items() if 0 <= v <= pmax}
+    neg = {c for c, v in values.items() if v < 0 and abs(v) <= abs(pmin)}
+    return pos | neg
+
+
+DEPTH = 8
+VALUES = {0: 0, 1: 1, 2: 2, 3: 100, 4: -1, 5: -2, 6: -100, 7: 127, 9: 3, 50: -127}
+
+
+@pytest.fixture(scope="module")
+def bsi_frag():
+    f = Fragment("i", "f", "bsig_f", 0)
+    for col, val in VALUES.items():
+        f.set_value(col, DEPTH, val)
+    return f
+
+
+def test_value_roundtrip(bsi_frag):
+    for col, val in VALUES.items():
+        got, ok = bsi_frag.value(col, DEPTH)
+        assert ok and got == val, (col, val, got)
+    _, ok = bsi_frag.value(30, DEPTH)
+    assert not ok
+
+
+PREDICATES = [-128, -127, -101, -100, -99, -3, -2, -1, 0, 1, 2, 3, 99, 100, 101, 127, 128]
+
+
+@pytest.mark.parametrize("pred", PREDICATES)
+def test_range_lt_gt(bsi_frag, pred):
+    for op, allow_eq, oracle in [
+        ("lt", False, lambda: ref_lt(VALUES, pred, False)),
+        ("lte", True, lambda: ref_lt(VALUES, pred, True)),
+        ("gt", False, lambda: ref_gt(VALUES, pred, False)),
+        ("gte", True, lambda: ref_gt(VALUES, pred, True)),
+    ]:
+        got = set(bsi_frag.range_op(op, DEPTH, pred).columns().tolist())
+        assert got == oracle(), (op, pred)
+
+
+@pytest.mark.parametrize("pred", PREDICATES)
+def test_range_eq_neq(bsi_frag, pred):
+    got = set(bsi_frag.range_op("eq", DEPTH, pred).columns().tolist())
+    assert got == {c for c, v in VALUES.items() if v == pred}, pred
+    got = set(bsi_frag.range_op("neq", DEPTH, pred).columns().tolist())
+    assert got == {c for c, v in VALUES.items() if v != pred}, pred
+
+
+@pytest.mark.parametrize("pmin,pmax", [(0, 100), (1, 2), (-2, -1), (-100, 100),
+                                       (-127, 0), (5, 5), (-1, 1), (101, 200)])
+def test_range_between(bsi_frag, pmin, pmax):
+    got = set(bsi_frag.range_between(DEPTH, pmin, pmax).columns().tolist())
+    assert got == ref_between(VALUES, pmin, pmax), (pmin, pmax)
+
+
+def test_sum(bsi_frag):
+    total, count = bsi_frag.sum(None, DEPTH)
+    assert count == len(VALUES)
+    assert total == sum(VALUES.values())
+
+
+def test_sum_filtered(bsi_frag):
+    from pilosa_tpu.core.row import Row
+    filt = Row.from_columns([0, 3, 6])
+    total, count = bsi_frag.sum(filt, DEPTH)
+    assert count == 3
+    assert total == VALUES[0] + VALUES[3] + VALUES[6]
+
+
+def test_min_max(bsi_frag):
+    mn, cnt = bsi_frag.min(None, DEPTH)
+    assert (mn, cnt) == (-127, 1)
+    mx, cnt = bsi_frag.max(None, DEPTH)
+    assert (mx, cnt) == (127, 1)
+
+
+def test_min_max_filtered(bsi_frag):
+    from pilosa_tpu.core.row import Row
+    filt = Row.from_columns([1, 2, 9])  # values 1, 2, 3
+    assert bsi_frag.min(filt, DEPTH) == (1, 1)
+    assert bsi_frag.max(filt, DEPTH) == (3, 1)
+    # multiple columns sharing the extreme value
+    f = Fragment("i", "f2", "bsig_f2", 0)
+    for c in range(5):
+        f.set_value(c, 4, 7)
+    assert f.min(None, 4) == (7, 5)
+    assert f.max(None, 4) == (7, 5)
+
+
+def test_min_max_empty():
+    f = Fragment("i", "g", "bsig_g", 0)
+    assert f.min(None, 4) == (0, 0)
+    assert f.max(None, 4) == (0, 0)
+    assert f.sum(None, 4) == (0, 0)
+
+
+def test_not_null(bsi_frag):
+    got = set(bsi_frag.not_null().columns().tolist())
+    assert got == set(VALUES)
