@@ -1,0 +1,222 @@
+"""PQL parser tests — cases modeled on reference pql/pql_test.go behavior."""
+
+import pytest
+
+from pilosa_tpu import pql
+from pilosa_tpu.pql import BETWEEN, EQ, GT, GTE, LT, LTE, NEQ, Call, Condition
+
+
+def one(src: str) -> Call:
+    q = pql.parse(src)
+    assert len(q.calls) == 1, q.calls
+    return q.calls[0]
+
+
+def test_empty_query():
+    assert pql.parse("").calls == []
+    assert pql.parse("  \n\t ").calls == []
+
+
+def test_simple_call():
+    c = one("Row(f=10)")
+    assert c.name == "Row"
+    assert c.args == {"f": 10}
+    assert c.children == []
+
+
+def test_nested_calls():
+    c = one("Count(Intersect(Row(a=1), Row(b=2)))")
+    assert c.name == "Count"
+    assert len(c.children) == 1
+    inter = c.children[0]
+    assert inter.name == "Intersect"
+    assert [ch.name for ch in inter.children] == ["Row", "Row"]
+    assert inter.children[0].args == {"a": 1}
+    assert inter.children[1].args == {"b": 2}
+
+
+def test_multiple_top_level_calls():
+    q = pql.parse("Set(1, f=2) Count(Row(f=2))\nRow(f=3)")
+    assert [c.name for c in q.calls] == ["Set", "Count", "Row"]
+    assert q.write_call_n() == 1
+
+
+def test_set_positional():
+    c = one("Set(10, f=1)")
+    assert c.args == {"_col": 10, "f": 1}
+
+
+def test_set_with_timestamp():
+    c = one("Set(10, f=1, 2001-02-03T04:05)")
+    assert c.args == {"_col": 10, "f": 1, "_timestamp": "2001-02-03T04:05"}
+
+
+def test_set_string_col():
+    c = one('Set("abc", f=1)')
+    assert c.args == {"_col": "abc", "f": 1}
+    c = one("Set('x-y', f=1)")
+    assert c.args == {"_col": "x-y", "f": 1}
+
+
+def test_clear():
+    c = one("Clear(7, f=3)")
+    assert c.name == "Clear"
+    assert c.args == {"_col": 7, "f": 3}
+
+
+def test_clear_row():
+    c = one("ClearRow(f=5)")
+    assert c.args == {"f": 5}
+
+
+def test_store():
+    c = one("Store(Row(f=1), g=2)")
+    assert c.name == "Store"
+    assert len(c.children) == 1
+    assert c.children[0].name == "Row"
+    assert c.args == {"g": 2}
+
+
+def test_set_row_attrs():
+    c = one('SetRowAttrs(f, 10, color="blue", active=true, weight=1.5, x=null)')
+    assert c.args == {
+        "_field": "f", "_row": 10,
+        "color": "blue", "active": True, "weight": 1.5, "x": None,
+    }
+
+
+def test_set_column_attrs():
+    c = one('SetColumnAttrs(9, name="bob", qty=-3)')
+    assert c.args == {"_col": 9, "name": "bob", "qty": -3}
+
+
+def test_topn():
+    c = one("TopN(f)")
+    assert c.args == {"_field": "f"}
+    c = one("TopN(f, n=25)")
+    assert c.args == {"_field": "f", "n": 25}
+    c = one("TopN(f, Row(g=1), n=10)")
+    assert c.args == {"_field": "f", "n": 10}
+    assert c.children[0].name == "Row"
+
+
+def test_rows():
+    c = one("Rows(f, previous=10, limit=100, column=3)")
+    assert c.args == {"_field": "f", "previous": 10, "limit": 100, "column": 3}
+
+
+def test_range_time_form():
+    c = one("Range(f=1, from='1999-12-31T00:00', to='2002-01-01T02:00')")
+    assert c.args == {"f": 1, "from": "1999-12-31T00:00", "to": "2002-01-01T02:00"}
+    c = one("Range(f=1, 1999-12-31T00:00, 2002-01-01T02:00)")
+    assert c.args == {"f": 1, "from": "1999-12-31T00:00", "to": "2002-01-01T02:00"}
+
+
+def test_range_condition_form():
+    c = one("Range(f > 5)")
+    cond = c.args["f"]
+    assert isinstance(cond, Condition)
+    assert cond.op == GT and cond.value == 5
+
+
+@pytest.mark.parametrize("op,tok", [
+    ("==", EQ), ("!=", NEQ), ("<", LT), ("<=", LTE), (">", GT), (">=", GTE),
+])
+def test_conditions(op, tok):
+    c = one(f"Row(f {op} 17)")
+    cond = c.args["f"]
+    assert isinstance(cond, Condition)
+    assert cond.op == tok
+    assert cond.value == 17
+
+
+def test_between_condition():
+    c = one("Row(f >< [4, 8])")
+    cond = c.args["f"]
+    assert cond.op == BETWEEN and cond.value == [4, 8]
+
+
+def test_conditional_form():
+    c = one("Row(4 < f <= 10)")
+    cond = c.args["f"]
+    assert cond.op == BETWEEN
+    assert cond.value == [5, 10]
+    c = one("Row(-2 <= f < 6)")
+    assert c.args["f"].value == [-2, 5]
+
+
+def test_negative_and_float_values():
+    c = one("Row(a=-5, b=1.25, c=-0.5)")
+    assert c.args == {"a": -5, "b": 1.25, "c": -0.5}
+
+
+def test_list_values():
+    c = one("Row(ids=[1, 2, 3])")
+    assert c.args == {"ids": [1, 2, 3]}
+    c = one('F(x=["a", "b"])')
+    assert c.args == {"x": ["a", "b"]}
+
+
+def test_bare_string_value():
+    c = one("Options(Row(f=1), field=other-thing:x)")
+    assert c.args["field"] == "other-thing:x"
+
+
+def test_string_escapes():
+    c = one(r'Row(f="a\"b")')
+    assert c.args["f"] == 'a"b'
+
+
+def test_call_as_arg_value():
+    c = one("GroupBy(Rows(a), filter=Row(b=1))")
+    assert c.children[0].name == "Rows"
+    filt = c.args["filter"]
+    assert isinstance(filt, Call) and filt.name == "Row"
+
+
+def test_duplicate_arg_rejected():
+    with pytest.raises(pql.ParseError):
+        pql.parse("Row(f=1, f=2)")
+
+
+def test_unterminated_call():
+    with pytest.raises(pql.ParseError):
+        pql.parse("Row(f=1")
+
+
+def test_garbage_rejected():
+    with pytest.raises(pql.ParseError):
+        pql.parse("Row(f=1))")
+
+
+def test_trailing_comma_generic():
+    c = one("Union(Row(a=1), Row(b=2),)")
+    assert len(c.children) == 2
+
+
+def test_keyword_prefix_is_bare_string():
+    c = one("Row(f=nullable)")
+    assert c.args["f"] == "nullable"
+    c = one("Row(f=truex)")
+    assert c.args["f"] == "truex"
+
+
+def test_call_str_roundtrip():
+    c = one("Count(Intersect(Row(a=1), Row(b=2)))")
+    assert str(c) == "Count(Intersect(Row(a=1), Row(b=2)))"
+    c = one("Row(4 < f <= 10)")
+    assert str(c) == "Row(f >< [5,10])"
+
+
+def test_uint_arg_accessors():
+    c = one("Row(f=10)")
+    v, ok = c.uint_arg("f")
+    assert (v, ok) == (10, True)
+    v, ok = c.uint_arg("missing")
+    assert (v, ok) == (0, False)
+    assert c.field_arg() == "f"
+
+
+def test_not_call():
+    c = one("Not(Row(f=1))")
+    assert c.name == "Not" and c.children[0].name == "Row"
