@@ -3,3 +3,18 @@ result algebra. Mirrors the reference's root package containment hierarchy
 (holder.go:50, index.go:37, field.go:65, view.go:36, fragment.go:99,
 row.go:27) rebuilt around sparse-at-rest host storage and dense-on-device
 query math."""
+
+from pilosa_tpu.core.attrs import AttrStore
+from pilosa_tpu.core.field import Field, FieldOptions
+from pilosa_tpu.core.fragment import Fragment
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.core.hostrow import HostRow
+from pilosa_tpu.core.index import Index, IndexOptions
+from pilosa_tpu.core.row import Row
+from pilosa_tpu.core.translate import TranslateStore
+from pilosa_tpu.core.view import View
+
+__all__ = [
+    "AttrStore", "Field", "FieldOptions", "Fragment", "Holder", "HostRow",
+    "Index", "IndexOptions", "Row", "TranslateStore", "View",
+]

@@ -1,0 +1,528 @@
+"""Field — a typed container of views.
+
+Reference: field.go (types :56-62, FieldOptions :1419, SetBit :927,
+ClearBit :967, SetValue :1075, Sum/Min/Max/Range :1121-1201, Import :1204,
+importValue :1285, bsiGroup :1561-1643, remote AvailableShards :263-358).
+
+Types:
+- ``set``   — plain rows, ranked/lru TopN cache options.
+- ``int``   — BSI (bit-sliced integers) with [min, max] and an offset base.
+- ``time``  — set + time-quantum views for range queries.
+- ``mutex`` — set with one-row-per-column invariant.
+- ``bool``  — mutex over rows {0:false, 1:true}.
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import threading
+from dataclasses import dataclass, field as dc_field
+from typing import Iterable
+
+import numpy as np
+
+from pilosa_tpu.config import (
+    DEFAULT_CACHE_SIZE,
+    EXISTENCE_FIELD_NAME,
+    SHARD_WIDTH,
+)
+from pilosa_tpu.core import timequantum as tq
+from pilosa_tpu.core.attrs import AttrStore
+from pilosa_tpu.core.row import Row
+from pilosa_tpu.core.translate import TranslateStore
+from pilosa_tpu.core.view import (
+    VIEW_STANDARD,
+    View,
+    is_time_view,
+    view_bsi_name,
+)
+from pilosa_tpu.errors import (
+    BSIGroupNotFoundError,
+    BSIGroupValueTooHighError,
+    BSIGroupValueTooLowError,
+    InvalidBSIGroupRangeError,
+    InvalidCacheTypeError,
+    InvalidFieldTypeError,
+    validate_name,
+)
+from pilosa_tpu.pql import ast as pql_ast
+
+FIELD_TYPE_SET = "set"
+FIELD_TYPE_INT = "int"
+FIELD_TYPE_TIME = "time"
+FIELD_TYPE_MUTEX = "mutex"
+FIELD_TYPE_BOOL = "bool"
+
+CACHE_TYPE_RANKED = "ranked"
+CACHE_TYPE_LRU = "lru"
+CACHE_TYPE_NONE = "none"
+
+_VALID_CACHE_TYPES = {CACHE_TYPE_RANKED, CACHE_TYPE_LRU, CACHE_TYPE_NONE}
+
+def bit_depth_uint(v: int) -> int:
+    """Bits to store unsigned v (reference bitDepth field.go:1663)."""
+    for i in range(63):
+        if v < (1 << i):
+            return i
+    return 63
+
+
+def bit_depth_int(v: int) -> int:
+    return bit_depth_uint(-v if v < 0 else v)
+
+
+def bsi_base(min_: int, max_: int) -> int:
+    """Reference bsiBase (field.go:1551)."""
+    if min_ > 0:
+        return min_
+    if max_ < 0:
+        return max_
+    return 0
+
+
+@dataclass
+class FieldOptions:
+    """Reference FieldOptions (field.go:1419)."""
+
+    type: str = FIELD_TYPE_SET
+    cache_type: str = CACHE_TYPE_RANKED
+    cache_size: int = DEFAULT_CACHE_SIZE
+    min: int = 0
+    max: int = 0
+    base: int = 0
+    bit_depth: int = 0
+    time_quantum: str = ""
+    keys: bool = False
+    no_standard_view: bool = False
+
+    def to_json(self) -> dict:
+        """Type-dependent shape (reference FieldOptions.MarshalJSON)."""
+        if self.type == FIELD_TYPE_INT:
+            return {"type": self.type, "base": self.base,
+                    "bitDepth": self.bit_depth, "min": self.min,
+                    "max": self.max, "keys": self.keys}
+        if self.type == FIELD_TYPE_TIME:
+            return {"type": self.type, "timeQuantum": self.time_quantum,
+                    "keys": self.keys, "noStandardView": self.no_standard_view}
+        if self.type == FIELD_TYPE_BOOL:
+            return {"type": self.type}
+        return {"type": self.type, "cacheType": self.cache_type,
+                "cacheSize": self.cache_size, "keys": self.keys}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "FieldOptions":
+        return cls(
+            type=d.get("type", FIELD_TYPE_SET),
+            cache_type=d.get("cacheType", CACHE_TYPE_RANKED),
+            cache_size=d.get("cacheSize", DEFAULT_CACHE_SIZE),
+            min=d.get("min", 0), max=d.get("max", 0),
+            base=d.get("base", 0), bit_depth=d.get("bitDepth", 0),
+            time_quantum=d.get("timeQuantum", ""),
+            keys=d.get("keys", False),
+            no_standard_view=d.get("noStandardView", False),
+        )
+
+
+@dataclass
+class BSIGroup:
+    """Reference bsiGroup (field.go:1561)."""
+
+    name: str
+    min: int = 0
+    max: int = 0
+    base: int = 0
+    bit_depth: int = 0
+
+    def bit_depth_min(self) -> int:
+        return self.base - (1 << self.bit_depth) + 1
+
+    def bit_depth_max(self) -> int:
+        return self.base + (1 << self.bit_depth) - 1
+
+    def base_value(self, op: str, value: int) -> tuple[int, bool]:
+        """(base-relative value, out_of_range) — reference baseValue
+        (field.go:1583), including the GT/LT clamp quirks."""
+        min_, max_ = self.bit_depth_min(), self.bit_depth_max()
+        base_value = 0
+        if op in (pql_ast.GT, pql_ast.GTE):
+            if value > max_:
+                return 0, True
+            elif value > min_:
+                base_value = value - self.base
+        elif op in (pql_ast.LT, pql_ast.LTE):
+            if value < min_:
+                return 0, True
+            elif value > max_:
+                base_value = max_ - self.base
+            else:
+                base_value = value - self.base
+        elif op in (pql_ast.EQ, pql_ast.NEQ):
+            if value < min_ or value > max_:
+                return 0, True
+            base_value = value - self.base
+        return base_value, False
+
+    def base_value_between(self, lo: int, hi: int) -> tuple[int, int, bool]:
+        min_, max_ = self.bit_depth_min(), self.bit_depth_max()
+        if hi < min_ or lo > max_:
+            return 0, 0, True
+        lo = max(lo, min_)
+        hi = min(hi, max_)
+        return lo - self.base, hi - self.base, False
+
+
+class Field:
+    """Typed view container (reference Field field.go:65)."""
+
+    def __init__(self, index: str, name: str, options: FieldOptions | None = None,
+                 stats=None, row_attr_store: AttrStore | None = None,
+                 translate_store: TranslateStore | None = None,
+                 fragment_listener=None, op_writer_factory=None):
+        # The internal existence field is the one reserved name allowed to
+        # bypass validation (reference index.go:336 createFieldIfNotExists).
+        if name != EXISTENCE_FIELD_NAME:
+            validate_name(name)
+        self.index = index
+        self.name = name
+        self.options = options or FieldOptions()
+        self._validate_options()
+        self.stats = stats
+        self.row_attr_store = row_attr_store or AttrStore()
+        self.translate_store = translate_store or TranslateStore()
+        self.fragment_listener = fragment_listener
+        self.op_writer_factory = op_writer_factory
+        self.views: dict[str, View] = {}
+        self._lock = threading.RLock()
+        #: shards known to hold data anywhere in the cluster
+        #: (reference remoteAvailableShards field.go:263).
+        self.remote_available_shards: set[int] = set()
+
+        self.bsi_group: BSIGroup | None = None
+        if self.options.type == FIELD_TYPE_INT:
+            base = self.options.base or bsi_base(self.options.min, self.options.max)
+            self.options.base = base
+            bd = self.options.bit_depth or max(
+                bit_depth_int(self.options.min - base),
+                bit_depth_int(self.options.max - base),
+            )
+            self.options.bit_depth = bd
+            self.bsi_group = BSIGroup(name=self.name, min=self.options.min,
+                                      max=self.options.max, base=base, bit_depth=bd)
+
+    def _validate_options(self):
+        o = self.options
+        if o.type not in (FIELD_TYPE_SET, FIELD_TYPE_INT, FIELD_TYPE_TIME,
+                          FIELD_TYPE_MUTEX, FIELD_TYPE_BOOL):
+            raise InvalidFieldTypeError(f"invalid field type: {o.type!r}")
+        if o.cache_type not in _VALID_CACHE_TYPES:
+            raise InvalidCacheTypeError(f"invalid cache type: {o.cache_type!r}")
+        if o.type == FIELD_TYPE_INT and o.min > o.max:
+            raise InvalidBSIGroupRangeError()
+        if o.type == FIELD_TYPE_TIME:
+            tq.validate_quantum(o.time_quantum)
+
+    # -- type helpers ------------------------------------------------------
+
+    @property
+    def field_type(self) -> str:
+        return self.options.type
+
+    @property
+    def keys(self) -> bool:
+        return self.options.keys
+
+    def uses_mutex(self) -> bool:
+        return self.options.type in (FIELD_TYPE_MUTEX, FIELD_TYPE_BOOL)
+
+    def time_quantum(self) -> str:
+        return self.options.time_quantum
+
+    # -- views -------------------------------------------------------------
+
+    def view(self, name: str) -> View | None:
+        return self.views.get(name)
+
+    def view_names(self) -> list[str]:
+        return sorted(self.views)
+
+    def create_view_if_not_exists(self, name: str) -> View:
+        with self._lock:
+            v = self.views.get(name)
+            if v is None:
+                v = View(self.index, self.name, name,
+                         cache_type=self.options.cache_type,
+                         cache_size=self.options.cache_size,
+                         mutex=self.uses_mutex(), stats=self.stats,
+                         fragment_listener=self.fragment_listener,
+                         op_writer_factory=self.op_writer_factory)
+                self.views[name] = v
+            return v
+
+    def available_shards(self) -> set[int]:
+        """Local fragments plus remote availability (field.go:263-358)."""
+        out = set(self.remote_available_shards)
+        for v in self.views.values():
+            out |= v.available_shards()
+        return out
+
+    def add_remote_available_shards(self, shards: Iterable[int]) -> None:
+        self.remote_available_shards |= set(shards)
+
+    # -- bit ops -----------------------------------------------------------
+
+    def set_bit(self, row_id: int, column_id: int,
+                timestamp: dt.datetime | None = None) -> bool:
+        """Fan the bit to standard + time views (reference SetBit :927)."""
+        changed = False
+        if not self.options.no_standard_view:
+            changed |= self.create_view_if_not_exists(VIEW_STANDARD).set_bit(
+                row_id, column_id)
+        if timestamp is not None:
+            q = self.time_quantum()
+            if not q:
+                raise ValueError("timestamp set on field without time quantum")
+            for name in tq.views_by_time(VIEW_STANDARD, timestamp, q):
+                changed |= self.create_view_if_not_exists(name).set_bit(
+                    row_id, column_id)
+        return changed
+
+    def clear_bit(self, row_id: int, column_id: int) -> bool:
+        """Clear from standard AND all time views (reference ClearBit
+        :967-1009 walks every view of the field)."""
+        changed = False
+        for name, v in list(self.views.items()):
+            if name == VIEW_STANDARD or is_time_view(name):
+                changed |= v.clear_bit(row_id, column_id)
+        return changed
+
+    def row(self, row_id: int) -> Row:
+        v = self.view(VIEW_STANDARD)
+        return v.row(row_id) if v else Row()
+
+    def row_time(self, row_id: int, t_from: dt.datetime | None,
+                 t_to: dt.datetime | None) -> Row:
+        """Union of time views covering [from, to) (executor Range/Row with
+        from/to, executor.go:1490-1528)."""
+        q = self.time_quantum()
+        if not q:
+            raise ValueError(f"field {self.name} has no time quantum")
+        if t_from is None or t_to is None:
+            # Open-ended bound: clamp to the data actually present so the
+            # view walk stays O(existing views), not O(calendar).
+            lo, hi = self._time_view_bounds()
+            if lo is None:
+                return Row()
+            t_from = t_from or lo
+            t_to = t_to or hi
+        start, end = t_from, t_to
+        out = Row()
+        for name in tq.views_by_time_range(VIEW_STANDARD, start, end, q):
+            v = self.view(name)
+            if v is not None:
+                out = out.union(v.row(row_id))
+        return out
+
+    def _time_view_bounds(self) -> tuple[dt.datetime | None, dt.datetime | None]:
+        """(earliest start, latest end) covered by existing time views."""
+        spans = []
+        for name in self.views:
+            if not is_time_view(name):
+                continue
+            stamp = name[len(VIEW_STANDARD) + 1:]
+            fmt, step = {
+                4: ("%Y", "y"), 6: ("%Y%m", "m"),
+                8: ("%Y%m%d", "d"), 10: ("%Y%m%d%H", "h"),
+            }.get(len(stamp), (None, None))
+            if fmt is None:
+                continue
+            try:
+                t0 = dt.datetime.strptime(stamp, fmt)
+            except ValueError:
+                continue
+            if step == "y":
+                t1 = t0.replace(year=t0.year + 1)
+            elif step == "m":
+                t1 = tq._add_month_norm(t0)
+            elif step == "d":
+                t1 = t0 + dt.timedelta(days=1)
+            else:
+                t1 = t0 + dt.timedelta(hours=1)
+            spans.append((t0, t1))
+        if not spans:
+            return None, None
+        return min(s for s, _ in spans), max(e for _, e in spans)
+
+    # -- BSI value ops -----------------------------------------------------
+
+    def _require_bsi(self) -> BSIGroup:
+        if self.bsi_group is None:
+            raise BSIGroupNotFoundError()
+        return self.bsi_group
+
+    def set_value(self, column_id: int, value: int) -> bool:
+        """Reference SetValue (field.go:1075): validate range, grow bit
+        depth, store base-relative sign-magnitude."""
+        bsig = self._require_bsi()
+        if value < bsig.min:
+            raise BSIGroupValueTooLowError()
+        if value > bsig.max:
+            raise BSIGroupValueTooHighError()
+        base_value = value - bsig.base
+        required = bit_depth_int(base_value)
+        if required > bsig.bit_depth:
+            bsig.bit_depth = required
+            self.options.bit_depth = required
+        v = self.create_view_if_not_exists(view_bsi_name(self.name))
+        return v.set_value(column_id, bsig.bit_depth, base_value)
+
+    def value(self, column_id: int) -> tuple[int, bool]:
+        bsig = self._require_bsi()
+        v = self.view(view_bsi_name(self.name))
+        if v is None:
+            return 0, False
+        val, exists = v.value(column_id, bsig.bit_depth)
+        if not exists:
+            return 0, False
+        return val + bsig.base, True
+
+    def sum(self, filter_row: Row | None = None) -> tuple[int, int]:
+        """(sum, count) — base-adjusted (field.go:1121)."""
+        bsig = self._require_bsi()
+        v = self.view(view_bsi_name(self.name))
+        if v is None:
+            return 0, 0
+        s, c = v.sum(filter_row, bsig.bit_depth)
+        return s + c * bsig.base, c
+
+    def min(self, filter_row: Row | None = None) -> tuple[int, int]:
+        bsig = self._require_bsi()
+        v = self.view(view_bsi_name(self.name))
+        if v is None:
+            return 0, 0
+        m, c = v.min(filter_row, bsig.bit_depth)
+        if c == 0:
+            return 0, 0
+        return m + bsig.base, c
+
+    def max(self, filter_row: Row | None = None) -> tuple[int, int]:
+        bsig = self._require_bsi()
+        v = self.view(view_bsi_name(self.name))
+        if v is None:
+            return 0, 0
+        m, c = v.max(filter_row, bsig.bit_depth)
+        if c == 0:
+            return 0, 0
+        return m + bsig.base, c
+
+    def range(self, op: str, predicate: int) -> Row:
+        """Comparison query over values (reference Field.Range :1178)."""
+        bsig = self._require_bsi()
+        if predicate < bsig.min or predicate > bsig.max:
+            # Out of configured range: reference returns nil row.
+            return Row()
+        v = self.view(view_bsi_name(self.name))
+        if v is None:
+            return Row()
+        base_value, out_of_range = bsig.base_value(op, predicate)
+        if out_of_range:
+            return Row()
+        return v.range_op(_op_name(op), bsig.bit_depth, base_value)
+
+    def range_between(self, pmin: int, pmax: int) -> Row:
+        bsig = self._require_bsi()
+        v = self.view(view_bsi_name(self.name))
+        if v is None:
+            return Row()
+        lo, hi, out_of_range = bsig.base_value_between(pmin, pmax)
+        if out_of_range:
+            return Row()
+        return v.range_between(bsig.bit_depth, lo, hi)
+
+    def not_null(self) -> Row:
+        """Columns with any value set (reference notNull via rangeOp)."""
+        v = self.view(view_bsi_name(self.name))
+        if v is None:
+            return Row()
+        out = Row()
+        for frag in v.fragments.values():
+            out = out.union(frag.not_null())
+        return out
+
+    # -- bulk import -------------------------------------------------------
+
+    def import_bits(self, row_ids, column_ids, timestamps=None,
+                    clear: bool = False) -> None:
+        """Reference Field.Import (field.go:1204): group bits by view and
+        shard, then bulk-import per fragment."""
+        row_ids = np.asarray(row_ids, dtype=np.uint64)
+        column_ids = np.asarray(column_ids, dtype=np.uint64)
+        if timestamps is None:
+            timestamps = [None] * len(row_ids)
+        data_by_view: dict[str, tuple[list, list]] = {}
+        q = self.time_quantum()
+        for rid, cid, ts in zip(row_ids.tolist(), column_ids.tolist(), timestamps):
+            names = []
+            if not self.options.no_standard_view:
+                names.append(VIEW_STANDARD)
+            if ts is not None:
+                if not q:
+                    raise ValueError("timestamps require a time quantum")
+                names.extend(tq.views_by_time(VIEW_STANDARD, ts, q))
+            for name in names:
+                rows, cols = data_by_view.setdefault(name, ([], []))
+                rows.append(rid)
+                cols.append(cid)
+        for name, (rows, cols) in data_by_view.items():
+            view = self.create_view_if_not_exists(name)
+            by_shard: dict[int, tuple[list, list]] = {}
+            for rid, cid in zip(rows, cols):
+                r, c = by_shard.setdefault(cid // SHARD_WIDTH, ([], []))
+                r.append(rid)
+                c.append(cid)
+            for shard, (r, c) in by_shard.items():
+                frag = view.create_fragment_if_not_exists(shard)
+                if self.uses_mutex() and not clear:
+                    frag.bulk_import_mutex(r, c)
+                else:
+                    frag.bulk_import(r, c, clear=clear)
+
+    def import_values(self, column_ids, values, clear: bool = False) -> None:
+        """Reference importValue (field.go:1285): validates range, grows
+        bit depth once for the batch."""
+        bsig = self._require_bsi()
+        if not clear:
+            lo, hi = min(values), max(values)
+            if lo < bsig.min:
+                raise BSIGroupValueTooLowError()
+            if hi > bsig.max:
+                raise BSIGroupValueTooHighError()
+            required = max(bit_depth_int(lo - bsig.base),
+                           bit_depth_int(hi - bsig.base))
+            if required > bsig.bit_depth:
+                bsig.bit_depth = required
+                self.options.bit_depth = required
+        view = self.create_view_if_not_exists(view_bsi_name(self.name))
+        by_shard: dict[int, tuple[list, list]] = {}
+        for cid, val in zip(column_ids, values):
+            c, v_ = by_shard.setdefault(int(cid) // SHARD_WIDTH, ([], []))
+            c.append(int(cid))
+            v_.append(int(val) - bsig.base)
+        for shard, (cids, vals) in by_shard.items():
+            frag = view.create_fragment_if_not_exists(shard)
+            frag.import_values(cids, vals, bsig.bit_depth, clear=clear)
+
+    # -- schema ------------------------------------------------------------
+
+    def info(self) -> dict:
+        return {"name": self.name, "options": self.options.to_json()}
+
+    def __repr__(self):
+        return f"Field({self.index}/{self.name} type={self.options.type})"
+
+
+def _op_name(op: str) -> str:
+    return {
+        pql_ast.EQ: "eq", pql_ast.NEQ: "neq",
+        pql_ast.LT: "lt", pql_ast.LTE: "lte",
+        pql_ast.GT: "gt", pql_ast.GTE: "gte",
+    }[op]

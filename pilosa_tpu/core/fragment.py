@@ -54,7 +54,8 @@ class Fragment:
 
     def __init__(self, index: str, field: str, view: str, shard: int,
                  cache_type: str = "ranked", cache_size: int = DEFAULT_CACHE_SIZE,
-                 stats=None, op_writer: Callable | None = None):
+                 stats=None, op_writer: Callable | None = None,
+                 mutex: bool = False):
         self.index = index
         self.field = field
         self.view = view
@@ -64,6 +65,9 @@ class Fragment:
         self.stats = stats
         #: WAL hook: called as op_writer(op, rows, cols) on mutation.
         self.op_writer = op_writer
+        #: Mutex semantics: at most one row bit per column (reference
+        #: mutexVector fragment.go:3094; bool fields use rows 0/1).
+        self.mutex = mutex
 
         self.rows: dict[int, HostRow] = {}
         self.generation = 0
@@ -92,6 +96,12 @@ class Fragment:
     def set_bit(self, row_id: int, column_id: int) -> bool:
         with self._lock:
             pos = self._local(column_id)
+            if self.mutex:
+                # Unset any other row's bit for this column first
+                # (reference handleMutex fragment.go:3094-3164).
+                existing = self.row_for_column(column_id)
+                if existing is not None and existing != row_id:
+                    self.clear_bit(existing, column_id)
             hr = self.rows.get(row_id)
             if hr is None:
                 hr = self.rows[row_id] = HostRow()
@@ -182,6 +192,8 @@ class Fragment:
         bulkImportMutex fragment.go:2108). Batched: one pass over existing
         rows to find steals, then grouped add/remove."""
         with self._lock:
+            if len(row_ids) != len(column_ids):
+                raise ValueError("row/column length mismatch")
             base = np.uint64(self.shard * SHARD_WIDTH)
             desired: dict[int, int] = {}
             for rid, cid in zip(row_ids, column_ids):

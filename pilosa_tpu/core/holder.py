@@ -1,0 +1,92 @@
+"""Holder — the root registry of all indexes on a node.
+
+Reference: holder.go (struct :50, Open :137, Schema/applySchema :284/:327,
+fragment accessor :496). Persistence (the data-dir walk, WAL, snapshots)
+lives in pilosa_tpu/storage/; the holder exposes hooks for it.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from pilosa_tpu.core.field import Field, FieldOptions
+from pilosa_tpu.core.fragment import Fragment
+from pilosa_tpu.core.index import Index, IndexOptions
+from pilosa_tpu.errors import IndexExistsError, IndexNotFoundError
+
+
+class Holder:
+    """Reference Holder (holder.go:50)."""
+
+    def __init__(self, stats=None, fragment_listener=None,
+                 op_writer_factory=None):
+        self.indexes: dict[str, Index] = {}
+        self.stats = stats
+        self.fragment_listener = fragment_listener
+        self.op_writer_factory = op_writer_factory
+        self._lock = threading.RLock()
+
+    # -- indexes -----------------------------------------------------------
+
+    def index(self, name: str) -> Index | None:
+        return self.indexes.get(name)
+
+    def index_or_raise(self, name: str) -> Index:
+        idx = self.indexes.get(name)
+        if idx is None:
+            raise IndexNotFoundError(f"index not found: {name!r}")
+        return idx
+
+    def create_index(self, name: str, options: IndexOptions | None = None) -> Index:
+        with self._lock:
+            if name in self.indexes:
+                raise IndexExistsError()
+            idx = Index(name, options, stats=self.stats,
+                        fragment_listener=self.fragment_listener,
+                        op_writer_factory=self.op_writer_factory)
+            self.indexes[name] = idx
+            return idx
+
+    def create_index_if_not_exists(self, name: str,
+                                   options: IndexOptions | None = None) -> Index:
+        with self._lock:
+            return self.indexes.get(name) or self.create_index(name, options)
+
+    def delete_index(self, name: str) -> None:
+        with self._lock:
+            if name not in self.indexes:
+                raise IndexNotFoundError()
+            del self.indexes[name]
+
+    # -- accessors (reference holder.go:496 fragment(i,f,v,shard)) ---------
+
+    def field(self, index: str, field: str) -> Field | None:
+        idx = self.index(index)
+        return idx.field(field) if idx else None
+
+    def fragment(self, index: str, field: str, view: str, shard: int) -> Fragment | None:
+        f = self.field(index, field)
+        if f is None:
+            return None
+        v = f.view(view)
+        return v.fragment(shard) if v else None
+
+    # -- schema (reference holder.go:284 Schema, :327 applySchema) ---------
+
+    def schema(self) -> list[dict]:
+        return [idx.info() for _, idx in sorted(self.indexes.items())]
+
+    def apply_schema(self, schema: list[dict]) -> None:
+        """Create any missing indexes/fields described by a schema dump."""
+        for idx_info in schema:
+            idx = self.create_index_if_not_exists(
+                idx_info["name"], IndexOptions.from_json(idx_info.get("options", {})))
+            for f_info in idx_info.get("fields", []):
+                idx.create_field_if_not_exists(
+                    f_info["name"], FieldOptions.from_json(f_info.get("options", {})))
+
+    def index_names(self) -> list[str]:
+        return sorted(self.indexes)
+
+    def __repr__(self):
+        return f"Holder(indexes={sorted(self.indexes)})"

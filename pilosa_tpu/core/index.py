@@ -1,0 +1,135 @@
+"""Index — a container of fields plus column metadata.
+
+Reference: index.go (struct :37, createField :416, DeleteField :471,
+AvailableShards union :292) and holder.go:46 (existence field ``_exists``
+backing Not()/existence semantics).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Iterable
+
+from pilosa_tpu.config import EXISTENCE_FIELD_NAME
+from pilosa_tpu.core.attrs import AttrStore
+from pilosa_tpu.core.field import Field, FieldOptions
+from pilosa_tpu.core.row import Row
+from pilosa_tpu.core.translate import TranslateStore
+from pilosa_tpu.errors import (
+    FieldExistsError,
+    FieldNotFoundError,
+    validate_name,
+)
+
+
+@dataclass
+class IndexOptions:
+    """Reference IndexOptions (index.go:910)."""
+
+    keys: bool = False
+    track_existence: bool = True
+
+    def to_json(self) -> dict:
+        return {"keys": self.keys, "trackExistence": self.track_existence}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "IndexOptions":
+        return cls(keys=d.get("keys", False),
+                   track_existence=d.get("trackExistence", True))
+
+
+class Index:
+    """Reference Index (index.go:37)."""
+
+    def __init__(self, name: str, options: IndexOptions | None = None,
+                 stats=None, fragment_listener=None, op_writer_factory=None):
+        validate_name(name)
+        self.name = name
+        self.options = options or IndexOptions()
+        self.stats = stats
+        self.fragment_listener = fragment_listener
+        self.op_writer_factory = op_writer_factory
+        self.fields: dict[str, Field] = {}
+        self.column_attr_store = AttrStore()
+        self.translate_store = TranslateStore()
+        self._lock = threading.RLock()
+        if self.options.track_existence:
+            self._create_existence_field()
+
+    # -- fields ------------------------------------------------------------
+
+    def field(self, name: str) -> Field | None:
+        return self.fields.get(name)
+
+    def existence_field(self) -> Field | None:
+        return self.fields.get(EXISTENCE_FIELD_NAME)
+
+    def public_fields(self) -> list[Field]:
+        return [f for n, f in sorted(self.fields.items())
+                if n != EXISTENCE_FIELD_NAME]
+
+    def _create_existence_field(self) -> Field:
+        f = Field(self.name, EXISTENCE_FIELD_NAME,
+                  FieldOptions(cache_type="none", cache_size=0),
+                  stats=self.stats, fragment_listener=self.fragment_listener,
+                  op_writer_factory=self.op_writer_factory)
+        self.fields[EXISTENCE_FIELD_NAME] = f
+        return f
+
+    def create_field(self, name: str, options: FieldOptions | None = None) -> Field:
+        with self._lock:
+            if name in self.fields:
+                raise FieldExistsError()
+            f = Field(self.name, name, options, stats=self.stats,
+                      fragment_listener=self.fragment_listener,
+                      op_writer_factory=self.op_writer_factory)
+            self.fields[name] = f
+            return f
+
+    def create_field_if_not_exists(self, name: str,
+                                   options: FieldOptions | None = None) -> Field:
+        with self._lock:
+            return self.fields.get(name) or self.create_field(name, options)
+
+    def delete_field(self, name: str) -> None:
+        with self._lock:
+            if name not in self.fields:
+                raise FieldNotFoundError()
+            del self.fields[name]
+
+    # -- existence ---------------------------------------------------------
+
+    def add_existence(self, column_ids: Iterable[int]) -> None:
+        """Mark columns existing (reference executeSet's existence write,
+        executor.go:2096)."""
+        ef = self.existence_field()
+        if ef is None:
+            return
+        cols = list(column_ids)
+        ef.import_bits([0] * len(cols), cols)
+
+    def existence_row(self) -> Row:
+        ef = self.existence_field()
+        return ef.row(0) if ef is not None else Row()
+
+    # -- shards ------------------------------------------------------------
+
+    def available_shards(self) -> set[int]:
+        """Union over fields (reference index.go:292)."""
+        out: set[int] = set()
+        for f in self.fields.values():
+            out |= f.available_shards()
+        return out or {0}
+
+    # -- schema ------------------------------------------------------------
+
+    def info(self) -> dict:
+        return {
+            "name": self.name,
+            "options": self.options.to_json(),
+            "fields": [f.info() for f in self.public_fields()],
+        }
+
+    def __repr__(self):
+        return f"Index({self.name} fields={sorted(self.fields)})"

@@ -1,0 +1,95 @@
+"""Key translation: string key ⇄ uint64 id, per index and per field.
+
+Reference: translate.go (TranslateStore :35, in-memory impl :220) and
+boltdb/translate.go:48 (sequence-allocated ids starting at 1, with a
+primary/replica streaming protocol handled at the cluster layer).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+from pilosa_tpu.errors import TranslateStoreReadOnlyError
+
+
+class TranslateStore:
+    """Monotonic id allocator with forward and reverse maps."""
+
+    def __init__(self, path: str | None = None, read_only: bool = False):
+        self.path = path
+        self.read_only = read_only
+        self._fwd: dict[str, int] = {}
+        self._rev: dict[int, str] = {}
+        self._next = 1  # ids start at 1 (boltdb/translate.go sequence)
+        self._lock = threading.RLock()
+        if path and os.path.exists(path):
+            self._load()
+
+    def translate_key(self, key: str, create: bool = True) -> int | None:
+        with self._lock:
+            id_ = self._fwd.get(key)
+            if id_ is not None:
+                return id_
+            if not create:
+                return None
+            if self.read_only:
+                raise TranslateStoreReadOnlyError()
+            id_ = self._next
+            self._next += 1
+            self._fwd[key] = id_
+            self._rev[id_] = key
+            return id_
+
+    def translate_keys(self, keys, create: bool = True) -> list[int | None]:
+        return [self.translate_key(k, create) for k in keys]
+
+    def translate_id(self, id_: int) -> str | None:
+        with self._lock:
+            return self._rev.get(id_)
+
+    def translate_ids(self, ids) -> list[str | None]:
+        return [self.translate_id(i) for i in ids]
+
+    def max_id(self) -> int:
+        with self._lock:
+            return self._next - 1
+
+    # -- replication feed (cluster layer streams entries id-ascending) -----
+
+    def entries_since(self, after_id: int) -> list[tuple[int, str]]:
+        with self._lock:
+            return sorted((i, k) for i, k in self._rev.items() if i > after_id)
+
+    def apply_entries(self, entries) -> None:
+        with self._lock:
+            for id_, key in entries:
+                self._fwd[key] = id_
+                self._rev[id_] = key
+                self._next = max(self._next, id_ + 1)
+
+    # -- persistence -------------------------------------------------------
+
+    def _load(self) -> None:
+        with open(self.path) as f:
+            for line in f:
+                if line.strip():
+                    id_, key = json.loads(line)
+                    self._fwd[key] = int(id_)
+                    self._rev[int(id_)] = key
+        if self._rev:
+            self._next = max(self._rev) + 1
+
+    def save(self) -> None:
+        if not self.path:
+            return
+        with self._lock:
+            tmp = self.path + ".tmp"
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            with open(tmp, "w") as f:
+                for id_ in sorted(self._rev):
+                    f.write(json.dumps([id_, self._rev[id_]]) + "\n")
+            os.replace(tmp, self.path)

@@ -1,0 +1,158 @@
+"""View — one slice of a field, holding fragments keyed by shard.
+
+Reference: view.go (names :36-44, CreateFragmentIfNotExists :263, setBit
+:367, setValue/sum/min/max/rangeOp :380-473). View names: ``standard``,
+``standard_YYYY[MM[DD[HH]]]`` time views, ``bsig_<field>`` BSI views.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterable
+
+from pilosa_tpu.config import DEFAULT_CACHE_SIZE, SHARD_WIDTH
+from pilosa_tpu.core.fragment import Fragment
+from pilosa_tpu.core.row import Row
+
+VIEW_STANDARD = "standard"
+VIEW_BSI_PREFIX = "bsig_"
+
+
+def view_bsi_name(field: str) -> str:
+    return VIEW_BSI_PREFIX + field
+
+
+def is_time_view(name: str) -> bool:
+    return name.startswith(VIEW_STANDARD + "_")
+
+
+class View:
+    """Container of per-shard fragments for one layout of one field."""
+
+    def __init__(self, index: str, field: str, name: str,
+                 cache_type: str = "ranked", cache_size: int = DEFAULT_CACHE_SIZE,
+                 mutex: bool = False, stats=None,
+                 fragment_listener: Callable | None = None,
+                 op_writer_factory: Callable | None = None):
+        self.index = index
+        self.field = field
+        self.name = name
+        self.cache_type = cache_type
+        self.cache_size = cache_size
+        self.mutex = mutex
+        self.stats = stats
+        #: called with (index, field, view, shard) when a fragment appears —
+        #: the hook the reference uses to broadcast CreateShardMessage
+        #: (view.go:263-304).
+        self.fragment_listener = fragment_listener
+        #: factory(index, field, view, shard) -> op_writer for WAL wiring.
+        self.op_writer_factory = op_writer_factory
+        self.fragments: dict[int, Fragment] = {}
+        self._lock = threading.RLock()
+
+    # -- fragments ---------------------------------------------------------
+
+    def fragment(self, shard: int) -> Fragment | None:
+        return self.fragments.get(shard)
+
+    def create_fragment_if_not_exists(self, shard: int) -> Fragment:
+        with self._lock:
+            frag = self.fragments.get(shard)
+            if frag is None:
+                op_writer = (self.op_writer_factory(self.index, self.field,
+                                                    self.name, shard)
+                             if self.op_writer_factory else None)
+                frag = Fragment(self.index, self.field, self.name, shard,
+                                cache_type=self.cache_type,
+                                cache_size=self.cache_size,
+                                stats=self.stats, op_writer=op_writer,
+                                mutex=self.mutex)
+                self.fragments[shard] = frag
+                if self.fragment_listener:
+                    self.fragment_listener(self.index, self.field, self.name, shard)
+            return frag
+
+    def available_shards(self) -> set[int]:
+        return set(self.fragments)
+
+    # -- bit ops -----------------------------------------------------------
+
+    def set_bit(self, row_id: int, column_id: int) -> bool:
+        frag = self.create_fragment_if_not_exists(column_id // SHARD_WIDTH)
+        return frag.set_bit(row_id, column_id)
+
+    def clear_bit(self, row_id: int, column_id: int) -> bool:
+        frag = self.fragment(column_id // SHARD_WIDTH)
+        return frag.clear_bit(row_id, column_id) if frag else False
+
+    def row(self, row_id: int, shards: Iterable[int] | None = None) -> Row:
+        """Cross-shard row for this view (used by the executor per shard
+        in the mapReduce path; whole-view reads for tests/tools)."""
+        wanted = set(shards) if shards is not None else None
+        segs = {}
+        for shard, frag in sorted(self.fragments.items()):
+            if wanted is not None and shard not in wanted:
+                continue
+            segs[shard] = frag.device_row(row_id)
+        return Row(segs)
+
+    # -- BSI ---------------------------------------------------------------
+
+    def set_value(self, column_id: int, bit_depth: int, value: int) -> bool:
+        frag = self.create_fragment_if_not_exists(column_id // SHARD_WIDTH)
+        return frag.set_value(column_id, bit_depth, value)
+
+    def value(self, column_id: int, bit_depth: int) -> tuple[int, bool]:
+        frag = self.fragment(column_id // SHARD_WIDTH)
+        if frag is None:
+            return 0, False
+        return frag.value(column_id, bit_depth)
+
+    def sum(self, filter_row: Row | None, bit_depth: int) -> tuple[int, int]:
+        total = cnt = 0
+        for frag in self.fragments.values():
+            s, c = frag.sum(filter_row, bit_depth)
+            total += s
+            cnt += c
+        return total, cnt
+
+    def min(self, filter_row: Row | None, bit_depth: int) -> tuple[int, int]:
+        best = None
+        cnt = 0
+        for frag in self.fragments.values():
+            v, c = frag.min(filter_row, bit_depth)
+            if c == 0:
+                continue
+            if best is None or v < best:
+                best, cnt = v, c
+            elif v == best:
+                cnt += c
+        return (best, cnt) if best is not None else (0, 0)
+
+    def max(self, filter_row: Row | None, bit_depth: int) -> tuple[int, int]:
+        best = None
+        cnt = 0
+        for frag in self.fragments.values():
+            v, c = frag.max(filter_row, bit_depth)
+            if c == 0:
+                continue
+            if best is None or v > best:
+                best, cnt = v, c
+            elif v == best:
+                cnt += c
+        return (best, cnt) if best is not None else (0, 0)
+
+    def range_op(self, op: str, bit_depth: int, predicate: int) -> Row:
+        out = Row()
+        for frag in self.fragments.values():
+            out = out.union(frag.range_op(op, bit_depth, predicate))
+        return out
+
+    def range_between(self, bit_depth: int, pmin: int, pmax: int) -> Row:
+        out = Row()
+        for frag in self.fragments.values():
+            out = out.union(frag.range_between(bit_depth, pmin, pmax))
+        return out
+
+    def __repr__(self):
+        return f"View({self.index}/{self.field}/{self.name} shards={sorted(self.fragments)})"

@@ -1,0 +1,105 @@
+"""Framework error types + name validation.
+
+Reference: pilosa.go (public Err* values :40-117, nameRegexp :119,
+validateName :155).
+"""
+
+from __future__ import annotations
+
+import re
+
+
+class PilosaError(Exception):
+    """Base class; .message matches the reference's error strings so HTTP
+    responses can be byte-compatible."""
+
+    message = "pilosa error"
+
+    def __init__(self, message: str | None = None):
+        super().__init__(message or self.message)
+
+
+class IndexNotFoundError(PilosaError):
+    message = "index not found"
+
+
+class IndexExistsError(PilosaError):
+    message = "index already exists"
+
+
+class FieldNotFoundError(PilosaError):
+    message = "field not found"
+
+
+class FieldExistsError(PilosaError):
+    message = "field already exists"
+
+
+class BSIGroupNotFoundError(PilosaError):
+    message = "bsigroup not found"
+
+
+class BSIGroupValueTooLowError(PilosaError):
+    message = "value too low for bsigroup"
+
+
+class BSIGroupValueTooHighError(PilosaError):
+    message = "value too high for bsigroup"
+
+
+class InvalidBSIGroupRangeError(PilosaError):
+    message = "invalid bsigroup range"
+
+
+class InvalidViewError(PilosaError):
+    message = "invalid view"
+
+
+class InvalidCacheTypeError(PilosaError):
+    message = "invalid cache type"
+
+
+class InvalidFieldTypeError(PilosaError):
+    message = "invalid field type"
+
+
+class InvalidTimeQuantumError(PilosaError):
+    message = "invalid time quantum"
+
+
+class NameError_(PilosaError):
+    message = "invalid name"
+
+
+class QueryError(PilosaError):
+    message = "invalid query"
+
+
+class TranslateStoreReadOnlyError(PilosaError):
+    message = "translate store could not find or create key, translate store read only"
+
+
+class NotImplementedError_(PilosaError):
+    message = "not implemented"
+
+
+class FragmentNotFoundError(PilosaError):
+    message = "fragment not found"
+
+
+class ShardOutOfBoundsError(PilosaError):
+    message = "shard out of bounds"
+
+
+class ClusterDoesNotOwnShardError(PilosaError):
+    message = "node does not own shard"
+
+
+# Reference: pilosa.go:119 — lowercase start, [a-z0-9_-], max 64 chars.
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_-]{0,63}$")
+
+
+def validate_name(name: str) -> None:
+    """Reference validateName (pilosa.go:155)."""
+    if not _NAME_RE.match(name):
+        raise NameError_(f"invalid index or field name: {name!r}")

@@ -22,6 +22,11 @@ class ParseError(Exception):
         self.pos = pos
 
 
+class SemanticError(ParseError):
+    """A definitive error (e.g. duplicate argument) that backtracking must
+    not swallow — PEG ordered choice only retries on *syntax* failure."""
+
+
 _IDENT_RE = re.compile(r"[A-Za-z][A-Za-z0-9]*")
 _FIELD_RE = re.compile(r"[A-Za-z][A-Za-z0-9_-]*")
 _RESERVED_RE = re.compile(r"_row|_col|_start|_end|_timestamp|_field")
@@ -129,6 +134,8 @@ class _Parser:
         if special is not None:
             try:
                 return special()
+            except SemanticError:
+                raise
             except ParseError:
                 self.pos = save + len(name)  # fall back to generic form
         return self._call_generic(name)
@@ -355,6 +362,8 @@ class _Parser:
             # Trailing comma before close is handled by caller.
             try:
                 self.arg(call)
+            except SemanticError:
+                raise
             except ParseError:
                 self.pos = save
                 break
@@ -421,7 +430,7 @@ class _Parser:
 
     def _set_arg(self, call: Call, key: str, value: Any):
         if key in call.args:
-            self.error(f"{DUPLICATE_ARG_ERROR}: {key}")
+            raise SemanticError(f"{DUPLICATE_ARG_ERROR}: {key}", self.pos)
         call.args[key] = value
 
     # - values -

@@ -1,0 +1,253 @@
+"""Data-model tests: time quantum, view, field, index, holder.
+
+Modeled on reference field_test.go / index_test.go / time_test.go cases.
+"""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core import timequantum as tq
+from pilosa_tpu.core.field import (
+    FIELD_TYPE_BOOL,
+    FIELD_TYPE_INT,
+    FIELD_TYPE_MUTEX,
+    FIELD_TYPE_TIME,
+    Field,
+    FieldOptions,
+    bit_depth_int,
+)
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.core.index import Index, IndexOptions
+from pilosa_tpu.core.row import Row
+from pilosa_tpu.errors import (
+    BSIGroupValueTooHighError,
+    BSIGroupValueTooLowError,
+    FieldExistsError,
+    NameError_,
+)
+from pilosa_tpu.pql import ast as pql_ast
+
+
+# -- time quantum ----------------------------------------------------------
+
+def test_views_by_time():
+    t = dt.datetime(2017, 3, 2, 15)
+    assert tq.views_by_time("standard", t, "YMDH") == [
+        "standard_2017", "standard_201703", "standard_20170302",
+        "standard_2017030215",
+    ]
+    assert tq.views_by_time("standard", t, "D") == ["standard_20170302"]
+
+
+def test_views_by_time_range_ymdh():
+    # Reference time_test.go TestViewsByTimeRange cases.
+    out = tq.views_by_time_range(
+        "std", dt.datetime(2016, 12, 30), dt.datetime(2017, 1, 3), "YMDH")
+    assert out == ["std_20161230", "std_20161231", "std_20170101", "std_20170102"]
+
+    out = tq.views_by_time_range(
+        "std", dt.datetime(2016, 1, 1), dt.datetime(2018, 1, 1), "YMDH")
+    assert out == ["std_2016", "std_2017"]
+
+    out = tq.views_by_time_range(
+        "std", dt.datetime(2016, 11, 30, 22), dt.datetime(2016, 12, 2, 2), "YMDH")
+    assert out == ["std_2016113022", "std_2016113023", "std_20161201",
+                   "std_2016120200", "std_2016120201"]
+
+
+def test_views_by_time_range_no_hour_quantum():
+    out = tq.views_by_time_range(
+        "std", dt.datetime(2016, 5, 10), dt.datetime(2016, 5, 12), "YMD")
+    assert out == ["std_20160510", "std_20160511"]
+
+
+def test_parse_time():
+    assert tq.parse_time("2017-01-02T03:04") == dt.datetime(2017, 1, 2, 3, 4)
+    with pytest.raises(ValueError):
+        tq.parse_time("bad")
+
+
+# -- field: set ------------------------------------------------------------
+
+def test_field_set_clear_bit():
+    f = Field("i", "f")
+    assert f.set_bit(1, 100)
+    assert not f.set_bit(1, 100)
+    assert sorted(f.row(1).columns().tolist()) == [100]
+    assert f.clear_bit(1, 100)
+    assert not f.clear_bit(1, 100)
+    assert f.row(1).columns().tolist() == []
+
+
+def test_field_name_validation():
+    with pytest.raises(NameError_):
+        Field("i", "UPPER")
+    with pytest.raises(NameError_):
+        Field("i", "9bad")
+    with pytest.raises(NameError_):
+        Field("i", "x" * 65)
+
+
+def test_field_time_views():
+    f = Field("i", "f", FieldOptions(type=FIELD_TYPE_TIME, time_quantum="YMD"))
+    f.set_bit(1, 10, timestamp=dt.datetime(2017, 3, 2))
+    assert set(f.view_names()) == {
+        "standard", "standard_2017", "standard_201703", "standard_20170302"}
+    got = f.row_time(1, dt.datetime(2017, 1, 1), dt.datetime(2018, 1, 1))
+    assert got.columns().tolist() == [10]
+    got = f.row_time(1, dt.datetime(2018, 1, 1), dt.datetime(2019, 1, 1))
+    assert got.columns().tolist() == []
+
+
+def test_field_mutex():
+    f = Field("i", "f", FieldOptions(type=FIELD_TYPE_MUTEX))
+    f.set_bit(1, 10)
+    f.set_bit(2, 10)  # steals the column from row 1
+    assert f.row(1).columns().tolist() == []
+    assert f.row(2).columns().tolist() == [10]
+
+
+def test_field_bool():
+    f = Field("i", "f", FieldOptions(type=FIELD_TYPE_BOOL))
+    f.set_bit(1, 5)   # true
+    f.set_bit(0, 5)   # -> false
+    assert f.row(1).columns().tolist() == []
+    assert f.row(0).columns().tolist() == [5]
+
+
+# -- field: int/BSI --------------------------------------------------------
+
+def test_bsi_base_and_depth():
+    f = Field("i", "f", FieldOptions(type=FIELD_TYPE_INT, min=10, max=1000))
+    assert f.bsi_group.base == 10
+    assert f.options.bit_depth == bit_depth_int(990)
+
+    f = Field("i", "f", FieldOptions(type=FIELD_TYPE_INT, min=-100, max=-10))
+    assert f.bsi_group.base == -10
+
+    f = Field("i", "f", FieldOptions(type=FIELD_TYPE_INT, min=-5, max=5))
+    assert f.bsi_group.base == 0
+
+
+def test_set_value_get_value():
+    f = Field("i", "f", FieldOptions(type=FIELD_TYPE_INT, min=-1000, max=1000))
+    assert f.set_value(1, 42)
+    assert f.set_value(2, -7)
+    assert f.set_value(3, 0)
+    assert f.value(1) == (42, True)
+    assert f.value(2) == (-7, True)
+    assert f.value(3) == (0, True)
+    assert f.value(99) == (0, False)
+    # overwrite
+    f.set_value(1, -42)
+    assert f.value(1) == (-42, True)
+
+
+def test_set_value_range_validation():
+    f = Field("i", "f", FieldOptions(type=FIELD_TYPE_INT, min=0, max=100))
+    with pytest.raises(BSIGroupValueTooLowError):
+        f.set_value(1, -1)
+    with pytest.raises(BSIGroupValueTooHighError):
+        f.set_value(1, 101)
+
+
+def test_sum_min_max():
+    f = Field("i", "f", FieldOptions(type=FIELD_TYPE_INT, min=-1000, max=1000))
+    vals = {1: 10, 2: -20, 3: 30, 5: 0}
+    for c, v in vals.items():
+        f.set_value(c, v)
+    s, c = f.sum()
+    assert (s, c) == (20, 4)
+    assert f.min() == (-20, 1)
+    assert f.max() == (30, 1)
+    filt = Row.from_columns([1, 2])
+    s, c = f.sum(filt)
+    assert (s, c) == (-10, 2)
+
+
+def test_field_range_queries():
+    f = Field("i", "f", FieldOptions(type=FIELD_TYPE_INT, min=-100, max=100))
+    for c, v in {1: 10, 2: -20, 3: 30, 4: 0}.items():
+        f.set_value(c, v)
+    assert f.range(pql_ast.GT, 5).columns().tolist() == [1, 3]
+    assert f.range(pql_ast.LT, 0).columns().tolist() == [2]
+    assert f.range(pql_ast.EQ, 30).columns().tolist() == [3]
+    assert f.range(pql_ast.NEQ, 30).columns().tolist() == [1, 2, 4]
+    assert f.range(pql_ast.LTE, 0).columns().tolist() == [2, 4]
+    assert f.range_between(-20, 10).columns().tolist() == [1, 2, 4]
+    assert f.not_null().columns().tolist() == [1, 2, 3, 4]
+
+
+def test_import_values_and_bits():
+    f = Field("i", "f", FieldOptions(type=FIELD_TYPE_INT, min=0, max=10**6))
+    cols = np.arange(0, 5000, 7, dtype=np.uint64)
+    vals = (cols * 3).astype(np.int64)
+    f.import_values(cols.tolist(), vals.tolist())
+    s, c = f.sum()
+    assert c == len(cols)
+    assert s == int(vals.sum())
+
+    g = Field("i", "g")
+    g.import_bits([1, 1, 2], [5, 9, 5])
+    assert g.row(1).columns().tolist() == [5, 9]
+    assert g.row(2).columns().tolist() == [5]
+
+
+# -- index / holder --------------------------------------------------------
+
+def test_index_create_field_and_existence():
+    idx = Index("i")
+    f = idx.create_field("f")
+    assert idx.field("f") is f
+    assert idx.existence_field() is not None
+    with pytest.raises(FieldExistsError):
+        idx.create_field("f")
+    idx.add_existence([1, 5])
+    assert idx.existence_row().columns().tolist() == [1, 5]
+    # _exists is hidden from public listing
+    assert [x.name for x in idx.public_fields()] == ["f"]
+
+
+def test_index_no_existence_tracking():
+    idx = Index("i", IndexOptions(track_existence=False))
+    assert idx.existence_field() is None
+
+
+def test_holder_schema_roundtrip():
+    h = Holder()
+    idx = h.create_index("myindex", IndexOptions(keys=False))
+    idx.create_field("fset")
+    idx.create_field("fint", FieldOptions(type=FIELD_TYPE_INT, min=0, max=100))
+    idx.create_field("ftime", FieldOptions(type=FIELD_TYPE_TIME, time_quantum="YMD"))
+
+    schema = h.schema()
+    h2 = Holder()
+    h2.apply_schema(schema)
+    assert h2.schema() == schema
+    assert h2.field("myindex", "fint").options.type == FIELD_TYPE_INT
+
+
+def test_holder_fragment_accessor():
+    h = Holder()
+    idx = h.create_index("i")
+    f = idx.create_field("f")
+    f.set_bit(3, 42)
+    frag = h.fragment("i", "f", "standard", 0)
+    assert frag is not None
+    assert frag.contains(3, 42)
+    assert h.fragment("i", "f", "standard", 9) is None
+    assert h.fragment("i", "nope", "standard", 0) is None
+
+
+def test_available_shards():
+    from pilosa_tpu.config import SHARD_WIDTH
+    idx = Index("i")
+    f = idx.create_field("f")
+    f.set_bit(0, 1)
+    f.set_bit(0, SHARD_WIDTH * 3 + 5)
+    assert f.available_shards() == {0, 3}
+    assert idx.available_shards() == {0, 3}
+    f.add_remote_available_shards([7])
+    assert f.available_shards() == {0, 3, 7}
