@@ -410,18 +410,58 @@ class Fragment:
         return pairs
 
     def rows_list(self, start_row: int = 0, column: int | None = None,
-                  limit: int | None = None) -> list[int]:
+                  limit: int | None = None,
+                  among: Iterable[int] | None = None) -> list[int]:
         """Row IDs present, from start_row, optionally only rows with a bit
-        in `column` (reference rows + filters fragment.go:2618-2724)."""
-        if column is not None:
-            pos = self._local(column)
-            out = [r for r in sorted(self.rows)
-                   if r >= start_row and self.rows[r].contains(pos)]
-        else:
-            out = [r for r in sorted(self.rows) if r >= start_row and self.rows[r].n > 0]
-        if limit is not None:
-            out = out[:limit]
+        in `column` and/or restricted to the `among` set (reference rows +
+        rowFilters fragment.go:2618-2724)."""
+        allowed = set(among) if among is not None else None
+        out = []
+        for r in sorted(self.rows):
+            if r < start_row or self.rows[r].n == 0:
+                continue
+            if allowed is not None and r not in allowed:
+                continue
+            if column is not None and not self.rows[r].contains(self._local(column)):
+                continue
+            out.append(r)
+            if limit is not None and len(out) >= limit:
+                break
         return out
+
+    def _filtered_row_counts(self, filter_row: Row | None) -> tuple[list[int], np.ndarray]:
+        """(row_ids, counts[∩ filter]) — one batched device call when a
+        filter is present, host counters otherwise."""
+        ids = self.rows_list()
+        if not ids:
+            return ids, np.empty(0, dtype=np.int64)
+        if filter_row is None:
+            return ids, np.asarray([self.rows[r].count() for r in ids],
+                                   dtype=np.int64)
+        seg = filter_row.segment(self.shard)
+        if seg is None:
+            return ids, np.zeros(len(ids), dtype=np.int64)
+        stack = self.device_stack(tuple(ids))
+        return ids, np.asarray(pallas_kernels.pair_count(stack, seg, "and"),
+                               dtype=np.int64)
+
+    def min_row(self, filter_row: Row | None = None) -> tuple[int, int]:
+        """(min row id with any bit [∩ filter], its count) or (0, 0)
+        (reference minRow fragment.go:1232)."""
+        ids, counts = self._filtered_row_counts(filter_row)
+        for rid, cnt in zip(ids, counts.tolist()):
+            if cnt > 0:
+                return rid, int(cnt)
+        return 0, 0
+
+    def max_row(self, filter_row: Row | None = None) -> tuple[int, int]:
+        """(max row id with any bit [∩ filter], its count) or (0, 0)
+        (reference maxRow fragment.go:1253)."""
+        ids, counts = self._filtered_row_counts(filter_row)
+        for rid, cnt in zip(reversed(ids), reversed(counts.tolist())):
+            if cnt > 0:
+                return rid, int(cnt)
+        return 0, 0
 
     # -- anti-entropy checksums -------------------------------------------
 

@@ -1,0 +1,23 @@
+"""Query execution: PQL call-tree interpreter over the data model.
+
+Reference: executor.go (dispatch :293-338, per-shard map fns :659-1786,
+mapReduce :2455). The TPU twist: per-shard bitmap math is device-resident
+and the shard loop is pluggable — the single-node path loops shards with
+on-device kernels; the mesh path (pilosa_tpu.parallel) batches all shards
+into stacked blocks under shard_map.
+"""
+
+from pilosa_tpu.exec.executor import ExecOptions, Executor
+from pilosa_tpu.exec.result import (
+    GroupCount,
+    Pair,
+    RowIdentifiers,
+    SignedRow,
+    ValCount,
+    result_to_json,
+)
+
+__all__ = [
+    "ExecOptions", "Executor", "GroupCount", "Pair", "RowIdentifiers",
+    "SignedRow", "ValCount", "result_to_json",
+]

@@ -1,0 +1,1001 @@
+"""The PQL executor: recursive call-tree interpreter with per-shard map
+functions and a pluggable map/reduce spine.
+
+Reference: executor.go — dispatch (:293-338), bitmap calls (:659-676,
+:1441-1786), aggregates (:406-857), TopN two-pass (:857-999), Rows
+(:1272-1441), GroupBy (:1069-1272, iterator :3058-3231), writes
+(:1823-2330), Options (:360), mapReduce (:2455), key translation
+(:2610-2905).
+
+TPU-first departures (same semantics, different math):
+- TopN is exact: per-shard batched intersection counts on device
+  (`pair_count` over a row stack) instead of the reference's
+  threshold-gated rank cache walk.
+- GroupBy batches the innermost field's rows into one device call per
+  accumulated prefix instead of per-row roaring intersections.
+- The shard loop is a seam: `map_reduce` runs shards locally here; the
+  cluster layer substitutes node fan-out, and the mesh planner
+  (pilosa_tpu.parallel) substitutes stacked shard_map execution.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field, replace
+from typing import Any, Callable, Iterable
+
+import numpy as np
+
+from pilosa_tpu.config import SHARD_WIDTH
+from pilosa_tpu.core import timequantum as tq
+from pilosa_tpu.core.field import FIELD_TYPE_BOOL, FIELD_TYPE_INT, FIELD_TYPE_TIME
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.core.index import Index
+from pilosa_tpu.core.row import Row
+from pilosa_tpu.core.view import VIEW_STANDARD, view_bsi_name
+from pilosa_tpu.errors import (
+    BSIGroupNotFoundError,
+    FieldNotFoundError,
+    IndexNotFoundError,
+    QueryError,
+)
+from pilosa_tpu.exec.result import (
+    FieldRow,
+    GroupCount,
+    Pair,
+    RowIdentifiers,
+    ValCount,
+    merge_group_counts,
+    merge_pairs,
+    merge_row_ids,
+    sort_pairs,
+)
+from pilosa_tpu.ops import pallas_kernels
+from pilosa_tpu.pql import BETWEEN, NEQ, Call, Condition, Query, parse
+from pilosa_tpu.pql import ast as pql_ast
+
+_MAXINT = (1 << 63) - 1
+
+#: reference defaultMinThreshold (executor.go:90).
+DEFAULT_MIN_THRESHOLD = 1
+
+_BITMAP_CALLS = frozenset(
+    {"Row", "Range", "Difference", "Intersect", "Union", "Xor", "Not", "Shift"})
+
+
+@dataclass
+class ExecOptions:
+    """Reference execOptions (executor.go:62)."""
+
+    remote: bool = False
+    exclude_row_attrs: bool = False
+    exclude_columns: bool = False
+    column_attrs: bool = False
+    shards: list[int] | None = None
+
+
+class Executor:
+    """Reference executor (executor.go:72)."""
+
+    def __init__(self, holder: Holder, cluster=None, node_id: str | None = None):
+        self.holder = holder
+        #: cluster hooks (pilosa_tpu.cluster); None = standalone node.
+        self.cluster = cluster
+        self.node_id = node_id
+
+    # ------------------------------------------------------------------
+    # entry
+    # ------------------------------------------------------------------
+
+    def execute(self, index_name: str, query: Query | str,
+                shards: Iterable[int] | None = None,
+                opt: ExecOptions | None = None) -> list[Any]:
+        """Reference executor.Execute (executor.go:113)."""
+        if isinstance(query, str):
+            query = parse(query)
+        opt = opt or ExecOptions()
+        idx = self.holder.index(index_name)
+        if idx is None:
+            raise IndexNotFoundError(f"index not found: {index_name!r}")
+        needs_shards = any(c.name not in ("Set", "Clear", "SetRowAttrs",
+                                          "SetColumnAttrs")
+                           for c in query.calls)
+        if shards is None and needs_shards:
+            shards = sorted(idx.available_shards())
+        shards = list(shards) if shards is not None else []
+
+        results = []
+        for call in query.calls:
+            call = self._translate_call(idx, call)
+            results.append(self._execute_call(idx, call, shards, opt))
+        return [self._translate_result(idx, c, r)
+                for c, r in zip(query.calls, results)]
+
+    # ------------------------------------------------------------------
+    # dispatch (reference executor.go:293-338)
+    # ------------------------------------------------------------------
+
+    def _execute_call(self, idx: Index, c: Call, shards: list[int],
+                      opt: ExecOptions) -> Any:
+        name = c.name
+        if name == "Sum":
+            return self._execute_sum(idx, c, shards, opt)
+        if name == "Min":
+            return self._execute_min_max(idx, c, shards, opt, is_min=True)
+        if name == "Max":
+            return self._execute_min_max(idx, c, shards, opt, is_min=False)
+        if name == "MinRow":
+            return self._execute_min_max_row(idx, c, shards, opt, is_min=True)
+        if name == "MaxRow":
+            return self._execute_min_max_row(idx, c, shards, opt, is_min=False)
+        if name == "Clear":
+            return self._execute_clear_bit(idx, c, opt)
+        if name == "ClearRow":
+            return self._execute_clear_row(idx, c, shards, opt)
+        if name == "Store":
+            return self._execute_store(idx, c, shards, opt)
+        if name == "Count":
+            return self._execute_count(idx, c, shards, opt)
+        if name == "Set":
+            return self._execute_set(idx, c, opt)
+        if name == "SetRowAttrs":
+            self._execute_set_row_attrs(idx, c, opt)
+            return None
+        if name == "SetColumnAttrs":
+            self._execute_set_column_attrs(idx, c, opt)
+            return None
+        if name == "TopN":
+            return self._execute_top_n(idx, c, shards, opt)
+        if name == "Rows":
+            return self._execute_rows(idx, c, shards, opt)
+        if name == "GroupBy":
+            return self._execute_group_by(idx, c, shards, opt)
+        if name == "Options":
+            return self._execute_options(idx, c, shards, opt)
+        if name in _BITMAP_CALLS:
+            return self._execute_bitmap_call(idx, c, shards, opt)
+        raise QueryError(f"unknown call: {name}")
+
+    # ------------------------------------------------------------------
+    # map/reduce spine (reference mapReduce executor.go:2455)
+    # ------------------------------------------------------------------
+
+    def map_reduce(self, idx: Index, shards: list[int], c: Call,
+                   opt: ExecOptions, map_fn: Callable[[int], Any],
+                   reduce_fn: Callable[[Any, Any], Any]) -> Any:
+        """Single-node spine: apply map_fn per shard, fold with reduce_fn.
+        The cluster layer overrides shard→node grouping + remote exec."""
+        if self.cluster is not None and not opt.remote:
+            return self.cluster.map_reduce(self, idx, shards, c, opt,
+                                           map_fn, reduce_fn)
+        acc = None
+        for shard in shards:
+            acc = reduce_fn(acc, map_fn(shard))
+        return acc
+
+    # ------------------------------------------------------------------
+    # bitmap calls
+    # ------------------------------------------------------------------
+
+    def _execute_bitmap_call(self, idx: Index, c: Call, shards: list[int],
+                             opt: ExecOptions) -> Row:
+        def map_fn(shard):
+            return self._bitmap_call_shard(idx, c, shard)
+
+        def reduce_fn(prev, v):
+            if prev is None:
+                return v
+            return prev.union(v)  # segments are disjoint by shard
+
+        row = self.map_reduce(idx, shards, c, opt, map_fn, reduce_fn) or Row()
+
+        # Attach row attributes for plain Row() (executor.go:604-639).
+        if c.name == "Row" and not c.has_condition_arg():
+            if opt.exclude_row_attrs:
+                row.attrs = {}
+            else:
+                try:
+                    field_name = c.field_arg()
+                    f = idx.field(field_name)
+                    row_id, ok = c.uint_arg(field_name)
+                    if f is not None and ok:
+                        row.attrs = f.row_attr_store.attrs(row_id)
+                except ValueError:
+                    pass
+        if opt.exclude_columns:
+            row.segments = {}
+        return row
+
+    def _bitmap_call_shard(self, idx: Index, c: Call, shard: int) -> Row:
+        """Reference executeBitmapCallShard (executor.go:659)."""
+        name = c.name
+        if name in ("Row", "Range"):
+            return self._row_shard(idx, c, shard)
+        if name == "Difference":
+            return self._nary_shard(idx, c, shard, "difference")
+        if name == "Intersect":
+            return self._nary_shard(idx, c, shard, "intersect")
+        if name == "Union":
+            return self._nary_shard(idx, c, shard, "union")
+        if name == "Xor":
+            return self._nary_shard(idx, c, shard, "xor")
+        if name == "Not":
+            return self._not_shard(idx, c, shard)
+        if name == "Shift":
+            return self._shift_shard(idx, c, shard)
+        raise QueryError(f"unknown call: {name}")
+
+    def _nary_shard(self, idx: Index, c: Call, shard: int, op: str) -> Row:
+        if not c.children:
+            raise QueryError(f"empty {c.name} query is currently not supported")
+        rows = [self._bitmap_call_shard(idx, ch, shard) for ch in c.children]
+        acc = rows[0]
+        for r in rows[1:]:
+            acc = getattr(acc, op)(r)
+        return acc
+
+    def _not_shard(self, idx: Index, c: Call, shard: int) -> Row:
+        if len(c.children) != 1:
+            raise QueryError("Not() requires a single row input")
+        if idx.existence_field() is None:
+            raise QueryError(
+                f"index does not support existence tracking: {idx.name}")
+        frag = self.holder.fragment(idx.name, idx.existence_field().name,
+                                    VIEW_STANDARD, shard)
+        existence = frag.row(0) if frag else Row()
+        row = self._bitmap_call_shard(idx, c.children[0], shard)
+        return existence.difference(row)
+
+    def _shift_shard(self, idx: Index, c: Call, shard: int) -> Row:
+        n, _ = c.int_arg("n")
+        if len(c.children) != 1:
+            raise QueryError("Shift() requires a single row input")
+        row = self._bitmap_call_shard(idx, c.children[0], shard)
+        return row.shift(n)
+
+    def _row_shard(self, idx: Index, c: Call, shard: int) -> Row:
+        """Reference executeRowShard (executor.go:1441)."""
+        if c.has_condition_arg():
+            return self._row_bsi_shard(idx, c, shard)
+
+        field_name = c.field_arg()
+        f = idx.field(field_name)
+        if f is None:
+            raise FieldNotFoundError(f"field not found: {field_name!r}")
+        row_val = c.args.get(field_name)
+        if isinstance(row_val, bool):  # bool field sugar: f=true / f=false
+            row_id = 1 if row_val else 0
+        else:
+            row_id, ok = c.uint_arg(field_name)
+            if not ok:
+                raise QueryError("Row() must specify row")
+
+        from_time = to_time = None
+        if "from" in c.args:
+            from_time = tq.parse_time(c.args["from"])
+        if "to" in c.args:
+            to_time = tq.parse_time(c.args["to"])
+
+        if c.name == "Row" and from_time is None and to_time is None:
+            frag = self.holder.fragment(idx.name, field_name, VIEW_STANDARD, shard)
+            return frag.row(row_id) if frag else Row()
+
+        q = f.time_quantum()
+        if not q:
+            return Row()
+        if to_time is None:
+            import datetime as dt
+            to_time = dt.datetime.now() + dt.timedelta(days=1)
+        if from_time is None:
+            import datetime as dt
+            from_time = dt.datetime.min.replace(year=1)
+        out = Row()
+        for view_name in tq.views_by_time_range(VIEW_STANDARD, from_time,
+                                                to_time, q):
+            frag = self.holder.fragment(idx.name, field_name, view_name, shard)
+            if frag is not None:
+                out = out.union(frag.row(row_id))
+        return out
+
+    def _row_bsi_shard(self, idx: Index, c: Call, shard: int) -> Row:
+        """Reference executeRowBSIGroupShard (executor.go:1536)."""
+        if len(c.args) == 0:
+            raise QueryError("Row(): condition required")
+        if len(c.args) > 1:
+            raise QueryError("Row(): too many arguments")
+        (field_name, cond), = c.args.items()
+        if not isinstance(cond, Condition):
+            raise QueryError(f"Row(): expected condition argument")
+        f = idx.field(field_name)
+        if f is None:
+            raise FieldNotFoundError(f"field not found: {field_name!r}")
+        bsig = f.bsi_group
+        if bsig is None:
+            raise BSIGroupNotFoundError()
+        frag = self.holder.fragment(idx.name, field_name,
+                                    view_bsi_name(field_name), shard)
+
+        # `!= null` → not-null.
+        if cond.op == NEQ and cond.value is None:
+            return frag.not_null() if frag else Row()
+
+        if cond.op == BETWEEN:
+            predicates = cond.int_slice_value()
+            if len(predicates) != 2:
+                raise QueryError(
+                    "Row(): BETWEEN condition requires exactly two integer values")
+            lo, hi, out_of_range = bsig.base_value_between(*predicates)
+            if out_of_range or frag is None:
+                return Row()
+            if predicates[0] <= bsig.min and predicates[1] >= bsig.max:
+                return frag.not_null()
+            return frag.range_between(bsig.bit_depth, lo, hi)
+
+        if not isinstance(cond.value, int) or isinstance(cond.value, bool):
+            raise QueryError("Row(): conditions only support integer values")
+        value = cond.value
+        base_value, out_of_range = bsig.base_value(cond.op, value)
+        if out_of_range and cond.op != NEQ:
+            return Row()
+        if frag is None:
+            return Row()
+        # Fully-encompassing LT/GT → all not-null (executor.go:1648-1652).
+        if ((cond.op == pql_ast.LT and value > bsig.max)
+                or (cond.op == pql_ast.LTE and value >= bsig.max)
+                or (cond.op == pql_ast.GT and value < bsig.min)
+                or (cond.op == pql_ast.GTE and value <= bsig.min)):
+            return frag.not_null()
+        if out_of_range and cond.op == NEQ:
+            return frag.not_null()
+        from pilosa_tpu.core.field import _op_name
+        return frag.range_op(_op_name(cond.op), bsig.bit_depth, base_value)
+
+    # ------------------------------------------------------------------
+    # aggregates
+    # ------------------------------------------------------------------
+
+    def _agg_filter(self, idx: Index, c: Call, shard: int) -> Row | None:
+        if len(c.children) > 1:
+            raise QueryError(f"{c.name}() only accepts a single bitmap input")
+        if len(c.children) == 1:
+            return self._bitmap_call_shard(idx, c.children[0], shard)
+        return None
+
+    def _bsi_fragment(self, idx: Index, field_name: str, shard: int):
+        f = idx.field(field_name)
+        if f is None or f.bsi_group is None:
+            return None, None
+        frag = self.holder.fragment(idx.name, field_name,
+                                    view_bsi_name(field_name), shard)
+        return f, frag
+
+    def _execute_sum(self, idx: Index, c: Call, shards, opt) -> ValCount:
+        field_name, ok = c.string_arg("field")
+        if not ok:
+            raise QueryError("Sum(): field required")
+
+        def map_fn(shard):
+            f, frag = self._bsi_fragment(idx, field_name, shard)
+            if frag is None:
+                return ValCount()
+            filt = self._agg_filter(idx, c, shard)
+            s, cnt = frag.sum(filt, f.bsi_group.bit_depth)
+            return ValCount(s + cnt * f.bsi_group.base, cnt)
+
+        result = self.map_reduce(idx, shards, c, opt, map_fn,
+                                 lambda p, v: v if p is None else p.add(v))
+        result = result or ValCount()
+        return ValCount() if result.count == 0 else result
+
+    def _execute_min_max(self, idx: Index, c: Call, shards, opt,
+                         is_min: bool) -> ValCount:
+        field_name, ok = c.string_arg("field")
+        if not ok:
+            raise QueryError(f"{c.name}(): field required")
+
+        def map_fn(shard):
+            f, frag = self._bsi_fragment(idx, field_name, shard)
+            if frag is None:
+                return ValCount()
+            filt = self._agg_filter(idx, c, shard)
+            if is_min:
+                v, cnt = frag.min(filt, f.bsi_group.bit_depth)
+            else:
+                v, cnt = frag.max(filt, f.bsi_group.bit_depth)
+            if cnt == 0:
+                return ValCount()
+            return ValCount(v + f.bsi_group.base, cnt)
+
+        def reduce_fn(p, v):
+            if p is None:
+                return v
+            return p.smaller(v) if is_min else p.larger(v)
+
+        result = self.map_reduce(idx, shards, c, opt, map_fn, reduce_fn) or ValCount()
+        return ValCount() if result.count == 0 else result
+
+    def _execute_min_max_row(self, idx: Index, c: Call, shards, opt,
+                             is_min: bool) -> Pair:
+        field_name, ok = c.string_arg("field")
+        if not ok:
+            raise QueryError(f"{c.name}(): field required")
+
+        def map_fn(shard):
+            f = idx.field(field_name)
+            if f is None:
+                return Pair()
+            frag = self.holder.fragment(idx.name, field_name, VIEW_STANDARD, shard)
+            if frag is None:
+                return Pair()
+            filt = self._agg_filter(idx, c, shard)
+            rid, cnt = frag.min_row(filt) if is_min else frag.max_row(filt)
+            return Pair(id=rid, count=cnt)
+
+        def reduce_fn(p, v):
+            if p is None or p.count == 0:
+                return v
+            if v.count == 0:
+                return p
+            if (v.id < p.id) == is_min and v.id != p.id:
+                return v
+            return p
+
+        return self.map_reduce(idx, shards, c, opt, map_fn, reduce_fn) or Pair()
+
+    def _execute_count(self, idx: Index, c: Call, shards, opt) -> int:
+        if len(c.children) != 1:
+            raise QueryError("Count() requires a single bitmap input")
+
+        def map_fn(shard):
+            return self._bitmap_call_shard(idx, c.children[0], shard).count()
+
+        return self.map_reduce(idx, shards, c, opt, map_fn,
+                               lambda p, v: (p or 0) + v) or 0
+
+    # ------------------------------------------------------------------
+    # TopN (reference executor.go:857 two-pass)
+    # ------------------------------------------------------------------
+
+    def _execute_top_n(self, idx: Index, c: Call, shards, opt) -> list[Pair]:
+        ids_arg, _ = c.uint_slice_arg("ids")
+        n, _ = c.uint_arg("n")
+
+        pairs = self._top_n_shards(idx, c, shards, opt)
+        if not pairs or ids_arg or opt.remote:
+            return pairs
+
+        # Pass 2: exact counts for the merged candidate ids.
+        other = c.clone()
+        other.args["ids"] = sorted(p.id for p in pairs)
+        trimmed = self._top_n_shards(idx, other, shards, opt)
+        if n and n < len(trimmed):
+            trimmed = trimmed[:n]
+        return trimmed
+
+    def _top_n_shards(self, idx: Index, c: Call, shards, opt) -> list[Pair]:
+        def reduce_fn(p, v):
+            return merge_pairs(p or [], v)
+
+        merged = self.map_reduce(
+            idx, shards, c, opt,
+            lambda shard: self._top_n_shard(idx, c, shard), reduce_fn) or []
+        return sort_pairs(merged)
+
+    def _top_n_shard(self, idx: Index, c: Call, shard: int) -> list[Pair]:
+        """Exact per-shard TopN: device-batched intersection counts over the
+        full row stack (replaces the reference's rank-cache walk,
+        fragment.go:1570 — exact, no threshold staleness)."""
+        field_name = c.args.get("_field")
+        n, _ = c.uint_arg("n")
+        f = idx.field(field_name) if field_name else None
+        if f is not None and f.field_type == FIELD_TYPE_INT:
+            raise QueryError(f"cannot compute TopN() on integer field: {field_name!r}")
+
+        attr_name = c.args.get("attrName")
+        row_ids, has_ids = c.uint_slice_arg("ids")
+        min_threshold, _ = c.uint_arg("threshold")
+        attr_values = c.args.get("attrValues")
+        tanimoto, _ = c.uint_arg("tanimotoThreshold")
+        if tanimoto > 100:
+            raise QueryError("Tanimoto Threshold is from 1 to 100 only")
+
+        src: Row | None = None
+        if len(c.children) == 1:
+            src = self._bitmap_call_shard(idx, c.children[0], shard)
+        elif len(c.children) > 1:
+            raise QueryError("TopN() can only have one input bitmap")
+
+        frag = self.holder.fragment(idx.name, field_name, VIEW_STANDARD, shard)
+        if frag is None:
+            return []
+        if frag.cache_type == "none":
+            raise QueryError(f'cannot compute TopN(), field has no cache: "{field_name}"')
+        if min_threshold == 0:
+            min_threshold = DEFAULT_MIN_THRESHOLD
+
+        if has_ids:
+            n = 0  # explicit ids: no truncation (fragment.go:1575)
+
+        # Exact batched counts via the shared fragment kernel path; then
+        # layer the threshold/tanimoto/attr-filter predicates on top.
+        raw = frag.top(n=0, src=src,
+                       row_ids=[int(r) for r in row_ids] if has_ids else None)
+
+        src_count = src.count() if (src is not None and tanimoto > 0) else 0
+        allowed_attrs = set(attr_values) if (attr_name and attr_values) else None
+
+        pairs = []
+        for rid, cnt in raw:
+            if tanimoto > 0:
+                import math
+                base = frag.rows[rid].count() if rid in frag.rows else 0
+                t = math.ceil(cnt * 100 / (base + src_count - cnt))
+                if t <= tanimoto:
+                    continue
+            elif cnt < min_threshold:
+                continue
+            if allowed_attrs is not None:
+                attrs = f.row_attr_store.attrs(rid) if f else {}
+                if attrs.get(attr_name) not in allowed_attrs:
+                    continue
+            pairs.append(Pair(id=rid, count=cnt))
+        if n:
+            pairs = pairs[:n]
+        return pairs
+
+    # ------------------------------------------------------------------
+    # Rows (reference executor.go:1272)
+    # ------------------------------------------------------------------
+
+    def _execute_rows(self, idx: Index, c: Call, shards, opt) -> RowIdentifiers:
+        field_name = c.args.get("field") if isinstance(c.args.get("field"), str) \
+            else c.args.get("_field")
+        if not isinstance(field_name, str):
+            raise QueryError("Rows() field required")
+        column, has_col = c.uint_arg("column")
+        if has_col:
+            shards = [column // SHARD_WIDTH]
+        limit, has_limit = c.uint_arg("limit")
+        limit = limit if has_limit else _MAXINT
+
+        def map_fn(shard):
+            return self._rows_shard(idx, field_name, c, shard)
+
+        def reduce_fn(p, v):
+            return merge_row_ids(p or [], v, limit)
+
+        rows = self.map_reduce(idx, shards, c, opt, map_fn, reduce_fn) or []
+        return RowIdentifiers(rows=rows)
+
+    def _rows_shard(self, idx: Index, field_name: str, c: Call,
+                    shard: int) -> list[int]:
+        """Reference executeRowsShard (executor.go:1320)."""
+        f = idx.field(field_name)
+        if f is None:
+            raise FieldNotFoundError(f"field not found: {field_name!r}")
+
+        views = [VIEW_STANDARD]
+        if f.field_type == FIELD_TYPE_TIME:
+            from_time = tq.parse_time(c.args["from"]) if "from" in c.args else None
+            to_time = tq.parse_time(c.args["to"]) if "to" in c.args else None
+            if from_time or to_time or f.options.no_standard_view:
+                q = f.time_quantum()
+                if not q:
+                    return []
+                lo, hi = f._time_view_bounds()
+                if lo is None:
+                    return []
+                from_time = from_time if (from_time and from_time > lo) else lo
+                to_time = to_time if (to_time and to_time < hi) else hi
+                views = tq.views_by_time_range(VIEW_STANDARD, from_time,
+                                               to_time, q)
+
+        start = 0
+        previous, has_prev = c.uint_arg("previous")
+        if has_prev:
+            start = previous + 1
+
+        column, has_col = c.uint_arg("column")
+        if has_col and column // SHARD_WIDTH != shard:
+            return []
+        limit, has_limit = c.uint_arg("limit")
+
+        out: list[int] = []
+        for view_name in views:
+            frag = self.holder.fragment(idx.name, field_name, view_name, shard)
+            if frag is None:
+                continue
+            rows = frag.rows_list(
+                start_row=start,
+                column=column if has_col else None,
+                limit=limit if has_limit else None)
+            out = merge_row_ids(out, rows, limit if has_limit else _MAXINT)
+        return out
+
+    # ------------------------------------------------------------------
+    # GroupBy (reference executor.go:1069, iterator :3058)
+    # ------------------------------------------------------------------
+
+    def _execute_group_by(self, idx: Index, c: Call, shards, opt) -> list[GroupCount]:
+        if not c.children:
+            raise QueryError("need at least one child call")
+        limit, has_limit = c.uint_arg("limit")
+        limit = limit if has_limit else _MAXINT
+        filter_call, _ = c.call_arg("filter")
+
+        child_rows: list[list[int] | None] = [None] * len(c.children)
+        for i, child in enumerate(c.children):
+            if isinstance(child.args.get("field"), str):
+                child.args["_field"] = child.args["field"]
+            if child.name != "Rows":
+                raise QueryError(
+                    f"'{child.name}' is not a valid child query for GroupBy, "
+                    f"must be 'Rows'")
+            _, has_lim = child.uint_arg("limit")
+            _, has_col = child.uint_arg("column")
+            if has_lim or has_col:
+                ids = self._execute_rows(idx, child, shards, opt).rows
+                if not ids:
+                    return []
+                child_rows[i] = ids
+
+        def map_fn(shard):
+            return self._group_by_shard(idx, c, filter_call, shard, child_rows)
+
+        def reduce_fn(p, v):
+            return merge_group_counts(p or [], v, limit)
+
+        results = self.map_reduce(idx, shards, c, opt, map_fn, reduce_fn) or []
+
+        offset, has_off = c.uint_arg("offset")
+        if has_off and offset < len(results):
+            results = results[offset:]
+        if has_limit and limit < len(results):
+            results = results[:limit]
+        return results
+
+    def _group_by_shard(self, idx: Index, c: Call, filter_call: Call | None,
+                        shard: int, child_rows) -> list[GroupCount]:
+        """DFS over row combinations; empty-intersection pruning; the last
+        level is one batched device intersection-count per prefix."""
+        filter_row = None
+        if filter_call is not None:
+            filter_row = self._bitmap_call_shard(idx, filter_call, shard)
+            fseg = filter_row.segment(shard)
+            if fseg is None:
+                return []
+
+        fields, frags, cands = [], [], []
+        for i, child in enumerate(c.children):
+            field_name = child.args.get("_field")
+            if idx.field(field_name) is None:
+                raise FieldNotFoundError(f"field not found: {field_name!r}")
+            frag = self.holder.fragment(idx.name, field_name, VIEW_STANDARD, shard)
+            if frag is None:
+                return []
+            rows = frag.rows_list(among=child_rows[i])
+            if not rows:
+                return []
+            fields.append(field_name)
+            frags.append(frag)
+            cands.append(rows)
+
+        # Per-child "previous" cursor (reference Seek + ignorePrev cascade,
+        # executor.go:3116-3137): each provided previous seeks its level;
+        # once a level can't resume exactly at its previous row, deeper
+        # levels restart from the beginning.
+        prev: list[int | None] = []
+        for i, child in enumerate(c.children):
+            p, has_p = child.uint_arg("previous")
+            prev.append(p if has_p else None)
+        any_prev = any(p is not None for p in prev)
+
+        limit, has_limit = c.uint_arg("limit")
+        limit = limit if has_limit else _MAXINT
+        results: list[GroupCount] = []
+        k = len(cands)
+
+        def recurse(level: int, acc: Row | None, prefix: list[int],
+                    at_cursor: bool):
+            if len(results) >= limit:
+                return
+            rows = cands[level]
+            if at_cursor and prev[level] is not None:
+                # Resume strictly after the cursor at the last level,
+                # at-or-after it at earlier levels.
+                lo = prev[level] + (1 if level == k - 1 else 0)
+                rows = [r for r in rows if r >= lo]
+            if level == k - 1:
+                # Batched last level.
+                if acc is None and filter_row is None:
+                    counts = [(r, frags[level].rows[r].count()) for r in rows]
+                else:
+                    base = acc if acc is not None else filter_row
+                    seg = base.segment(shard)
+                    if seg is None:
+                        return
+                    stack = frags[level].device_stack(tuple(rows))
+                    cnts = np.asarray(
+                        pallas_kernels.pair_count(stack, seg, "and"))
+                    counts = list(zip(rows, cnts.tolist()))
+                for r, cnt in counts:
+                    if len(results) >= limit:
+                        return
+                    if cnt > 0:
+                        results.append(GroupCount(
+                            group=[FieldRow(field=fields[i], row_id=p)
+                                   for i, p in enumerate(prefix)] +
+                                  [FieldRow(field=fields[level], row_id=r)],
+                            count=int(cnt)))
+                return
+            for j, r in enumerate(rows):
+                if len(results) >= limit:
+                    return
+                row = frags[level].row(r)
+                if level == 0 and filter_row is not None:
+                    row = row.intersect(filter_row)
+                elif acc is not None:
+                    row = row.intersect(acc)
+                # The cursor chain survives only along the first row of each
+                # level, and only if that row IS the previous row (or the
+                # level had no previous) — otherwise deeper levels restart
+                # (ignorePrev).
+                still_cursor = (at_cursor and j == 0
+                                and (prev[level] is None or r == prev[level]))
+                if not still_cursor and row.is_empty():
+                    continue
+                recurse(level + 1, row, prefix + [r], still_cursor)
+
+        recurse(0, None, [], any_prev)
+        return results
+
+    # ------------------------------------------------------------------
+    # writes
+    # ------------------------------------------------------------------
+
+    def _execute_set(self, idx: Index, c: Call, opt: ExecOptions) -> bool:
+        """Reference executeSet (executor.go:2067)."""
+        col_id, ok = c.uint_arg("_col")
+        if not ok:
+            raise QueryError("Set() column argument 'col' required")
+        field_name = c.field_arg()
+        f = idx.field(field_name)
+        if f is None:
+            raise FieldNotFoundError(f"field not found: {field_name!r}")
+
+        idx.add_existence([col_id])
+
+        if f.field_type == FIELD_TYPE_INT:
+            row_val, ok = c.int_arg(field_name)
+            if not ok:
+                raise QueryError("Set() row argument 'row' required")
+            return f.set_value(col_id, row_val)
+
+        row_arg = c.args.get(field_name)
+        if isinstance(row_arg, bool):
+            row_id = 1 if row_arg else 0
+        else:
+            row_id, ok = c.uint_arg(field_name)
+            if not ok:
+                raise QueryError("Set() row argument 'row' required")
+
+        timestamp = None
+        if "_timestamp" in c.args:
+            timestamp = tq.parse_time(c.args["_timestamp"])
+        return f.set_bit(row_id, col_id, timestamp)
+
+    def _execute_clear_bit(self, idx: Index, c: Call, opt: ExecOptions) -> bool:
+        field_name = c.field_arg()
+        f = idx.field(field_name)
+        if f is None:
+            raise FieldNotFoundError(f"field not found: {field_name!r}")
+        row_arg = c.args.get(field_name)
+        if isinstance(row_arg, bool):
+            row_id = 1 if row_arg else 0
+        else:
+            row_id, ok = c.uint_arg(field_name)
+            if not ok:
+                raise QueryError("row=<row> argument required to Clear() call")
+        col_id, ok = c.uint_arg("_col")
+        if not ok:
+            raise QueryError(
+                "column argument to Clear(<COLUMN>, <FIELD>=<ROW>) required")
+        if f.field_type == FIELD_TYPE_INT:
+            # Clearing an int value clears the exists bit.
+            v = f.view(view_bsi_name(field_name))
+            if v is None:
+                return False
+            frag = v.fragment(col_id // SHARD_WIDTH)
+            if frag is None:
+                return False
+            from pilosa_tpu.core.fragment import BSI_EXISTS_BIT
+            return frag.clear_bit(BSI_EXISTS_BIT, col_id)
+        return f.clear_bit(row_id, col_id)
+
+    def _execute_clear_row(self, idx: Index, c: Call, shards, opt) -> bool:
+        field_name = c.field_arg()
+        f = idx.field(field_name)
+        if f is None:
+            raise FieldNotFoundError(f"field not found: {field_name!r}")
+        if f.field_type == FIELD_TYPE_INT:
+            raise QueryError(
+                f"ClearRow() is not supported on {f.field_type} field types")
+        row_arg = c.args.get(field_name)
+        if isinstance(row_arg, bool):
+            row_id = 1 if row_arg else 0
+        else:
+            row_id, ok = c.uint_arg(field_name)
+            if not ok:
+                raise QueryError("ClearRow() row argument 'row' required")
+
+        def map_fn(shard):
+            changed = False
+            for view_name, v in list(f.views.items()):
+                frag = v.fragment(shard)
+                if frag is not None:
+                    changed |= frag.clear_row(row_id)
+            return changed
+
+        return bool(self.map_reduce(idx, shards, c, opt, map_fn,
+                                    lambda p, v: bool(p) or v))
+
+    def _execute_store(self, idx: Index, c: Call, shards, opt) -> bool:
+        """Reference executeSetRow / Store() (executor.go:1990)."""
+        field_name = c.field_arg()
+        f = idx.field(field_name)
+        if f is None:
+            raise FieldNotFoundError(f"field not found: {field_name!r}")
+        if f.field_type != "set":
+            raise QueryError(f"can't Store() on a {f.field_type} field")
+        row_id, ok = c.uint_arg(field_name)
+        if not ok:
+            raise QueryError("need the <FIELD>=<ROW> argument on Store()")
+        if len(c.children) != 1:
+            raise QueryError("Store() requires a source row")
+
+        def map_fn(shard):
+            src = self._bitmap_call_shard(idx, c.children[0], shard)
+            view = f.create_view_if_not_exists(VIEW_STANDARD)
+            frag = view.create_fragment_if_not_exists(shard)
+            return frag.set_row(src, row_id)
+
+        return bool(self.map_reduce(idx, shards, c, opt, map_fn,
+                                    lambda p, v: bool(p) or v))
+
+    def _execute_set_row_attrs(self, idx: Index, c: Call, opt) -> None:
+        field_name = c.args.get("_field")
+        f = idx.field(field_name)
+        if f is None:
+            raise FieldNotFoundError(f"field not found: {field_name!r}")
+        row_id, ok = c.uint_arg("_row")
+        if not ok:
+            raise QueryError("SetRowAttrs() row field 'row' required")
+        attrs = {k: v for k, v in c.args.items() if k not in ("_field", "_row")}
+        f.row_attr_store.set_attrs(row_id, attrs)
+
+    def _execute_set_column_attrs(self, idx: Index, c: Call, opt) -> None:
+        col_id, ok = c.uint_arg("_col")
+        if not ok:
+            raise QueryError("SetColumnAttrs() col required")
+        attrs = {k: v for k, v in c.args.items() if k != "_col"}
+        idx.column_attr_store.set_attrs(col_id, attrs)
+
+    # ------------------------------------------------------------------
+    # Options (reference executor.go:360)
+    # ------------------------------------------------------------------
+
+    def _execute_options(self, idx: Index, c: Call, shards, opt) -> Any:
+        opt_copy = replace(opt)
+        if "columnAttrs" in c.args:
+            v = c.args["columnAttrs"]
+            if not isinstance(v, bool):
+                raise QueryError("Query(): columnAttrs must be a bool")
+            opt.column_attrs = v  # mutates outer opt, like the reference
+        if "excludeRowAttrs" in c.args:
+            v = c.args["excludeRowAttrs"]
+            if not isinstance(v, bool):
+                raise QueryError("Query(): excludeRowAttrs must be a bool")
+            opt_copy.exclude_row_attrs = v
+        if "excludeColumns" in c.args:
+            v = c.args["excludeColumns"]
+            if not isinstance(v, bool):
+                raise QueryError("Query(): excludeColumns must be a bool")
+            opt_copy.exclude_columns = v
+        if "shards" in c.args:
+            v = c.args["shards"]
+            if not isinstance(v, list) or not all(
+                    isinstance(s, int) and not isinstance(s, bool) for s in v):
+                raise QueryError("Query(): shards must be a list of unsigned integers")
+            shards = v
+        if len(c.children) != 1:
+            raise QueryError("Options() requires a single child call")
+        return self._execute_call(idx, c.children[0], shards, opt_copy)
+
+    # ------------------------------------------------------------------
+    # key translation (reference executor.go:2610-2905)
+    # ------------------------------------------------------------------
+
+    def _translate_call(self, idx: Index, c: Call) -> Call:
+        """Map string keys to ids in-place on a clone."""
+        c = c.clone()
+        self._translate_call_rec(idx, c)
+        return c
+
+    def _translate_call_rec(self, idx: Index, c: Call) -> None:
+        # Column key (index-level).
+        col = c.args.get("_col")
+        if isinstance(col, str):
+            if not idx.options.keys:
+                raise QueryError(f"string 'col' value not allowed unless "
+                                 f"index 'keys' option enabled: {col!r}")
+            c.args["_col"] = idx.translate_store.translate_key(col)
+        # Row keys (field-level).
+        for key in list(c.args):
+            if pql_ast.is_reserved_arg(key):
+                continue
+            f = idx.field(key)
+            if f is None:
+                continue
+            val = c.args[key]
+            if isinstance(val, str) and f.keys:
+                c.args[key] = f.translate_store.translate_key(val)
+        row = c.args.get("_row")
+        if isinstance(row, str):
+            fname = c.args.get("_field")
+            f = idx.field(fname) if fname else None
+            if f is None or not f.keys:
+                raise QueryError("string 'row' value not allowed unless "
+                                 "field 'keys' option enabled")
+            c.args["_row"] = f.translate_store.translate_key(row)
+        # Rows()/GroupBy-child cursor args (reference translateCall
+        # executor.go:2634-2637: rowKey="previous", colKey="column").
+        if c.name == "Rows":
+            fname = c.args.get("_field") or c.args.get("field")
+            f = idx.field(fname) if isinstance(fname, str) else None
+            p = c.args.get("previous")
+            if isinstance(p, str):
+                if f is None or not f.keys:
+                    raise QueryError("string 'previous' value not allowed "
+                                     "unless field 'keys' option enabled")
+                c.args["previous"] = f.translate_store.translate_key(p)
+            col = c.args.get("column")
+            if isinstance(col, str):
+                if not idx.options.keys:
+                    raise QueryError("string 'column' value not allowed "
+                                     "unless index 'keys' option enabled")
+                c.args["column"] = idx.translate_store.translate_key(col)
+        for ch in c.children:
+            self._translate_call_rec(idx, ch)
+        for v in c.args.values():
+            if isinstance(v, Call):
+                self._translate_call_rec(idx, v)
+
+    def _translate_result(self, idx: Index, c: Call, result: Any) -> Any:
+        """Map ids back to keys on results (reference :2781)."""
+        if isinstance(result, Row) and idx.options.keys:
+            result.keys = [idx.translate_store.translate_id(int(i)) or str(i)
+                           for i in result.columns()]
+        elif isinstance(result, RowIdentifiers):
+            fname = c.args.get("_field") or c.args.get("field")
+            f = idx.field(fname) if isinstance(fname, str) else None
+            if f is not None and f.keys:
+                result.keys = [f.translate_store.translate_id(r) or str(r)
+                               for r in result.rows]
+                result.rows = []
+        elif isinstance(result, Pair) and c.name in ("MinRow", "MaxRow"):
+            fname = c.args.get("field")
+            f = idx.field(fname) if isinstance(fname, str) else None
+            if f is not None and f.keys:
+                result.key = f.translate_store.translate_id(result.id) or ""
+        elif isinstance(result, list) and result and isinstance(result[0], Pair):
+            fname = c.args.get("_field")
+            f = idx.field(fname) if isinstance(fname, str) else None
+            if f is not None and f.keys:
+                for p in result:
+                    p.key = f.translate_store.translate_id(p.id) or str(p.id)
+        elif isinstance(result, list) and result and isinstance(result[0], GroupCount):
+            for gc in result:
+                for fr in gc.group:
+                    f = idx.field(fr.field)
+                    if f is not None and f.keys:
+                        fr.row_key = f.translate_store.translate_id(fr.row_id) or ""
+        return result

@@ -1,0 +1,410 @@
+"""Executor tests — cases modeled on reference executor_test.go.
+
+Each test builds a Holder, writes via PQL Set()/direct imports, and checks
+query results end to end through Executor.execute.
+"""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.config import SHARD_WIDTH
+from pilosa_tpu.core import Holder, FieldOptions, IndexOptions, Row
+from pilosa_tpu.core.field import (
+    FIELD_TYPE_BOOL,
+    FIELD_TYPE_INT,
+    FIELD_TYPE_MUTEX,
+    FIELD_TYPE_TIME,
+)
+from pilosa_tpu.errors import FieldNotFoundError, QueryError
+from pilosa_tpu.exec import Executor, GroupCount, Pair, RowIdentifiers, ValCount
+
+
+@pytest.fixture
+def env():
+    h = Holder()
+    idx = h.create_index("i")
+    return h, idx, Executor(h)
+
+
+def q(e, src, index="i"):
+    return e.execute(index, src)
+
+
+# -- Set / Row / Count -----------------------------------------------------
+
+def test_set_and_row(env):
+    h, idx, e = env
+    idx.create_field("f")
+    assert q(e, "Set(100, f=1)") == [True]
+    assert q(e, "Set(100, f=1)") == [False]  # already set
+    (row,) = q(e, "Row(f=1)")
+    assert row.columns().tolist() == [100]
+
+
+def test_set_cross_shard(env):
+    h, idx, e = env
+    idx.create_field("f")
+    cols = [3, SHARD_WIDTH + 5, 2 * SHARD_WIDTH + 7]
+    for c in cols:
+        q(e, f"Set({c}, f=9)")
+    (row,) = q(e, "Row(f=9)")
+    assert row.columns().tolist() == cols
+    assert q(e, "Count(Row(f=9))") == [3]
+
+
+def test_existence_tracked_on_set(env):
+    h, idx, e = env
+    idx.create_field("f")
+    q(e, "Set(10, f=1) Set(20, f=2)")
+    assert idx.existence_row().columns().tolist() == [10, 20]
+
+
+def test_clear(env):
+    h, idx, e = env
+    idx.create_field("f")
+    q(e, "Set(10, f=1)")
+    assert q(e, "Clear(10, f=1)") == [True]
+    assert q(e, "Clear(10, f=1)") == [False]
+    assert q(e, "Count(Row(f=1))") == [0]
+
+
+# -- combinators -----------------------------------------------------------
+
+def test_intersect_union_difference_xor(env):
+    h, idx, e = env
+    idx.create_field("a")
+    idx.create_field("b")
+    a_cols = [1, 2, 3, SHARD_WIDTH + 1]
+    b_cols = [2, 3, 4, SHARD_WIDTH + 2]
+    for c in a_cols:
+        q(e, f"Set({c}, a=1)")
+    for c in b_cols:
+        q(e, f"Set({c}, b=1)")
+    (r,) = q(e, "Intersect(Row(a=1), Row(b=1))")
+    assert r.columns().tolist() == [2, 3]
+    (r,) = q(e, "Union(Row(a=1), Row(b=1))")
+    assert r.columns().tolist() == sorted(set(a_cols) | set(b_cols))
+    (r,) = q(e, "Difference(Row(a=1), Row(b=1))")
+    assert r.columns().tolist() == [1, SHARD_WIDTH + 1]
+    (r,) = q(e, "Xor(Row(a=1), Row(b=1))")
+    assert r.columns().tolist() == [1, 4, SHARD_WIDTH + 1, SHARD_WIDTH + 2]
+
+
+def test_not(env):
+    h, idx, e = env
+    idx.create_field("f")
+    q(e, "Set(1, f=1) Set(2, f=1) Set(3, f=2)")
+    (r,) = q(e, "Not(Row(f=1))")
+    assert r.columns().tolist() == [3]
+    (r,) = q(e, "Not(Union(Row(f=1), Row(f=2)))")
+    assert r.columns().tolist() == []
+
+
+def test_not_requires_existence(env):
+    h, _, e = env
+    idx2 = h.create_index("noex", IndexOptions(track_existence=False))
+    idx2.create_field("f")
+    with pytest.raises(QueryError):
+        e.execute("noex", "Not(Row(f=1))")
+
+
+def test_shift(env):
+    h, idx, e = env
+    idx.create_field("f")
+    q(e, "Set(1, f=1) Set(5, f=1)")
+    (r,) = q(e, "Shift(Row(f=1), n=2)")
+    assert r.columns().tolist() == [3, 7]
+
+
+# -- BSI / conditions ------------------------------------------------------
+
+@pytest.fixture
+def bsi_env(env):
+    h, idx, e = env
+    idx.create_field("v", FieldOptions(type=FIELD_TYPE_INT, min=-1100, max=1000))
+    for col, val in {1: 10, 2: -20, 3: 30, 4: 0, SHARD_WIDTH + 1: 500}.items():
+        q(e, f"Set({col}, v={val})")
+    return h, idx, e
+
+
+def test_set_int_value_and_conditions(bsi_env):
+    h, idx, e = bsi_env
+    (r,) = q(e, "Row(v > 5)")
+    assert r.columns().tolist() == [1, 3, SHARD_WIDTH + 1]
+    (r,) = q(e, "Row(v < 0)")
+    assert r.columns().tolist() == [2]
+    (r,) = q(e, "Row(v == 30)")
+    assert r.columns().tolist() == [3]
+    (r,) = q(e, "Row(v != 30)")
+    assert r.columns().tolist() == [1, 2, 4, SHARD_WIDTH + 1]
+    (r,) = q(e, "Row(v != null)")
+    assert r.columns().tolist() == [1, 2, 3, 4, SHARD_WIDTH + 1]
+    (r,) = q(e, "Row(v >< [0, 30])")
+    assert r.columns().tolist() == [1, 3, 4]
+    (r,) = q(e, "Row(-20 <= v < 30)")
+    assert r.columns().tolist() == [1, 2, 4]
+
+
+def test_condition_encompassing_range_returns_not_null(bsi_env):
+    h, idx, e = bsi_env
+    (r,) = q(e, "Row(v < 1000000)")  # past bit-depth max
+    assert r.columns().tolist() == [1, 2, 3, 4, SHARD_WIDTH + 1]
+    (r,) = q(e, "Row(v >= -1100)")
+    assert r.columns().tolist() == [1, 2, 3, 4, SHARD_WIDTH + 1]
+
+
+def test_sum_min_max(bsi_env):
+    h, idx, e = bsi_env
+    assert q(e, "Sum(field=v)") == [ValCount(520, 5)]
+    assert q(e, "Min(field=v)") == [ValCount(-20, 1)]
+    assert q(e, "Max(field=v)") == [ValCount(500, 1)]
+    # with filter
+    idx.create_field("f")
+    q(e, "Set(1, f=1) Set(2, f=1)")
+    assert q(e, "Sum(Row(f=1), field=v)") == [ValCount(-10, 2)]
+    assert q(e, "Min(Row(f=1), field=v)") == [ValCount(-20, 1)]
+    assert q(e, "Max(Row(f=1), field=v)") == [ValCount(10, 1)]
+
+
+# -- MinRow / MaxRow -------------------------------------------------------
+
+def test_min_max_row(env):
+    h, idx, e = env
+    idx.create_field("f")
+    q(e, "Set(1, f=3) Set(2, f=7) Set(3, f=5)")
+    assert q(e, "MinRow(field=f)") == [Pair(id=3, count=1)]
+    assert q(e, "MaxRow(field=f)") == [Pair(id=7, count=1)]
+
+
+# -- TopN ------------------------------------------------------------------
+
+def test_top_n(env):
+    h, idx, e = env
+    f = idx.create_field("f")
+    # row 0: 5 bits, row 1: 3 bits, row 2: 1 bit (spread over 2 shards)
+    f.import_bits([0] * 5 + [1] * 3 + [2],
+                  [0, 1, 2, SHARD_WIDTH, SHARD_WIDTH + 1,
+                   10, 11, SHARD_WIDTH + 10, 20])
+    (pairs,) = q(e, "TopN(f, n=2)")
+    assert pairs == [Pair(id=0, count=5), Pair(id=1, count=3)]
+    (pairs,) = q(e, "TopN(f)")
+    assert pairs == [Pair(id=0, count=5), Pair(id=1, count=3), Pair(id=2, count=1)]
+
+
+def test_top_n_with_src_and_ids(env):
+    h, idx, e = env
+    f = idx.create_field("f")
+    g = idx.create_field("g")
+    f.import_bits([0] * 3 + [1] * 2, [0, 1, 2, 1, 2])
+    g.import_bits([9] * 2, [1, 2])
+    (pairs,) = q(e, "TopN(f, Row(g=9))")
+    assert pairs == [Pair(id=0, count=2), Pair(id=1, count=2)] or \
+           pairs == [Pair(id=1, count=2), Pair(id=0, count=2)]
+    (pairs,) = q(e, "TopN(f, ids=[1])")
+    assert pairs == [Pair(id=1, count=2)]
+
+
+def test_top_n_threshold_and_attr_filter(env):
+    h, idx, e = env
+    f = idx.create_field("f")
+    f.import_bits([0] * 4 + [1] * 2 + [2], [0, 1, 2, 3, 0, 1, 5])
+    (pairs,) = q(e, "TopN(f, threshold=2)")
+    assert pairs == [Pair(id=0, count=4), Pair(id=1, count=2)]
+    q(e, 'SetRowAttrs(f, 0, cat="x")')
+    q(e, 'SetRowAttrs(f, 1, cat="y")')
+    (pairs,) = q(e, 'TopN(f, attrName="cat", attrValues=["x"])')
+    assert pairs == [Pair(id=0, count=4)]
+
+
+def test_top_n_rejects_int_field(bsi_env):
+    h, idx, e = bsi_env
+    with pytest.raises(QueryError):
+        q(e, "TopN(v)")
+
+
+# -- Rows ------------------------------------------------------------------
+
+def test_rows(env):
+    h, idx, e = env
+    f = idx.create_field("f")
+    f.import_bits([1, 3, 5, 7], [1, 2, 3, SHARD_WIDTH + 4])
+    assert q(e, "Rows(f)") == [RowIdentifiers(rows=[1, 3, 5, 7])]
+    assert q(e, "Rows(f, previous=3)") == [RowIdentifiers(rows=[5, 7])]
+    assert q(e, "Rows(f, limit=2)") == [RowIdentifiers(rows=[1, 3])]
+    assert q(e, "Rows(f, column=2)") == [RowIdentifiers(rows=[3])]
+
+
+# -- GroupBy ---------------------------------------------------------------
+
+def test_group_by(env):
+    h, idx, e = env
+    a = idx.create_field("a")
+    b = idx.create_field("b")
+    # a row 0: cols {0,1,2}; a row 1: cols {1,2}
+    a.import_bits([0, 0, 0, 1, 1], [0, 1, 2, 1, 2])
+    # b row 0: cols {0,1}; b row 1: cols {2}
+    b.import_bits([0, 0, 1], [0, 1, 2])
+    (groups,) = q(e, "GroupBy(Rows(a), Rows(b))")
+    got = {(tuple(fr.row_id for fr in g.group)): g.count for g in groups}
+    assert got == {(0, 0): 2, (0, 1): 1, (1, 0): 1, (1, 1): 1}
+
+
+def test_group_by_filter_and_limit(env):
+    h, idx, e = env
+    a = idx.create_field("a")
+    b = idx.create_field("b")
+    a.import_bits([0, 0, 1], [0, 1, 1])
+    b.import_bits([0, 0], [0, 1])
+    (groups,) = q(e, "GroupBy(Rows(a), Rows(b), filter=Row(a=0))")
+    got = {(tuple(fr.row_id for fr in g.group)): g.count for g in groups}
+    assert got == {(0, 0): 2, (1, 0): 1}
+    (groups,) = q(e, "GroupBy(Rows(a), Rows(b), limit=1)")
+    assert len(groups) == 1 and groups[0].count == 2
+
+
+def test_group_by_previous(env):
+    h, idx, e = env
+    a = idx.create_field("a")
+    b = idx.create_field("b")
+    a.import_bits([0, 1], [0, 0])
+    b.import_bits([0, 1], [0, 0])
+    (groups,) = q(e, "GroupBy(Rows(a, previous=0), Rows(b, previous=0))")
+    got = [tuple(fr.row_id for fr in g.group) for g in groups]
+    assert got == [(0, 1), (1, 0), (1, 1)]
+
+
+def test_group_by_rejects_non_rows_child(env):
+    h, idx, e = env
+    idx.create_field("a")
+    with pytest.raises(QueryError):
+        q(e, "GroupBy(Row(a=1))")
+
+
+# -- ClearRow / Store ------------------------------------------------------
+
+def test_clear_row(env):
+    h, idx, e = env
+    f = idx.create_field("f")
+    f.import_bits([1, 1, 2], [1, SHARD_WIDTH + 1, 2])
+    assert q(e, "ClearRow(f=1)") == [True]
+    assert q(e, "Count(Row(f=1))") == [0]
+    assert q(e, "Count(Row(f=2))") == [1]
+    assert q(e, "ClearRow(f=1)") == [False]
+
+
+def test_store(env):
+    h, idx, e = env
+    f = idx.create_field("f")
+    f.import_bits([1, 1], [3, SHARD_WIDTH + 4])
+    assert q(e, "Store(Row(f=1), f=9)") == [True]
+    (r,) = q(e, "Row(f=9)")
+    assert r.columns().tolist() == [3, SHARD_WIDTH + 4]
+
+
+# -- attrs -----------------------------------------------------------------
+
+def test_row_attrs_attached(env):
+    h, idx, e = env
+    idx.create_field("f")
+    q(e, "Set(1, f=7)")
+    q(e, 'SetRowAttrs(f, 7, color="blue", weight=3)')
+    (row,) = q(e, "Row(f=7)")
+    assert row.attrs == {"color": "blue", "weight": 3}
+    # Options(excludeRowAttrs=true)
+    (row,) = q(e, "Options(Row(f=7), excludeRowAttrs=true)")
+    assert row.attrs == {}
+    (row,) = q(e, "Options(Row(f=7), excludeColumns=true)")
+    assert row.columns().tolist() == []
+
+
+def test_set_column_attrs(env):
+    h, idx, e = env
+    idx.create_field("f")
+    q(e, 'SetColumnAttrs(9, name="bob")')
+    assert idx.column_attr_store.attrs(9) == {"name": "bob"}
+
+
+def test_options_shards(env):
+    h, idx, e = env
+    f = idx.create_field("f")
+    f.import_bits([1, 1, 1], [0, SHARD_WIDTH, 2 * SHARD_WIDTH])
+    (r,) = q(e, "Options(Row(f=1), shards=[0, 2])")
+    assert r.columns().tolist() == [0, 2 * SHARD_WIDTH]
+
+
+# -- time ------------------------------------------------------------------
+
+def test_row_time_range(env):
+    h, idx, e = env
+    idx.create_field("t", FieldOptions(type=FIELD_TYPE_TIME, time_quantum="YMDH"))
+    q(e, "Set(1, t=1, 2018-01-01T00:00)")
+    q(e, "Set(2, t=1, 2018-06-05T12:00)")
+    q(e, "Set(3, t=1, 2019-02-03T04:00)")
+    (r,) = q(e, "Range(t=1, from='2018-01-01T00:00', to='2019-01-01T00:00')")
+    assert r.columns().tolist() == [1, 2]
+    (r,) = q(e, "Row(t=1, from='2018-06-01T00:00', to='2019-03-01T00:00')")
+    assert r.columns().tolist() == [2, 3]
+    # plain Row uses the standard view
+    (r,) = q(e, "Row(t=1)")
+    assert r.columns().tolist() == [1, 2, 3]
+
+
+# -- mutex / bool ----------------------------------------------------------
+
+def test_mutex_field_via_executor(env):
+    h, idx, e = env
+    idx.create_field("m", FieldOptions(type=FIELD_TYPE_MUTEX))
+    q(e, "Set(5, m=1)")
+    q(e, "Set(5, m=2)")
+    assert q(e, "Count(Row(m=1))") == [0]
+    assert q(e, "Count(Row(m=2))") == [1]
+
+
+def test_bool_field_via_executor(env):
+    h, idx, e = env
+    idx.create_field("b", FieldOptions(type=FIELD_TYPE_BOOL))
+    q(e, "Set(5, b=true)")
+    (r,) = q(e, "Row(b=true)")
+    assert r.columns().tolist() == [5]
+    q(e, "Set(5, b=false)")
+    (r,) = q(e, "Row(b=false)")
+    assert r.columns().tolist() == [5]
+    (r,) = q(e, "Row(b=true)")
+    assert r.columns().tolist() == []
+
+
+# -- keys ------------------------------------------------------------------
+
+def test_index_and_field_keys(env):
+    h, _, e = env
+    idx = h.create_index("ki", IndexOptions(keys=True))
+    idx.create_field("f", FieldOptions(keys=True))
+    e.execute("ki", 'Set("alpha", f="red")')
+    e.execute("ki", 'Set("beta", f="red")')
+    (row,) = e.execute("ki", 'Row(f="red")')
+    assert sorted(row.keys) == ["alpha", "beta"]
+    (rows,) = e.execute("ki", "Rows(f)")
+    assert rows.keys == ["red"] and rows.rows == []
+
+
+# -- errors ----------------------------------------------------------------
+
+def test_field_not_found(env):
+    h, idx, e = env
+    with pytest.raises(FieldNotFoundError):
+        q(e, "Row(nope=1)")
+
+
+def test_count_requires_single_child(env):
+    h, idx, e = env
+    idx.create_field("f")
+    with pytest.raises(QueryError):
+        q(e, "Count(Row(f=1), Row(f=2))")
+
+
+def test_store_requires_set_field(bsi_env):
+    h, idx, e = bsi_env
+    with pytest.raises(QueryError):
+        q(e, "Store(Row(v > 0), v=1)")
