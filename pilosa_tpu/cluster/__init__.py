@@ -1,0 +1,27 @@
+"""Cluster layer: membership, shard placement, node fan-out, replication,
+anti-entropy repair.
+
+Reference: cluster.go (struct :186, partition/jump-hash placement
+:871-959, state machine :47-50), executor.go mapReduce node side
+(:2414-2560), holder.go syncer (:911). The TPU build keeps this layer
+host-side and thin: placement is a pure function, node transport is an
+``InternalClient`` interface (in-process for tests, HTTP for real
+deployments), and the per-node compute underneath is the mesh planner.
+"""
+
+from pilosa_tpu.cluster.cluster import (
+    STATE_DEGRADED,
+    STATE_NORMAL,
+    STATE_RESIZING,
+    STATE_STARTING,
+    Cluster,
+)
+from pilosa_tpu.cluster.client import InternalClient, LocalClient, NopClient
+from pilosa_tpu.cluster.node import Node
+from pilosa_tpu.cluster.placement import fnv1a64, jump_hash, partition
+
+__all__ = [
+    "Cluster", "InternalClient", "LocalClient", "NopClient", "Node",
+    "fnv1a64", "jump_hash", "partition",
+    "STATE_STARTING", "STATE_NORMAL", "STATE_DEGRADED", "STATE_RESIZING",
+]

@@ -1,0 +1,104 @@
+"""Node-to-node transport interface.
+
+Reference: client.go (InternalClient interface :46-74) with the HTTP impl
+in http/client.go:37. Three implementations here:
+
+- ``NopClient`` — standalone nodes (reference nopInternalClient);
+- ``LocalClient`` — in-process registry of peer servers, the transport of
+  the multi-node test harness (analog of test.MustRunCluster's real-HTTP
+  in-process nodes, test/pilosa.go:343 — we cross a serialization
+  boundary by shipping PQL strings + JSON-able payloads, no sockets);
+- the HTTP impl lives in pilosa_tpu.server (once the REST layer exists).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Protocol
+
+from pilosa_tpu.cluster.node import Node
+
+
+class InternalClient(Protocol):
+    """What the executor/cluster need from a peer (client.go:46)."""
+
+    def query_node(self, node: Node, index: str, query: str,
+                   shards: list[int] | None, remote: bool) -> list[Any]:
+        """Execute PQL on a peer (http: POST /index/{i}/query?remote=true)."""
+        ...
+
+    def fragment_blocks(self, node: Node, index: str, field: str, view: str,
+                        shard: int) -> dict[int, bytes]:
+        """Checksum blocks of a peer fragment (anti-entropy)."""
+        ...
+
+    def fragment_block_data(self, node: Node, index: str, field: str,
+                            view: str, shard: int, block: int):
+        """(row_ids, column_ids) of one block on a peer."""
+        ...
+
+    def import_bits(self, node: Node, index: str, field: str, view: str,
+                    shard: int, rows: list[int], cols: list[int],
+                    clear: bool) -> None:
+        """Push bits into one specific fragment of a peer (the diff-push
+        half of anti-entropy, fragment.go:2986)."""
+        ...
+
+
+class NopClient:
+    """Standalone stub: remote calls are errors (clusters of one never
+    issue them)."""
+
+    def query_node(self, node, index, query, shards, remote):
+        raise RuntimeError("nop client cannot query remote nodes")
+
+    def fragment_blocks(self, node, index, field, view, shard):
+        raise RuntimeError("nop client cannot reach remote nodes")
+
+    def fragment_block_data(self, node, index, field, view, shard, block):
+        raise RuntimeError("nop client cannot reach remote nodes")
+
+    def import_bits(self, node, index, field, view, shard, rows, cols, clear):
+        raise RuntimeError("nop client cannot reach remote nodes")
+
+
+class LocalClient:
+    """In-process peer registry: node id -> server-like object exposing
+    ``handle_query`` / ``handle_fragment_*`` (pilosa_tpu.cluster.harness
+    wires these to real executors)."""
+
+    def __init__(self):
+        self.peers: dict[str, Any] = {}
+        #: node ids currently "down" (fault injection — the pumba pause
+        #: analog, internal/clustertests/cluster_test.go:69).
+        self.down: set[str] = set()
+
+    def register(self, node_id: str, server: Any) -> None:
+        self.peers[node_id] = server
+
+    def _peer(self, node: Node):
+        if node.id in self.down:
+            raise ConnectionError(f"node {node.id} is down")
+        peer = self.peers.get(node.id)
+        if peer is None:
+            raise ConnectionError(f"unknown node {node.id}")
+        return peer
+
+    def query_node(self, node, index, query, shards, remote=True):
+        return self._peer(node).handle_query(index, query, shards, remote)
+
+    def fragment_blocks(self, node, index, field, view, shard):
+        return self._peer(node).handle_fragment_blocks(index, field, view, shard)
+
+    def fragment_block_data(self, node, index, field, view, shard, block):
+        return self._peer(node).handle_fragment_block_data(
+            index, field, view, shard, block)
+
+    def import_bits(self, node, index, field, view, shard, rows, cols,
+                    clear=False):
+        return self._peer(node).handle_import(index, field, view, shard,
+                                              rows, cols, clear)
+
+    def send_message(self, node, message: dict):
+        """Control-plane broadcast (reference /internal/cluster/message,
+        broadcast.go:55-72)."""
+        return self._peer(node).handle_message(message)

@@ -1,0 +1,192 @@
+"""Cluster: membership + placement + distributed map/reduce + write fan-out.
+
+Reference: cluster.go (struct :186, state machine :47-50, partitionNodes
+:902-923) and the node-distribution half of executor.go (shardsByNode
+:2435, mapReduce retry/failover :2455-2560, write replication
+:2144-2168).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable
+
+from pilosa_tpu.config import DEFAULT_PARTITION_N
+from pilosa_tpu.cluster.client import InternalClient, NopClient
+from pilosa_tpu.cluster.node import Node
+from pilosa_tpu.cluster.placement import jump_hash, partition
+from pilosa_tpu.errors import PilosaError
+
+STATE_STARTING = "STARTING"
+STATE_NORMAL = "NORMAL"
+STATE_DEGRADED = "DEGRADED"
+STATE_RESIZING = "RESIZING"
+
+
+class ShardUnavailableError(PilosaError):
+    message = "shard unavailable"
+
+
+class Cluster:
+    """Reference cluster (cluster.go:186)."""
+
+    def __init__(self, local_id: str, nodes: list[Node] | None = None,
+                 replica_n: int = 1, partition_n: int = DEFAULT_PARTITION_N,
+                 client: InternalClient | None = None):
+        self.local_id = local_id
+        self.nodes: list[Node] = sorted(nodes or [], key=lambda n: n.id)
+        self.replica_n = replica_n
+        self.partition_n = partition_n
+        self.client = client or NopClient()
+        self.state = STATE_STARTING
+        self._lock = threading.RLock()
+
+    # -- membership --------------------------------------------------------
+
+    def node_by_id(self, node_id: str) -> Node | None:
+        for n in self.nodes:
+            if n.id == node_id:
+                return n
+        return None
+
+    @property
+    def local_node(self) -> Node | None:
+        return self.node_by_id(self.local_id)
+
+    def coordinator(self) -> Node | None:
+        for n in self.nodes:
+            if n.is_coordinator:
+                return n
+        return None
+
+    def node_join(self, node: Node) -> None:
+        """Reference nodeJoin (cluster.go:1796) minus resize: membership
+        changes while holding data trigger a resize job (future work)."""
+        with self._lock:
+            if self.node_by_id(node.id) is None:
+                self.nodes = sorted(self.nodes + [node], key=lambda n: n.id)
+            self._update_state()
+
+    def node_leave(self, node_id: str) -> None:
+        with self._lock:
+            n = self.node_by_id(node_id)
+            if n is not None:
+                n.state = "DOWN"
+            self._update_state()
+
+    def _update_state(self) -> None:
+        """cluster.go:571-582: tolerate < replicaN losses (DEGRADED);
+        beyond that, data is unavailable (STARTING)."""
+        down = sum(1 for n in self.nodes if n.state == "DOWN")
+        if down == 0:
+            self.state = STATE_NORMAL
+        elif down < self.replica_n:
+            self.state = STATE_DEGRADED
+        else:
+            self.state = STATE_STARTING
+
+    def set_state(self, state: str) -> None:
+        self.state = state
+
+    # -- placement ---------------------------------------------------------
+
+    def partition(self, index: str, shard: int) -> int:
+        return partition(index, shard, self.partition_n)
+
+    def partition_nodes(self, partition_id: int) -> list[Node]:
+        """Reference partitionNodes (cluster.go:902): jump-hash the
+        partition onto the sorted ring, walk forward for replicas."""
+        if not self.nodes:
+            return []
+        replica_n = min(self.replica_n, len(self.nodes)) or 1
+        start = jump_hash(partition_id, len(self.nodes))
+        return [self.nodes[(start + i) % len(self.nodes)]
+                for i in range(replica_n)]
+
+    def shard_nodes(self, index: str, shard: int) -> list[Node]:
+        return self.partition_nodes(self.partition(index, shard))
+
+    def owns_shard(self, node_id: str, index: str, shard: int) -> bool:
+        return any(n.id == node_id for n in self.shard_nodes(index, shard))
+
+    def shards_by_node(self, nodes: list[Node], index: str,
+                       shards: list[int]) -> dict[str, list[int]]:
+        """Reference shardsByNode (executor.go:2435): each shard goes to
+        its first live owner among ``nodes``."""
+        out: dict[str, list[int]] = {}
+        live = {n.id for n in nodes}
+        for shard in shards:
+            for owner in self.shard_nodes(index, shard):
+                if owner.id in live:
+                    out.setdefault(owner.id, []).append(shard)
+                    break
+            else:
+                raise ShardUnavailableError()
+        return out
+
+    # -- distributed map/reduce (reference mapReduce executor.go:2455) -----
+
+    def map_reduce(self, executor, idx, shards: list[int], c, opt,
+                   map_fn: Callable[[int], Any],
+                   reduce_fn: Callable[[Any, Any], Any],
+                   local_batch_fn: Callable[[list[int]], Any] | None = None) -> Any:
+        """``local_batch_fn`` lets the mesh planner take this node's whole
+        shard batch as one SPMD program instead of a per-shard loop."""
+        nodes = [n for n in self.nodes if n.state != "DOWN"]
+        result = None
+        pending = list(shards)
+        while pending:
+            groups = self.shards_by_node(nodes, idx.name, pending)
+            failed: list[int] = []
+            done: list[int] = []
+            for node_id, node_shards in groups.items():
+                if node_id == self.local_id:
+                    if local_batch_fn is not None:
+                        acc = local_batch_fn(node_shards)
+                    else:
+                        acc = None
+                        for shard in node_shards:
+                            acc = reduce_fn(acc, map_fn(shard))
+                    result = acc if result is None else reduce_fn(result, acc)
+                    done.extend(node_shards)
+                else:
+                    try:
+                        node = self.node_by_id(node_id)
+                        res = self.client.query_node(
+                            node, idx.name, str(c), node_shards, remote=True)
+                        result = res[0] if result is None else \
+                            reduce_fn(result, res[0])
+                        done.extend(node_shards)
+                    except ConnectionError:
+                        # Failover: drop the node, re-map its shards onto
+                        # replicas (executor.go:2492-2503).
+                        nodes = [n for n in nodes if n.id != node_id]
+                        failed.extend(node_shards)
+            pending = failed
+        return result
+
+    # -- write fan-out (reference executeSetBitField executor.go:2144) -----
+
+    def write_fanout(self, idx_name: str, shard: int, c, opt,
+                     local_apply: Callable[[], bool]) -> bool:
+        """Apply a single-column write on every replica: locally when this
+        node owns it, forwarded otherwise. Returns changed-ness."""
+        ret = False
+        for node in self.shard_nodes(idx_name, shard):
+            if node.id == self.local_id:
+                if local_apply():
+                    ret = True
+            elif not opt.remote:
+                res = self.client.query_node(node, idx_name, str(c), None,
+                                             remote=True)
+                if res and res[0]:
+                    ret = True
+        return ret
+
+    def broadcast_call(self, idx_name: str, c, opt) -> None:
+        """Forward an attr-write to every other node (executor.go:2237)."""
+        if opt.remote:
+            return
+        for node in self.nodes:
+            if node.id != self.local_id and node.state != "DOWN":
+                self.client.query_node(node, idx_name, str(c), None, remote=True)

@@ -1,0 +1,141 @@
+"""In-process multi-node cluster: the analog of the reference's
+``test.MustRunCluster(t, 3)`` (test/pilosa.go:343) — N fully-wired nodes
+(holder + executor + cluster + transport) in one process, crossing a
+PQL-string serialization boundary between nodes, no sockets.
+
+Also the template a real deployment follows: swap LocalClient for the
+HTTP client and each ClusterNode becomes one host's server process.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pilosa_tpu.cluster.client import LocalClient
+from pilosa_tpu.cluster.cluster import STATE_NORMAL, Cluster
+from pilosa_tpu.cluster.node import URI, Node
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.core.index import IndexOptions
+from pilosa_tpu.exec.executor import ExecOptions, Executor
+
+
+class ClusterNode:
+    """One node: holder + executor + cluster view + request handlers
+    (the handler surface LocalClient dispatches to — mirrors the
+    /internal/* HTTP routes, http/handler.go:274)."""
+
+    def __init__(self, node_id: str, cluster: Cluster, planner=None):
+        self.id = node_id
+        # New local fragments broadcast CreateShardMessage so every node's
+        # shard map stays complete (reference view.go:263-304).
+        self.holder = Holder(fragment_listener=self._broadcast_shard)
+        self.cluster = cluster
+        self.executor = Executor(self.holder, cluster=cluster,
+                                 node_id=node_id, planner=planner)
+
+    def _broadcast_shard(self, index: str, field: str, view: str, shard: int):
+        msg = {"type": "create-shard", "index": index, "field": field,
+               "shard": shard}
+        for node in self.cluster.nodes:
+            if node.id == self.id or node.state == "DOWN":
+                continue
+            try:
+                self.cluster.client.send_message(node, msg)
+            except (ConnectionError, RuntimeError):
+                pass  # best-effort, like the 50ms-timeout broadcast
+
+    def handle_message(self, message: dict) -> None:
+        if message.get("type") == "create-shard":
+            f = self.holder.field(message["index"], message["field"])
+            if f is not None:
+                f.add_remote_available_shards([message["shard"]])
+
+    # -- request handlers (the "server" surface) ---------------------------
+
+    def handle_query(self, index: str, query: str,
+                     shards: list[int] | None, remote: bool) -> list[Any]:
+        opt = ExecOptions(remote=remote)
+        return self.executor.execute(index, query, shards=shards, opt=opt)
+
+    def handle_fragment_blocks(self, index, field, view, shard):
+        frag = self.holder.fragment(index, field, view, shard)
+        return frag.checksum_blocks() if frag else {}
+
+    def handle_fragment_block_data(self, index, field, view, shard, block):
+        frag = self.holder.fragment(index, field, view, shard)
+        if frag is None:
+            import numpy as np
+            return np.empty(0, np.uint64), np.empty(0, np.uint64)
+        return frag.block_data(block)
+
+    def handle_import(self, index, field, view, shard, rows, cols,
+                      clear=False):
+        f = self.holder.field(index, field)
+        if f is None:
+            return
+        v = f.create_view_if_not_exists(view)
+        frag = v.create_fragment_if_not_exists(shard)
+        frag.bulk_import(rows, cols, clear=clear)
+
+    def handle_schema(self):
+        return self.holder.schema()
+
+    def apply_schema(self, schema) -> None:
+        self.holder.apply_schema(schema)
+
+
+class LocalCluster:
+    """N in-process nodes sharing a LocalClient transport."""
+
+    def __init__(self, n: int, replica_n: int = 1, planner_factory=None):
+        self.client = LocalClient()
+        nodes = [Node(id=f"node{i}", uri=URI(host="localhost", port=10101 + i),
+                      is_coordinator=(i == 0))
+                 for i in range(n)]
+        self.nodes: list[ClusterNode] = []
+        for i in range(n):
+            cluster = Cluster(local_id=f"node{i}",
+                              nodes=[Node(id=m.id, uri=m.uri,
+                                          is_coordinator=m.is_coordinator)
+                                     for m in nodes],
+                              replica_n=replica_n, client=self.client)
+            cluster.set_state(STATE_NORMAL)
+            planner = planner_factory(i) if planner_factory else None
+            cn = ClusterNode(f"node{i}", cluster, planner=planner)
+            self.client.register(cn.id, cn)
+            self.nodes.append(cn)
+
+    def __getitem__(self, i: int) -> ClusterNode:
+        return self.nodes[i]
+
+    def create_index(self, name: str, options: IndexOptions | None = None):
+        """Create the index + schema on every node (the reference
+        broadcasts CreateIndexMessage, api.go:162)."""
+        for cn in self.nodes:
+            cn.holder.create_index_if_not_exists(name, options)
+
+    def create_field(self, index: str, name: str, options=None):
+        for cn in self.nodes:
+            idx = cn.holder.index(index)
+            idx.create_field_if_not_exists(name, options)
+
+    def query(self, index: str, query: str, node: int = 0) -> list[Any]:
+        """Run through one node as coordinator (Cluster.Query analog,
+        test/pilosa.go:247)."""
+        return self.nodes[node].executor.execute(index, query)
+
+    def down(self, node_id: str) -> None:
+        """Fault injection: the pumba 'pause container' analog
+        (internal/clustertests/cluster_test.go:69)."""
+        self.client.down.add(node_id)
+        for cn in self.nodes:
+            if cn.id != node_id:
+                cn.cluster.node_leave(node_id)
+
+    def up(self, node_id: str) -> None:
+        self.client.down.discard(node_id)
+        for cn in self.nodes:
+            n = cn.cluster.node_by_id(node_id)
+            if n is not None:
+                n.state = "READY"
+                cn.cluster._update_state()

@@ -76,11 +76,20 @@ class ExecOptions:
 class Executor:
     """Reference executor (executor.go:72)."""
 
-    def __init__(self, holder: Holder, cluster=None, node_id: str | None = None):
+    def __init__(self, holder: Holder, cluster=None, node_id: str | None = None,
+                 planner=None):
         self.holder = holder
         #: cluster hooks (pilosa_tpu.cluster); None = standalone node.
         self.cluster = cluster
         self.node_id = node_id
+        #: MeshPlanner (pilosa_tpu.parallel): SPMD fast path for bitmap
+        #: trees and Count() — one XLA program over all shards.
+        self.planner = planner
+
+    def _planner_for(self, c: Call, opt: "ExecOptions"):
+        if self.planner is None:
+            return None
+        return self.planner if self.planner.supports(c) else None
 
     # ------------------------------------------------------------------
     # entry
@@ -103,10 +112,16 @@ class Executor:
             shards = sorted(idx.available_shards())
         shards = list(shards) if shards is not None else []
 
+        # Key translation happens on the coordinator only; forwarded
+        # (remote) queries already carry ids and must return raw internal
+        # results so the coordinator can merge them (executor.go:113-160).
         results = []
         for call in query.calls:
-            call = self._translate_call(idx, call)
+            if not opt.remote:
+                call = self._translate_call(idx, call)
             results.append(self._execute_call(idx, call, shards, opt))
+        if opt.remote:
+            return results
         return [self._translate_result(idx, c, r)
                 for c, r in zip(query.calls, results)]
 
@@ -161,12 +176,18 @@ class Executor:
 
     def map_reduce(self, idx: Index, shards: list[int], c: Call,
                    opt: ExecOptions, map_fn: Callable[[int], Any],
-                   reduce_fn: Callable[[Any, Any], Any]) -> Any:
+                   reduce_fn: Callable[[Any, Any], Any],
+                   local_batch_fn: Callable[[list[int]], Any] | None = None) -> Any:
         """Single-node spine: apply map_fn per shard, fold with reduce_fn.
-        The cluster layer overrides shard→node grouping + remote exec."""
+        The cluster layer overrides shard→node grouping + remote exec;
+        ``local_batch_fn`` (the mesh planner) takes whole local shard
+        batches as one SPMD program."""
         if self.cluster is not None and not opt.remote:
             return self.cluster.map_reduce(self, idx, shards, c, opt,
-                                           map_fn, reduce_fn)
+                                           map_fn, reduce_fn,
+                                           local_batch_fn=local_batch_fn)
+        if local_batch_fn is not None:
+            return local_batch_fn(list(shards))
         acc = None
         for shard in shards:
             acc = reduce_fn(acc, map_fn(shard))
@@ -178,6 +199,8 @@ class Executor:
 
     def _execute_bitmap_call(self, idx: Index, c: Call, shards: list[int],
                              opt: ExecOptions) -> Row:
+        planner = self._planner_for(c, opt)
+
         def map_fn(shard):
             return self._bitmap_call_shard(idx, c, shard)
 
@@ -186,7 +209,10 @@ class Executor:
                 return v
             return prev.union(v)  # segments are disjoint by shard
 
-        row = self.map_reduce(idx, shards, c, opt, map_fn, reduce_fn) or Row()
+        local_batch = (lambda shs: planner.execute_bitmap(idx, c, shs)) \
+            if planner is not None else None
+        row = self.map_reduce(idx, shards, c, opt, map_fn, reduce_fn,
+                              local_batch_fn=local_batch) or Row()
 
         # Attach row attributes for plain Row() (executor.go:604-639).
         if c.name == "Row" and not c.has_condition_arg():
@@ -445,11 +471,16 @@ class Executor:
         if len(c.children) != 1:
             raise QueryError("Count() requires a single bitmap input")
 
+        planner = self._planner_for(c.children[0], opt)
+
         def map_fn(shard):
             return self._bitmap_call_shard(idx, c.children[0], shard).count()
 
+        local_batch = (lambda shs: planner.execute_count(idx, c.children[0], shs)) \
+            if planner is not None else None
         return self.map_reduce(idx, shards, c, opt, map_fn,
-                               lambda p, v: (p or 0) + v) or 0
+                               lambda p, v: (p or 0) + v,
+                               local_batch_fn=local_batch) or 0
 
     # ------------------------------------------------------------------
     # TopN (reference executor.go:857 two-pass)
@@ -546,7 +577,10 @@ class Executor:
     # Rows (reference executor.go:1272)
     # ------------------------------------------------------------------
 
-    def _execute_rows(self, idx: Index, c: Call, shards, opt) -> RowIdentifiers:
+    def _execute_rows(self, idx: Index, c: Call, shards, opt) -> list[int]:
+        """Returns raw row ids (reference RowIDs); the public
+        RowIdentifiers wrapping happens in _translate_result, so remote
+        responses stay mergeable (executor.go:1272, :2800)."""
         field_name = c.args.get("field") if isinstance(c.args.get("field"), str) \
             else c.args.get("_field")
         if not isinstance(field_name, str):
@@ -563,8 +597,7 @@ class Executor:
         def reduce_fn(p, v):
             return merge_row_ids(p or [], v, limit)
 
-        rows = self.map_reduce(idx, shards, c, opt, map_fn, reduce_fn) or []
-        return RowIdentifiers(rows=rows)
+        return self.map_reduce(idx, shards, c, opt, map_fn, reduce_fn) or []
 
     def _rows_shard(self, idx: Index, field_name: str, c: Call,
                     shard: int) -> list[int]:
@@ -633,7 +666,7 @@ class Executor:
             _, has_lim = child.uint_arg("limit")
             _, has_col = child.uint_arg("column")
             if has_lim or has_col:
-                ids = self._execute_rows(idx, child, shards, opt).rows
+                ids = self._execute_rows(idx, child, shards, opt)
                 if not ids:
                     return []
                 child_rows[i] = ids
@@ -768,20 +801,25 @@ class Executor:
             row_val, ok = c.int_arg(field_name)
             if not ok:
                 raise QueryError("Set() row argument 'row' required")
-            return f.set_value(col_id, row_val)
-
-        row_arg = c.args.get(field_name)
-        if isinstance(row_arg, bool):
-            row_id = 1 if row_arg else 0
+            apply = lambda: f.set_value(col_id, row_val)
         else:
-            row_id, ok = c.uint_arg(field_name)
-            if not ok:
-                raise QueryError("Set() row argument 'row' required")
+            row_arg = c.args.get(field_name)
+            if isinstance(row_arg, bool):
+                row_id = 1 if row_arg else 0
+            else:
+                row_id, ok = c.uint_arg(field_name)
+                if not ok:
+                    raise QueryError("Set() row argument 'row' required")
+            timestamp = None
+            if "_timestamp" in c.args:
+                timestamp = tq.parse_time(c.args["_timestamp"])
+            apply = lambda: f.set_bit(row_id, col_id, timestamp)
 
-        timestamp = None
-        if "_timestamp" in c.args:
-            timestamp = tq.parse_time(c.args["_timestamp"])
-        return f.set_bit(row_id, col_id, timestamp)
+        if self.cluster is not None:
+            # Replicated write: apply on every owner (executor.go:2144).
+            return self.cluster.write_fanout(
+                idx.name, col_id // SHARD_WIDTH, c, opt, apply)
+        return apply()
 
     def _execute_clear_bit(self, idx: Index, c: Call, opt: ExecOptions) -> bool:
         field_name = c.field_arg()
@@ -800,16 +838,23 @@ class Executor:
             raise QueryError(
                 "column argument to Clear(<COLUMN>, <FIELD>=<ROW>) required")
         if f.field_type == FIELD_TYPE_INT:
-            # Clearing an int value clears the exists bit.
-            v = f.view(view_bsi_name(field_name))
-            if v is None:
-                return False
-            frag = v.fragment(col_id // SHARD_WIDTH)
-            if frag is None:
-                return False
-            from pilosa_tpu.core.fragment import BSI_EXISTS_BIT
-            return frag.clear_bit(BSI_EXISTS_BIT, col_id)
-        return f.clear_bit(row_id, col_id)
+            def apply():
+                # Clearing an int value clears the exists bit.
+                v = f.view(view_bsi_name(field_name))
+                if v is None:
+                    return False
+                frag = v.fragment(col_id // SHARD_WIDTH)
+                if frag is None:
+                    return False
+                from pilosa_tpu.core.fragment import BSI_EXISTS_BIT
+                return frag.clear_bit(BSI_EXISTS_BIT, col_id)
+        else:
+            def apply():
+                return f.clear_bit(row_id, col_id)
+        if self.cluster is not None:
+            return self.cluster.write_fanout(
+                idx.name, col_id // SHARD_WIDTH, c, opt, apply)
+        return apply()
 
     def _execute_clear_row(self, idx: Index, c: Call, shards, opt) -> bool:
         field_name = c.field_arg()
@@ -871,6 +916,8 @@ class Executor:
             raise QueryError("SetRowAttrs() row field 'row' required")
         attrs = {k: v for k, v in c.args.items() if k not in ("_field", "_row")}
         f.row_attr_store.set_attrs(row_id, attrs)
+        if self.cluster is not None:
+            self.cluster.broadcast_call(idx.name, c, opt)
 
     def _execute_set_column_attrs(self, idx: Index, c: Call, opt) -> None:
         col_id, ok = c.uint_arg("_col")
@@ -878,6 +925,8 @@ class Executor:
             raise QueryError("SetColumnAttrs() col required")
         attrs = {k: v for k, v in c.args.items() if k != "_col"}
         idx.column_attr_store.set_attrs(col_id, attrs)
+        if self.cluster is not None:
+            self.cluster.broadcast_call(idx.name, c, opt)
 
     # ------------------------------------------------------------------
     # Options (reference executor.go:360)
@@ -974,13 +1023,15 @@ class Executor:
         if isinstance(result, Row) and idx.options.keys:
             result.keys = [idx.translate_store.translate_id(int(i)) or str(i)
                            for i in result.columns()]
-        elif isinstance(result, RowIdentifiers):
+        elif c.name == "Rows" and isinstance(result, list):
             fname = c.args.get("_field") or c.args.get("field")
             f = idx.field(fname) if isinstance(fname, str) else None
             if f is not None and f.keys:
-                result.keys = [f.translate_store.translate_id(r) or str(r)
-                               for r in result.rows]
-                result.rows = []
+                result = RowIdentifiers(
+                    keys=[f.translate_store.translate_id(r) or str(r)
+                          for r in result])
+            else:
+                result = RowIdentifiers(rows=list(result))
         elif isinstance(result, Pair) and c.name in ("MinRow", "MaxRow"):
             fname = c.args.get("field")
             f = idx.field(fname) if isinstance(fname, str) else None

@@ -79,6 +79,20 @@ def _compare_select(bits, filt, pred_lo, pred_hi, depth: int, op: str, allow_eq:
     return out & filt
 
 
+def range_lt_unsigned_t(bits, filt, lo, hi, depth: int, allow_eq: bool):
+    """Traced-predicate variant: lo/hi are uint32 scalars (device or host).
+    One compiled program serves every predicate magnitude."""
+    return _compare_select(bits, filt, lo, hi, depth, "lt", allow_eq)
+
+
+def range_gt_unsigned_t(bits, filt, lo, hi, depth: int, allow_eq: bool):
+    return _compare_select(bits, filt, lo, hi, depth, "gt", allow_eq)
+
+
+def range_eq_unsigned_t(bits, filt, lo, hi, depth: int):
+    return _compare_select(bits, filt, lo, hi, depth, "eq", True)
+
+
 def range_lt_unsigned(bits, filt, upred: int, depth: int, allow_eq: bool):
     """{col in filt : mag(col) < (<=) upred} — reference rangeLTUnsigned
     (fragment.go:1357)."""

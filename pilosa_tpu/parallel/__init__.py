@@ -1,0 +1,13 @@
+"""Distribution over the TPU mesh.
+
+Maps the reference's shard data-parallelism (executor.go:2455 mapReduce over
+goroutines + HTTP) onto a ``jax.sharding.Mesh``: all shards of a query are
+stacked into ``[S, W]`` blocks laid out over the ``shard`` mesh axis, the
+whole PQL call tree compiles to ONE XLA program, and cross-shard reductions
+(Count/Sum/TopN merges) become ICI collectives inside that program.
+"""
+
+from pilosa_tpu.parallel.mesh import make_mesh, shard_spec
+from pilosa_tpu.parallel.planner import MeshPlanner
+
+__all__ = ["make_mesh", "shard_spec", "MeshPlanner"]

@@ -1,0 +1,40 @@
+"""Device mesh construction for shard-parallel query execution.
+
+The reference hashes shards onto cluster nodes (cluster.go:871-923); here
+shards are laid out round-robin over a 1-D ``('shard',)`` mesh. Multi-host
+runs extend the same mesh over DCN (jax.distributed) — the program doesn't
+change, only the device list does.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+SHARD_AXIS = "shard"
+
+
+def make_mesh(devices=None, n: int | None = None) -> Mesh:
+    """1-D mesh over ``devices`` (default: all local devices, optionally
+    the first ``n``)."""
+    if devices is None:
+        devices = jax.devices()
+    if n is not None:
+        devices = devices[:n]
+    return Mesh(np.asarray(devices), (SHARD_AXIS,))
+
+
+def shard_spec(mesh: Mesh, *, sharded_dim: int = 0, ndim: int = 2) -> NamedSharding:
+    """NamedSharding partitioning dim ``sharded_dim`` over the shard axis."""
+    spec = [None] * ndim
+    spec[sharded_dim] = SHARD_AXIS
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def pad_to_multiple(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m

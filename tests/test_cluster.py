@@ -1,0 +1,213 @@
+"""Cluster-layer tests: placement math, in-process multi-node execution,
+replication, node-failure failover, anti-entropy repair.
+
+Models the reference's cluster_internal_test.go (pure placement math),
+executor_test.go's MustRunCluster(t, 3) mirrors, and the clustertests
+fault-injection suite (pumba pause → degraded reads → repair).
+"""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.cluster import Cluster, Node, fnv1a64, jump_hash, partition
+from pilosa_tpu.cluster.cluster import ShardUnavailableError
+from pilosa_tpu.cluster.harness import LocalCluster
+from pilosa_tpu.cluster.sync import HolderSyncer, merge_block
+from pilosa_tpu.config import SHARD_WIDTH
+from pilosa_tpu.core import Holder, FieldOptions
+from pilosa_tpu.exec import Executor
+
+
+# -- placement math --------------------------------------------------------
+
+def test_fnv1a64_vectors():
+    # Published FNV-1a test vectors.
+    assert fnv1a64(b"") == 0xCBF29CE484222325
+    assert fnv1a64(b"a") == 0xAF63DC4C8601EC8C
+    assert fnv1a64(b"foobar") == 0x85944171F73967E8
+
+
+def test_jump_hash_properties():
+    # Deterministic, in range, and monotone-stable as buckets grow.
+    for key in (0, 1, 12345, 2**63):
+        for n in (1, 2, 3, 8, 100):
+            b = jump_hash(key, n)
+            assert 0 <= b < n
+    # Adding a bucket moves only a ~1/n fraction of keys.
+    moved = sum(jump_hash(k, 8) != jump_hash(k, 9) for k in range(1000))
+    assert moved < 1000 * 0.25
+
+
+def test_partition_stability():
+    p = partition("i", 0)
+    assert partition("i", 0) == p
+    assert 0 <= p < 256
+    assert partition("other", 0) != p or partition("other", 1) != partition("i", 1)
+
+
+def test_shard_nodes_replicas():
+    nodes = [Node(id=f"n{i}") for i in range(4)]
+    c = Cluster("n0", nodes, replica_n=2)
+    owners = c.shard_nodes("i", 0)
+    assert len(owners) == 2
+    assert len({n.id for n in owners}) == 2
+    # All nodes' views agree on placement.
+    c2 = Cluster("n3", [Node(id=f"n{i}") for i in range(4)], replica_n=2)
+    assert [n.id for n in c2.shard_nodes("i", 0)] == [n.id for n in owners]
+
+
+def test_shards_by_node_unavailable():
+    c = Cluster("n0", [Node(id="n0")], replica_n=1)
+    with pytest.raises(ShardUnavailableError):
+        c.shards_by_node([], "i", [0])
+
+
+# -- multi-node execution --------------------------------------------------
+
+def seed_cluster(lc: LocalCluster, n_shards=4, seed=5):
+    lc.create_index("i")
+    lc.create_field("i", "f")
+    lc.create_field("i", "g")
+    rng = np.random.default_rng(seed)
+    total = n_shards * SHARD_WIDTH
+    data = []
+    for field in ("f", "g"):
+        rows = rng.integers(0, 4, 2000)
+        cols = rng.integers(0, total, 2000)
+        data.append((rows, cols))
+        # route writes per shard to owning nodes, like api.Import
+        for shard in range(n_shards):
+            m = (cols // SHARD_WIDTH) == shard
+            if not m.any():
+                continue
+            node = lc[0].cluster.shard_nodes("i", shard)[0]
+            peer = lc.client.peers[node.id]
+            peer.holder.field("i", field).import_bits(rows[m], cols[m])
+    return data
+
+
+def expected_single_node(data, query):
+    h = Holder()
+    idx = h.create_index("i")
+    for name, (rows, cols) in zip(("f", "g"), data):
+        idx.create_field(name).import_bits(rows, cols)
+    return Executor(h).execute("i", query)
+
+
+CLUSTER_QUERIES = [
+    "Count(Row(f=1))",
+    "Count(Intersect(Row(f=1), Row(g=2)))",
+    "Count(Union(Row(f=0), Row(g=3)))",
+    "TopN(f, n=3)",
+    "Rows(f)",
+]
+
+
+@pytest.mark.parametrize("query", CLUSTER_QUERIES)
+def test_three_node_cluster_matches_single_node(query):
+    lc = LocalCluster(3)
+    data = seed_cluster(lc)
+    want = expected_single_node(data, query)
+    for node in range(3):
+        got = lc.query("i", query, node=node)
+        if hasattr(want[0], "columns"):
+            assert np.array_equal(got[0].columns(), want[0].columns())
+        else:
+            assert got == want, (query, node)
+
+
+def test_replicated_write_fanout():
+    lc = LocalCluster(3, replica_n=2)
+    lc.create_index("i")
+    lc.create_field("i", "f")
+    assert lc.query("i", "Set(5, f=1)") == [True]
+    owners = [n.id for n in lc[0].cluster.shard_nodes("i", 0)]
+    for cn in lc.nodes:
+        frag = cn.holder.fragment("i", "f", "standard", 0)
+        if cn.id in owners:
+            assert frag is not None and frag.contains(1, 5), cn.id
+        else:
+            assert frag is None or not frag.contains(1, 5), cn.id
+
+
+def test_attr_broadcast():
+    lc = LocalCluster(3)
+    lc.create_index("i")
+    lc.create_field("i", "f")
+    lc.query("i", 'SetRowAttrs(f, 1, color="red")')
+    for cn in lc.nodes:
+        assert cn.holder.field("i", "f").row_attr_store.attrs(1) == \
+            {"color": "red"}
+
+
+def test_failover_with_replicas():
+    """Node goes down; reads fail over to replicas (executor.go:2492)."""
+    lc = LocalCluster(3, replica_n=2)
+    lc.create_index("i")
+    lc.create_field("i", "f")
+    # Write through the cluster so replicas hold copies.
+    cols = [1, SHARD_WIDTH + 2, 2 * SHARD_WIDTH + 3]
+    for c in cols:
+        lc.query("i", f"Set({c}, f=7)")
+    assert lc.query("i", "Count(Row(f=7))") == [3]
+    # Fault injection: pause whichever non-coordinator node owns a shard.
+    lc.down("node1")
+    assert lc.query("i", "Count(Row(f=7))", node=0) == [3]
+    assert lc[0].cluster.state == "DEGRADED"
+    lc.up("node1")
+    assert lc[0].cluster.state == "NORMAL"
+
+
+def test_failover_without_replicas_fails():
+    lc = LocalCluster(3, replica_n=1)
+    lc.create_index("i")
+    lc.create_field("i", "f")
+    for s in range(3):
+        lc.query("i", f"Set({s * SHARD_WIDTH}, f=1)")
+    lc.down("node1")
+    owned_by_down = [s for s in range(3)
+                     if lc[0].cluster.shard_nodes("i", s)[0].id == "node1"]
+    if owned_by_down:
+        with pytest.raises(ShardUnavailableError):
+            lc.query("i", "Count(Row(f=1))", node=0)
+
+
+# -- anti-entropy ----------------------------------------------------------
+
+def test_merge_block_majority():
+    e = np.empty(0, np.uint64)
+    local = (np.array([1, 2], np.uint64), np.array([10, 20], np.uint64))
+    r1 = (np.array([1], np.uint64), np.array([10], np.uint64))
+    r2 = (np.array([1, 3], np.uint64), np.array([10, 30], np.uint64))
+    (lsets, lclears), remote = merge_block(local, [r1, r2])
+    # bit (1,10): on all -> kept. (2,20): 1/3 -> cleared locally.
+    # (3,30): 1/3 -> cleared on r2. majorityN = 2.
+    assert lsets[0].tolist() == [] and lclears[0].tolist() == [2]
+    (r1s, r1c), (r2s, r2c) = remote
+    assert r1s[0].tolist() == [] and r1c[0].tolist() == []
+    assert r2c[0].tolist() == [3]
+
+
+def test_merge_block_even_split_keeps():
+    local = (np.array([1], np.uint64), np.array([10], np.uint64))
+    r1 = (np.empty(0, np.uint64), np.empty(0, np.uint64))
+    (lsets, lclears), remote = merge_block(local, [r1])
+    # 1 of 2 present, majorityN = (2+1)//2 = 1 -> kept; replica gets a set.
+    assert lclears[0].tolist() == []
+    assert remote[0][0][0].tolist() == [1]
+
+
+def test_holder_syncer_repairs_replicas():
+    lc = LocalCluster(3, replica_n=2)
+    lc.create_index("i")
+    lc.create_field("i", "f")
+    lc.query("i", "Set(5, f=1) Set(6, f=1)")
+    owners = lc[0].cluster.shard_nodes("i", 0)
+    # Corrupt one replica: drop a bit directly.
+    victim = lc.client.peers[owners[1].id]
+    victim.holder.fragment("i", "f", "standard", 0).clear_bit(1, 6)
+    primary = lc.client.peers[owners[0].id]
+    syncer = HolderSyncer(primary.holder, primary.cluster, lc.client)
+    repaired = syncer.sync_holder()
+    assert repaired >= 1
+    assert victim.holder.fragment("i", "f", "standard", 0).contains(1, 6)
