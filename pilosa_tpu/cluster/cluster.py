@@ -177,6 +177,10 @@ class Cluster:
                 if local_apply():
                     ret = True
             elif not opt.remote:
+                if node.state == "DOWN":
+                    # Skip lost replicas; anti-entropy repairs them on
+                    # rejoin (holder.go:911 SyncHolder).
+                    continue
                 res = self.client.query_node(node, idx_name, str(c), None,
                                              remote=True)
                 if res and res[0]:

@@ -72,7 +72,8 @@ class ClusterNode:
                       clear=False):
         f = self.holder.field(index, field)
         if f is None:
-            return
+            # Schema drift must surface, not silently drop repair data.
+            raise LookupError(f"field not found: {index}/{field}")
         v = f.create_view_if_not_exists(view)
         frag = v.create_fragment_if_not_exists(shard)
         frag.bulk_import(rows, cols, clear=clear)

@@ -126,10 +126,16 @@ class HolderSyncer:
             if all(pb.get(b) == local_blocks.get(b) for pb in peer_blocks):
                 continue
             local_pairs = frag.block_data(b)
-            remote_pairs = []
+            remote_pairs, reachable = [], []
             for node in live:
-                remote_pairs.append(self.client.fragment_block_data(
-                    node, index_name, field_name, view_name, shard, b))
+                try:
+                    remote_pairs.append(self.client.fragment_block_data(
+                        node, index_name, field_name, view_name, shard, b))
+                    reachable.append(node)
+                except ConnectionError:
+                    continue  # peer died mid-sync: merge with the rest
+            if not reachable:
+                continue
             (lsets, lclears), remote_diffs = merge_block(local_pairs, remote_pairs)
             if len(lsets[0]):
                 frag.bulk_import(lsets[0].tolist(), lsets[1].tolist())
@@ -138,15 +144,18 @@ class HolderSyncer:
                 frag.bulk_import(lclears[0].tolist(), lclears[1].tolist(),
                                  clear=True)
                 changed = True
-            for node, (rsets, rclears) in zip(live, remote_diffs):
-                if len(rsets[0]):
-                    self.client.import_bits(
-                        node, index_name, field_name, view_name, shard,
-                        rsets[0].tolist(), rsets[1].tolist(), False)
-                    changed = True
-                if len(rclears[0]):
-                    self.client.import_bits(
-                        node, index_name, field_name, view_name, shard,
-                        rclears[0].tolist(), rclears[1].tolist(), True)
-                    changed = True
+            for node, (rsets, rclears) in zip(reachable, remote_diffs):
+                try:
+                    if len(rsets[0]):
+                        self.client.import_bits(
+                            node, index_name, field_name, view_name, shard,
+                            rsets[0].tolist(), rsets[1].tolist(), False)
+                        changed = True
+                    if len(rclears[0]):
+                        self.client.import_bits(
+                            node, index_name, field_name, view_name, shard,
+                            rclears[0].tolist(), rclears[1].tolist(), True)
+                        changed = True
+                except (ConnectionError, LookupError):
+                    continue  # next sync pass retries this peer
         return changed

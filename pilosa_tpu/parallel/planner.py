@@ -22,6 +22,7 @@ repeated queries re-upload nothing.
 from __future__ import annotations
 
 import functools
+from collections import OrderedDict
 from typing import Any, Callable
 
 import jax
@@ -55,12 +56,20 @@ _BITMAP_CALLS = frozenset(
 class MeshPlanner:
     """Shard-stacked SPMD execution of bitmap call trees."""
 
-    def __init__(self, holder, mesh=None):
+    #: default device-memory budget for cached leaf stacks (bytes).
+    DEFAULT_CACHE_BYTES = 4 << 30
+
+    def __init__(self, holder, mesh=None,
+                 max_cache_bytes: int = DEFAULT_CACHE_BYTES):
         self.holder = holder
         self.mesh = mesh if mesh is not None else make_mesh()
         self.n_devices = int(np.prod(self.mesh.devices.shape))
-        #: (index, field, view, row_id, shards) -> (gens, [S, W] device array)
-        self._stack_cache: dict[tuple, tuple[tuple, jax.Array]] = {}
+        #: LRU of (index, field, view, row_id, shards) ->
+        #: (gens, [S, W] device array); bounded by max_cache_bytes.
+        self._stack_cache: "OrderedDict[tuple, tuple[tuple, jax.Array]]" = \
+            OrderedDict()
+        self._cache_bytes = 0
+        self.max_cache_bytes = max_cache_bytes
         #: structural signature -> jitted tree evaluator
         self._fn_cache: dict[tuple, Callable] = {}
 
@@ -109,6 +118,7 @@ class MeshPlanner:
 
     def invalidate(self) -> None:
         self._stack_cache.clear()
+        self._cache_bytes = 0
 
     # ------------------------------------------------------------------
     # tree → structural signature + leaf list
@@ -253,6 +263,7 @@ class MeshPlanner:
         gens = self._gens(field_name, view, shards)
         hit = self._stack_cache.get(key)
         if hit is not None and hit[0] == gens:
+            self._stack_cache.move_to_end(key)
             return hit[1]
         s_pad = self._pad(len(shards))
         mat = np.zeros((s_pad, WORDS_PER_SHARD), dtype=np.uint32)
@@ -261,7 +272,16 @@ class MeshPlanner:
             if frag is not None:
                 mat[i] = frag.row_words(row_id)
         arr = jax.device_put(mat, shard_spec(self.mesh))
+        nbytes = mat.nbytes
+        if hit is not None:
+            self._cache_bytes -= hit[1].nbytes
+            del self._stack_cache[key]
+        while (self._stack_cache
+               and self._cache_bytes + nbytes > self.max_cache_bytes):
+            _, (g, old) = self._stack_cache.popitem(last=False)
+            self._cache_bytes -= old.nbytes
         self._stack_cache[key] = (gens, arr)
+        self._cache_bytes += nbytes
         return arr
 
     def _fetch_leaf(self, idx: Index, leaf: tuple, shards: tuple):
