@@ -44,10 +44,12 @@ def main() -> None:
     # Two bitmap rows ("f=1", "g=2") over n_shards shards, ~DENSITY fill.
     # Dense uint32 blocks — exactly the planner's leaf layout.
     def random_blocks():
+        import math
         words = rng.integers(0, 1 << 32, size=(n_shards, WORDS_PER_SHARD),
                              dtype=np.uint32)
-        # AND of k random masks ≈ density 2^-k; k=4 -> ~6%.
-        for _ in range(3):
+        # AND of k random masks ≈ density 2^-k (one mask ≈ 0.5).
+        k = max(1, round(-math.log2(max(DENSITY, 1e-9))))
+        for _ in range(k - 1):
             words &= rng.integers(0, 1 << 32, size=words.shape, dtype=np.uint32)
         return words
 

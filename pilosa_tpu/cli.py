@@ -1,0 +1,265 @@
+"""Command-line interface.
+
+Reference: cmd/ (cobra tree, cmd/root.go:28 — server, import, export,
+check, inspect, config, generate-config) with command bodies in ctl/.
+Config precedence matches the reference (cmd/root.go:46-60):
+flags > env (PILOSA_TPU_*) > TOML file.
+
+Usage::
+
+    python -m pilosa_tpu.cli server --bind 127.0.0.1:10101 --data-dir ./data
+    python -m pilosa_tpu.cli import --host ... <index> <field> rows.csv
+    python -m pilosa_tpu.cli export --host ... <index> <field>
+    python -m pilosa_tpu.cli check ./data
+    python -m pilosa_tpu.cli inspect ./data
+    python -m pilosa_tpu.cli config | generate-config
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+
+_DEFAULTS = {
+    "bind": "127.0.0.1:10101",
+    "data_dir": "",
+    "peers": "",
+    "replica_n": 1,
+    "anti_entropy_interval": 0.0,
+    "planner": True,
+}
+
+
+def _load_config(path: str | None) -> dict:
+    cfg = dict(_DEFAULTS)
+    if path:
+        import tomllib
+        with open(path, "rb") as f:
+            for k, v in tomllib.load(f).items():
+                cfg[k.replace("-", "_")] = v
+    for k in cfg:
+        env = os.environ.get(f"PILOSA_TPU_{k.upper()}")
+        if env is not None:
+            cur = cfg[k]
+            if isinstance(cur, bool):
+                cfg[k] = env.lower() in ("1", "true", "yes")
+            elif isinstance(cur, int):
+                cfg[k] = int(env)
+            elif isinstance(cur, float):
+                cfg[k] = float(env)
+            else:
+                cfg[k] = env
+    return cfg
+
+
+def cmd_server(args) -> int:
+    cfg = _load_config(args.config)
+    if args.bind:
+        cfg["bind"] = args.bind
+    if args.data_dir:
+        cfg["data_dir"] = args.data_dir
+    if args.peers:
+        cfg["peers"] = args.peers
+    if args.replica_n:
+        cfg["replica_n"] = args.replica_n
+    if args.no_planner:
+        cfg["planner"] = False
+
+    from pilosa_tpu.server.node import ServerNode
+    node = ServerNode(
+        bind=cfg["bind"],
+        peers=[p for p in str(cfg["peers"]).split(",") if p],
+        replica_n=int(cfg["replica_n"]),
+        use_planner=bool(cfg["planner"]),
+        anti_entropy_interval=float(cfg["anti_entropy_interval"]),
+        data_dir=cfg["data_dir"] or None,
+    )
+    node.open()
+    print(f"pilosa-tpu serving at {node.address}", file=sys.stderr)
+    try:
+        node.http.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        node.close()
+    return 0
+
+
+def _post(host: str, path: str, body: bytes) -> dict:
+    req = urllib.request.Request(f"http://{host}{path}", data=body,
+                                 method="POST")
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        return json.loads(resp.read() or b"{}")
+
+
+def cmd_import(args) -> int:
+    """CSV (row,col[,timestamp]) -> batched imports, like ctl/import.go:
+    parse, buffer, send per batch."""
+    rows, cols, stamps = [], [], []
+    has_ts = False
+
+    def flush():
+        nonlocal rows, cols, stamps
+        if not rows:
+            return
+        body: dict = {"rowIDs": rows, "columnIDs": cols}
+        if has_ts:
+            body["timestamps"] = stamps
+        _post(args.host, f"/index/{args.index}/field/{args.field}/import"
+                         + ("?clear=1" if args.clear else ""),
+              json.dumps(body).encode())
+        rows, cols, stamps = [], [], []
+
+    for path in args.files:
+        f = sys.stdin if path == "-" else open(path)
+        try:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                parts = line.split(",")
+                rows.append(int(parts[0]))
+                cols.append(int(parts[1]))
+                if len(parts) > 2:
+                    has_ts = True
+                    stamps.append(parts[2])
+                else:
+                    stamps.append(None)
+                if len(rows) >= args.buffer_size:
+                    flush()
+        finally:
+            if f is not sys.stdin:
+                f.close()
+    flush()
+    return 0
+
+
+def cmd_export(args) -> int:
+    shards = [args.shard] if args.shard is not None else None
+    if shards is None:
+        with urllib.request.urlopen(
+                f"http://{args.host}/internal/shards/max", timeout=60) as r:
+            mx = json.loads(r.read())["standard"].get(args.index, 0)
+        shards = list(range(mx + 1))
+    for shard in shards:
+        url = (f"http://{args.host}/export?index={args.index}"
+               f"&field={args.field}&shard={shard}")
+        try:
+            with urllib.request.urlopen(url, timeout=60) as r:
+                sys.stdout.write(r.read().decode())
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                continue  # sparse shard with no fragment
+            raise
+    return 0
+
+
+def cmd_check(args) -> int:
+    """Offline consistency check of a data dir (ctl/check.go:30)."""
+    from pilosa_tpu.storage.wal import WalReader
+    import numpy as np
+    bad = 0
+    for root, _, files in os.walk(args.data_dir):
+        for fn in files:
+            p = os.path.join(root, fn)
+            if fn.endswith(".wal"):
+                n = sum(1 for _ in WalReader(p))
+                print(f"ok wal   {p} ({n} ops)")
+            elif fn.endswith(".snap"):
+                try:
+                    with np.load(p) as z:
+                        n = len(z["row_ids"])
+                    print(f"ok snap  {p} ({n} rows)")
+                except Exception as e:
+                    print(f"BAD snap {p}: {e}")
+                    bad += 1
+            elif fn.endswith(".tmp"):
+                print(f"stale tmp {p} (crash leftover; safe to delete)")
+    return 1 if bad else 0
+
+
+def cmd_inspect(args) -> int:
+    """Per-fragment stats of a data dir (ctl/inspect.go analog)."""
+    import numpy as np
+    for root, _, files in os.walk(args.data_dir):
+        for fn in sorted(files):
+            if not fn.endswith(".snap"):
+                continue
+            p = os.path.join(root, fn)
+            with np.load(p) as z:
+                rows = len(z["row_ids"])
+                bits = len(z["positions"])
+            rel = os.path.relpath(p, args.data_dir)
+            print(f"{rel}: rows={rows} bits={bits}")
+    return 0
+
+
+def cmd_config(args) -> int:
+    print(json.dumps(_load_config(args.config), indent=2))
+    return 0
+
+
+def cmd_generate_config(args) -> int:
+    print('bind = "127.0.0.1:10101"\n'
+          'data-dir = ""\n'
+          'peers = ""\n'
+          'replica-n = 1\n'
+          'anti-entropy-interval = 0.0\n'
+          'planner = true')
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="pilosa-tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("server", help="run a node")
+    s.add_argument("--bind", default="")
+    s.add_argument("--data-dir", default="")
+    s.add_argument("--peers", default="", help="comma-separated host:port")
+    s.add_argument("--replica-n", type=int, default=0)
+    s.add_argument("--no-planner", action="store_true")
+    s.add_argument("--config", default=None)
+    s.set_defaults(fn=cmd_server)
+
+    s = sub.add_parser("import", help="bulk import CSV")
+    s.add_argument("--host", default="127.0.0.1:10101")
+    s.add_argument("--buffer-size", type=int, default=100_000)
+    s.add_argument("--clear", action="store_true")
+    s.add_argument("index")
+    s.add_argument("field")
+    s.add_argument("files", nargs="+")
+    s.set_defaults(fn=cmd_import)
+
+    s = sub.add_parser("export", help="export CSV")
+    s.add_argument("--host", default="127.0.0.1:10101")
+    s.add_argument("--shard", type=int, default=None)
+    s.add_argument("index")
+    s.add_argument("field")
+    s.set_defaults(fn=cmd_export)
+
+    s = sub.add_parser("check", help="offline data-dir consistency check")
+    s.add_argument("data_dir")
+    s.set_defaults(fn=cmd_check)
+
+    s = sub.add_parser("inspect", help="data-dir fragment stats")
+    s.add_argument("data_dir")
+    s.set_defaults(fn=cmd_inspect)
+
+    s = sub.add_parser("config", help="print resolved config")
+    s.add_argument("--config", default=None)
+    s.set_defaults(fn=cmd_config)
+
+    s = sub.add_parser("generate-config", help="print default TOML config")
+    s.set_defaults(fn=cmd_generate_config)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

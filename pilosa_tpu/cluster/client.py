@@ -102,3 +102,10 @@ class LocalClient:
         """Control-plane broadcast (reference /internal/cluster/message,
         broadcast.go:55-72)."""
         return self._peer(node).handle_message(message)
+
+    def send_import(self, node, index, field, shard, rows=None, cols=None,
+                    values=None, timestamps=None, clear=False):
+        """Field-level import routed to an owning node (api.go:967)."""
+        return self._peer(node).handle_import_request(
+            index, field, rows=rows, cols=cols, values=values,
+            timestamps=timestamps, clear=clear)

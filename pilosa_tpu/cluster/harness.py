@@ -14,9 +14,36 @@ from typing import Any
 from pilosa_tpu.cluster.client import LocalClient
 from pilosa_tpu.cluster.cluster import STATE_NORMAL, Cluster
 from pilosa_tpu.cluster.node import URI, Node
+from pilosa_tpu.core.field import FieldOptions
 from pilosa_tpu.core.holder import Holder
 from pilosa_tpu.core.index import IndexOptions
 from pilosa_tpu.exec.executor import ExecOptions, Executor
+
+
+def handle_cluster_message(holder: Holder, message: dict) -> None:
+    """Apply a control-plane message to a node's holder (the 16 message
+    types of broadcast.go:55-72; schema + shard availability subset)."""
+    t = message.get("type")
+    if t == "create-shard":
+        f = holder.field(message["index"], message["field"])
+        if f is not None:
+            f.add_remote_available_shards([message["shard"]])
+    elif t == "create-index":
+        holder.create_index_if_not_exists(
+            message["index"], IndexOptions.from_json(message.get("options", {})))
+    elif t == "delete-index":
+        if holder.index(message["index"]) is not None:
+            holder.delete_index(message["index"])
+    elif t == "create-field":
+        idx = holder.index(message["index"])
+        if idx is not None:
+            idx.create_field_if_not_exists(
+                message["field"],
+                FieldOptions.from_json(message.get("options", {})))
+    elif t == "delete-field":
+        idx = holder.index(message["index"])
+        if idx is not None and idx.field(message["field"]) is not None:
+            idx.delete_field(message["field"])
 
 
 class ClusterNode:
@@ -45,10 +72,24 @@ class ClusterNode:
                 pass  # best-effort, like the 50ms-timeout broadcast
 
     def handle_message(self, message: dict) -> None:
-        if message.get("type") == "create-shard":
-            f = self.holder.field(message["index"], message["field"])
-            if f is not None:
-                f.add_remote_available_shards([message["shard"]])
+        handle_cluster_message(self.holder, message)
+
+    def handle_import_request(self, index, field, rows=None, cols=None,
+                              values=None, timestamps=None,
+                              clear=False) -> None:
+        from pilosa_tpu.core import timequantum as tq
+        f = self.holder.field(index, field)
+        if f is None:
+            raise LookupError(f"field not found: {index}/{field}")
+        if values is not None:
+            f.import_values(cols, values, clear=clear)
+        else:
+            ts = None
+            if timestamps is not None:
+                ts = [tq.parse_time(t) if t else None for t in timestamps]
+            f.import_bits(rows, cols, ts, clear=clear)
+        # Owners track existence locally (executor.go:2096 analog).
+        self.holder.index(index).add_existence(cols)
 
     # -- request handlers (the "server" surface) ---------------------------
 

@@ -113,6 +113,11 @@ class HolderSyncer:
                 peer_blocks.append(self.client.fragment_blocks(
                     node, index_name, field_name, view_name, shard))
                 live.append(node)
+            except LookupError:
+                # Replica lacks the fragment entirely: empty block set —
+                # every local block diffs and gets pushed.
+                peer_blocks.append({})
+                live.append(node)
             except ConnectionError:
                 continue
         if not live:
@@ -127,10 +132,14 @@ class HolderSyncer:
                 continue
             local_pairs = frag.block_data(b)
             remote_pairs, reachable = [], []
+            empty = (np.empty(0, np.uint64), np.empty(0, np.uint64))
             for node in live:
                 try:
                     remote_pairs.append(self.client.fragment_block_data(
                         node, index_name, field_name, view_name, shard, b))
+                    reachable.append(node)
+                except LookupError:
+                    remote_pairs.append(empty)
                     reachable.append(node)
                 except ConnectionError:
                     continue  # peer died mid-sync: merge with the rest

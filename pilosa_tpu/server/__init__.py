@@ -1,0 +1,12 @@
+"""Node server: API facade + HTTP transport.
+
+Reference: api.go (API :42, the complete public method surface
+:135-1323), http/handler.go (router :274), http/client.go (InternalClient
+impl :37), server.go (Server orchestration :46).
+"""
+
+from pilosa_tpu.server.api import API
+from pilosa_tpu.server.httpd import HTTPServer
+from pilosa_tpu.server.httpclient import HTTPInternalClient
+
+__all__ = ["API", "HTTPServer", "HTTPInternalClient"]

@@ -1,0 +1,286 @@
+"""API — the complete externally-reachable operation surface.
+
+Reference: api.go (API struct :42, Query :135, index/field CRUD :162-467,
+Import/ImportValue :920-1127, ExportCSV :500, schema :726-758, Status and
+cluster ops :1129-1260). Every HTTP/CLI entry point goes through here; the
+HTTP layer is a thin router over these methods.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Any, Iterable
+
+from pilosa_tpu.config import SHARD_WIDTH
+from pilosa_tpu.core import timequantum as tq
+from pilosa_tpu.core.field import FieldOptions
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.core.index import IndexOptions
+from pilosa_tpu.core.row import Row
+from pilosa_tpu.errors import (
+    FieldNotFoundError,
+    FragmentNotFoundError,
+    IndexNotFoundError,
+)
+from pilosa_tpu.exec.executor import ExecOptions, Executor
+from pilosa_tpu.exec.result import result_to_json
+from pilosa_tpu.pql import parse
+
+
+class API:
+    """Reference API (api.go:42)."""
+
+    def __init__(self, holder: Holder, executor: Executor, cluster=None,
+                 syncer=None):
+        self.holder = holder
+        self.executor = executor
+        self.cluster = cluster
+        self.syncer = syncer
+
+    # -- query (api.go:135) ------------------------------------------------
+
+    def query(self, index: str, query: str,
+              shards: list[int] | None = None, column_attrs: bool = False,
+              exclude_row_attrs: bool = False, exclude_columns: bool = False,
+              remote: bool = False) -> dict:
+        """Execute PQL; returns the QueryResponse JSON dict
+        ({"results": [...]} shape, handler.go:60-75)."""
+        opt = ExecOptions(remote=remote, column_attrs=column_attrs,
+                          exclude_row_attrs=exclude_row_attrs,
+                          exclude_columns=exclude_columns)
+        results = self.executor.execute(index, query, shards=shards, opt=opt)
+        if remote:
+            # Node-to-node response: typed envelope the coordinator can
+            # decode back to internal results (encoding/proto analog).
+            from pilosa_tpu.server import wire
+            return {"results": [wire.encode_result(r) for r in results]}
+        resp: dict[str, Any] = {"results": [result_to_json(r) for r in results]}
+        if opt.column_attrs:
+            resp["columnAttrs"] = self._column_attr_sets(index, results)
+        return resp
+
+    def _column_attr_sets(self, index: str, results: list) -> list[dict]:
+        """Attrs of every column appearing in Row results
+        (reference executor.go ColumnAttrSets assembly)."""
+        idx = self.holder.index_or_raise(index)
+        cols: set[int] = set()
+        for r in results:
+            if isinstance(r, Row):
+                cols.update(int(c) for c in r.columns())
+        out = []
+        for c in sorted(cols):
+            attrs = idx.column_attr_store.attrs(c)
+            if attrs:
+                out.append({"id": c, "attrs": attrs})
+        return out
+
+    # -- schema CRUD (api.go:162-467) --------------------------------------
+
+    def create_index(self, name: str, options: dict | None = None):
+        idx = self.holder.create_index(
+            name, IndexOptions.from_json(options or {}))
+        self._broadcast({"type": "create-index", "index": name,
+                         "options": options or {}})
+        return idx
+
+    def delete_index(self, name: str) -> None:
+        self.holder.delete_index(name)
+        self._broadcast({"type": "delete-index", "index": name})
+
+    def create_field(self, index: str, field: str,
+                     options: dict | None = None):
+        idx = self.holder.index_or_raise(index)
+        f = idx.create_field(field, FieldOptions.from_json(options or {}))
+        self._broadcast({"type": "create-field", "index": index,
+                         "field": field, "options": options or {}})
+        return f
+
+    def delete_field(self, index: str, field: str) -> None:
+        idx = self.holder.index_or_raise(index)
+        idx.delete_field(field)
+        self._broadcast({"type": "delete-field", "index": index,
+                         "field": field})
+
+    def schema(self) -> list[dict]:
+        return self.holder.schema()
+
+    def apply_schema(self, schema: list[dict]) -> None:
+        self.holder.apply_schema(schema)
+
+    def index_info(self, index: str) -> dict:
+        return self.holder.index_or_raise(index).info()
+
+    # -- imports (api.go:920-1127) -----------------------------------------
+
+    def import_bits(self, index: str, field: str, row_ids: Iterable[int],
+                    column_ids: Iterable[int],
+                    timestamps: Iterable[int | None] | None = None,
+                    row_keys: Iterable[str] | None = None,
+                    column_keys: Iterable[str] | None = None,
+                    clear: bool = False) -> None:
+        """Batch bit import with key translation; routes each shard's
+        batch to owning nodes when clustered."""
+        idx = self.holder.index_or_raise(index)
+        f = idx.field(field)
+        if f is None:
+            raise FieldNotFoundError()
+        if row_keys is not None:
+            row_ids = [f.translate_store.translate_key(k) for k in row_keys]
+        if column_keys is not None:
+            column_ids = [idx.translate_store.translate_key(k)
+                          for k in column_keys]
+        ts = None
+        if timestamps is not None:
+            ts = [tq.parse_time(t) if t else None for t in timestamps]
+        row_ids = list(row_ids)
+        column_ids = list(column_ids)
+        if self.cluster is not None:
+            self._route_import(index, field, row_ids, column_ids, ts, clear,
+                               values=None)
+        else:
+            f.import_bits(row_ids, column_ids, ts, clear=clear)
+        idx.add_existence(column_ids)
+
+    def import_values(self, index: str, field: str,
+                      column_ids: Iterable[int], values: Iterable[int],
+                      column_keys: Iterable[str] | None = None,
+                      clear: bool = False) -> None:
+        idx = self.holder.index_or_raise(index)
+        f = idx.field(field)
+        if f is None:
+            raise FieldNotFoundError()
+        if column_keys is not None:
+            column_ids = [idx.translate_store.translate_key(k)
+                          for k in column_keys]
+        column_ids = list(column_ids)
+        values = list(values)
+        if self.cluster is not None:
+            self._route_import(index, field, None, column_ids, None, clear,
+                               values=values)
+        else:
+            f.import_values(column_ids, values, clear=clear)
+        idx.add_existence(column_ids)
+
+    def _route_import(self, index, field, row_ids, column_ids, ts, clear,
+                      values):
+        """Group by shard, send each batch to every owning node
+        (api.go:967-1030)."""
+        by_shard: dict[int, list[int]] = {}
+        for i, cid in enumerate(column_ids):
+            by_shard.setdefault(cid // SHARD_WIDTH, []).append(i)
+        f = self.holder.field(index, field)
+        for shard, idxs in by_shard.items():
+            cols = [column_ids[i] for i in idxs]
+            for node in self.cluster.shard_nodes(index, shard):
+                if node.id == self.cluster.local_id:
+                    if values is None:
+                        f.import_bits([row_ids[i] for i in idxs], cols,
+                                      [ts[i] for i in idxs] if ts else None,
+                                      clear=clear)
+                    else:
+                        f.import_values(cols, [values[i] for i in idxs],
+                                        clear=clear)
+                else:
+                    ts_out = None
+                    if ts is not None:
+                        from pilosa_tpu.config import TIME_FORMAT
+                        ts_out = [t.strftime(TIME_FORMAT) if t else None
+                                  for t in (ts[i] for i in idxs)]
+                    self.cluster.client.send_import(
+                        node, index, field, shard,
+                        rows=[row_ids[i] for i in idxs] if row_ids else None,
+                        cols=cols,
+                        values=[values[i] for i in idxs] if values else None,
+                        timestamps=ts_out, clear=clear)
+
+    # -- export (api.go:500) -----------------------------------------------
+
+    def export_csv(self, index: str, field: str, shard: int) -> str:
+        """CSV of row,col (or keys) for one shard (reference exportShard)."""
+        idx = self.holder.index_or_raise(index)
+        f = idx.field(field)
+        if f is None:
+            raise FieldNotFoundError()
+        frag = self.holder.fragment(index, field, "standard", shard)
+        if frag is None:
+            raise FragmentNotFoundError()
+        buf = io.StringIO()
+        for rid in frag.row_ids():
+            hr = frag.rows[rid]
+            base = shard * SHARD_WIDTH
+            for pos in hr.to_positions():
+                col = int(pos) + base
+                if f.keys:
+                    rk = f.translate_store.translate_id(rid) or str(rid)
+                else:
+                    rk = str(rid)
+                if idx.options.keys:
+                    ck = idx.translate_store.translate_id(col) or str(col)
+                else:
+                    ck = str(col)
+                buf.write(f"{rk},{ck}\n")
+        return buf.getvalue()
+
+    # -- cluster/status (api.go:726-1260) ----------------------------------
+
+    def status(self) -> dict:
+        if self.cluster is None:
+            return {"state": "NORMAL", "nodes": [], "localID": "standalone"}
+        return {
+            "state": self.cluster.state,
+            "nodes": [n.to_json() for n in self.cluster.nodes],
+            "localID": self.cluster.local_id,
+        }
+
+    def hosts(self) -> list[dict]:
+        if self.cluster is None:
+            return []
+        return [n.to_json() for n in self.cluster.nodes]
+
+    def info(self) -> dict:
+        import pilosa_tpu
+        return {"shardWidth": SHARD_WIDTH,
+                "version": pilosa_tpu.__version__}
+
+    def max_shards(self) -> dict:
+        return {name: max(self.holder.index(name).available_shards())
+                for name in self.holder.index_names()}
+
+    def translate_keys(self, index: str, field: str | None,
+                       keys: list[str]) -> list[int]:
+        idx = self.holder.index_or_raise(index)
+        if field:
+            f = idx.field(field)
+            if f is None:
+                raise FieldNotFoundError()
+            return [f.translate_store.translate_key(k) for k in keys]
+        return [idx.translate_store.translate_key(k) for k in keys]
+
+    def recalculate_caches(self) -> None:
+        """Row counts are maintained exactly; nothing to rebuild. Kept for
+        route parity (api.go RecalculateCaches)."""
+
+    # -- internals ---------------------------------------------------------
+
+    def fragment_blocks(self, index, field, view, shard) -> dict[int, bytes]:
+        frag = self.holder.fragment(index, field, view, shard)
+        if frag is None:
+            raise FragmentNotFoundError()
+        return frag.checksum_blocks()
+
+    def fragment_block_data(self, index, field, view, shard, block):
+        frag = self.holder.fragment(index, field, view, shard)
+        if frag is None:
+            raise FragmentNotFoundError()
+        return frag.block_data(block)
+
+    def _broadcast(self, message: dict) -> None:
+        if self.cluster is None:
+            return
+        for node in self.cluster.nodes:
+            if node.id == self.cluster.local_id or node.state == "DOWN":
+                continue
+            try:
+                self.cluster.client.send_message(node, message)
+            except (ConnectionError, RuntimeError):
+                pass

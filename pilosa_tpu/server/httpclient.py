@@ -1,0 +1,100 @@
+"""InternalClient over HTTP — the real-cluster transport.
+
+Reference: http/client.go:37 (queries via POST /index/{i}/query with
+remote=true, fragment sync via /internal/fragment/*, messages via
+/internal/cluster/message). JSON bodies; stdlib urllib, no dependencies.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Any
+
+import numpy as np
+
+from pilosa_tpu.cluster.node import Node
+
+
+class HTTPInternalClient:
+    """Implements the InternalClient protocol against peer HTTP servers."""
+
+    def __init__(self, timeout: float = 30.0):
+        self.timeout = timeout
+
+    def _url(self, node: Node, path: str) -> str:
+        return f"{node.uri}{path}"
+
+    def _request(self, node: Node, method: str, path: str,
+                 body: bytes | None = None) -> Any:
+        req = urllib.request.Request(self._url(node, path), data=body,
+                                     method=method)
+        if body is not None:
+            req.add_header("Content-Type", "application/json")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                data = resp.read()
+        except urllib.error.HTTPError as e:
+            # The peer is alive but rejected the request — application
+            # error, NOT a connection failure (failover must not trigger).
+            detail = e.read().decode(errors="replace")
+            if e.code == 404:
+                raise LookupError(f"{node.id}: {detail}") from e
+            raise RuntimeError(f"node {node.id} HTTP {e.code}: {detail}") from e
+        except (urllib.error.URLError, OSError) as e:
+            raise ConnectionError(f"node {node.id} unreachable: {e}") from e
+        return json.loads(data) if data else {}
+
+    # -- InternalClient protocol -------------------------------------------
+
+    def query_node(self, node: Node, index: str, query: str,
+                   shards: list[int] | None, remote: bool = True):
+        path = f"/index/{index}/query?remote={'true' if remote else 'false'}"
+        if shards:
+            path += "&shards=" + ",".join(str(s) for s in shards)
+        resp = self._request(node, "POST", path, query.encode())
+        if "error" in resp:
+            raise RuntimeError(resp["error"])
+        if remote:
+            from pilosa_tpu.server import wire
+            return [wire.decode_result(r) for r in resp["results"]]
+        return resp["results"]
+
+    def fragment_blocks(self, node, index, field, view, shard):
+        resp = self._request(
+            node, "GET",
+            f"/internal/fragment/blocks?index={index}&field={field}"
+            f"&view={view}&shard={shard}")
+        return {b["id"]: bytes.fromhex(b["checksum"])
+                for b in resp.get("blocks", [])}
+
+    def fragment_block_data(self, node, index, field, view, shard, block):
+        resp = self._request(
+            node, "GET",
+            f"/internal/fragment/block/data?index={index}&field={field}"
+            f"&view={view}&shard={shard}&block={block}")
+        return (np.asarray(resp["rowIDs"], dtype=np.uint64),
+                np.asarray(resp["columnIDs"], dtype=np.uint64))
+
+    def import_bits(self, node, index, field, view, shard, rows, cols,
+                    clear=False):
+        body = json.dumps({
+            "kind": "fragment", "index": index, "field": field,
+            "view": view, "shard": shard, "rowIDs": list(rows),
+            "columnIDs": list(cols), "clear": clear,
+        }).encode()
+        self._request(node, "POST", "/internal/import", body)
+
+    def send_import(self, node, index, field, shard, rows=None, cols=None,
+                    values=None, timestamps=None, clear=False):
+        body = json.dumps({
+            "kind": "field", "index": index, "field": field, "shard": shard,
+            "rowIDs": rows, "columnIDs": list(cols or []),
+            "values": values, "timestamps": timestamps, "clear": clear,
+        }).encode()
+        self._request(node, "POST", "/internal/import", body)
+
+    def send_message(self, node: Node, message: dict):
+        self._request(node, "POST", "/internal/cluster/message",
+                      json.dumps(message).encode())

@@ -1,0 +1,286 @@
+"""HTTP layer: REST router over the API facade.
+
+Reference: http/handler.go (newRouter :274-318 — the public
+``/index/...``, ``/query``, ``/schema``, ``/status``, import/export
+routes plus the ``/internal/*`` node-to-node RPC). Implemented on the
+stdlib ThreadingHTTPServer — no framework dependency; JSON bodies
+replace the reference's protobuf on internal routes (documented
+deviation; the wire format is an implementation detail of this build).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from pilosa_tpu.errors import (
+    FieldExistsError,
+    FieldNotFoundError,
+    FragmentNotFoundError,
+    IndexExistsError,
+    IndexNotFoundError,
+    PilosaError,
+    QueryError,
+)
+from pilosa_tpu.pql import ParseError
+from pilosa_tpu.server.api import API
+
+_CONFLICTS = (IndexExistsError, FieldExistsError)
+_NOT_FOUND = (IndexNotFoundError, FieldNotFoundError, FragmentNotFoundError)
+
+
+class HTTPServer:
+    """One node's HTTP front end (reference http/handler.go:46)."""
+
+    def __init__(self, api: API, host: str = "127.0.0.1", port: int = 10101):
+        self.api = api
+        self.host = host
+        self.port = port
+        handler = _make_handler(api)
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self.port = self._httpd.server_address[1]  # resolved if port=0
+        self._thread: threading.Thread | None = None
+
+    def serve_background(self) -> None:
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def serve_forever(self) -> None:
+        self._httpd.serve_forever()
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    @property
+    def address(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+
+def _make_handler(api: API):
+    routes = _build_routes(api)
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # quiet by default
+            pass
+
+        def _dispatch(self, method: str):
+            parsed = urlparse(self.path)
+            params = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+            length = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(length) if length else b""
+            for pattern, methods in routes:
+                m = pattern.match(parsed.path)
+                if not m:
+                    continue
+                fn = methods.get(method)
+                if fn is None:
+                    continue
+                try:
+                    status, payload = fn(m.groupdict(), params, body)
+                except _CONFLICTS as e:
+                    status, payload = 409, {"error": str(e)}
+                except _NOT_FOUND as e:
+                    status, payload = 404, {"error": str(e)}
+                except (QueryError, ParseError, ValueError, PilosaError) as e:
+                    status, payload = 400, {"error": str(e)}
+                except Exception as e:  # pragma: no cover
+                    status, payload = 500, {"error": f"internal: {e}"}
+                return self._reply(status, payload)
+            self._reply(404, {"error": "not found"})
+
+        def _reply(self, status: int, payload):
+            if isinstance(payload, (dict, list)):
+                data = (json.dumps(payload) + "\n").encode()
+                ctype = "application/json"
+            else:
+                data = str(payload).encode()
+                ctype = "text/plain"
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            self._dispatch("GET")
+
+        def do_POST(self):
+            self._dispatch("POST")
+
+        def do_DELETE(self):
+            self._dispatch("DELETE")
+
+    return Handler
+
+
+def _build_routes(api: API):
+    """[(compiled_pattern, {method: fn(path_vars, params, body)})] in
+    reference route order (http/handler.go:276-318)."""
+
+    def jbody(body: bytes) -> dict:
+        if not body:
+            return {}
+        return json.loads(body)
+
+    def home(pv, params, body):
+        return 200, "pilosa-tpu: a TPU-native distributed bitmap index\n"
+
+    def get_indexes(pv, params, body):
+        return 200, {"indexes": api.schema()}
+
+    def post_index(pv, params, body):
+        opts = jbody(body).get("options", {})
+        api.create_index(pv["index"], opts)
+        return 200, {}
+
+    def get_index(pv, params, body):
+        return 200, api.index_info(pv["index"])
+
+    def delete_index(pv, params, body):
+        api.delete_index(pv["index"])
+        return 200, {}
+
+    def post_field(pv, params, body):
+        opts = jbody(body).get("options", {})
+        api.create_field(pv["index"], pv["field"], opts)
+        return 200, {}
+
+    def delete_field(pv, params, body):
+        api.delete_field(pv["index"], pv["field"])
+        return 200, {}
+
+    def post_import(pv, params, body):
+        req = jbody(body)
+        clear = params.get("clear") in ("1", "true")
+        if "values" in req:
+            api.import_values(pv["index"], pv["field"],
+                              req.get("columnIDs") or [],
+                              req["values"],
+                              column_keys=req.get("columnKeys"),
+                              clear=clear)
+        else:
+            api.import_bits(pv["index"], pv["field"],
+                            req.get("rowIDs") or [],
+                            req.get("columnIDs") or [],
+                            timestamps=req.get("timestamps"),
+                            row_keys=req.get("rowKeys"),
+                            column_keys=req.get("columnKeys"),
+                            clear=clear)
+        return 200, {}
+
+    def post_query(pv, params, body):
+        shards = None
+        if params.get("shards"):
+            shards = [int(s) for s in params["shards"].split(",")]
+        try:
+            resp = api.query(
+                pv["index"], body.decode(),
+                shards=shards,
+                column_attrs=params.get("columnAttrs") == "true",
+                exclude_row_attrs=params.get("excludeRowAttrs") == "true",
+                exclude_columns=params.get("excludeColumns") == "true",
+                remote=params.get("remote") == "true")
+        except _NOT_FOUND:
+            raise
+        except (QueryError, ParseError, PilosaError, ValueError) as e:
+            return 400, {"error": str(e)}
+        return 200, resp
+
+    def get_export(pv, params, body):
+        csv = api.export_csv(params["index"], params["field"],
+                             int(params["shard"]))
+        return 200, csv
+
+    def get_schema(pv, params, body):
+        return 200, {"indexes": api.schema()}
+
+    def post_schema(pv, params, body):
+        api.apply_schema(jbody(body).get("indexes", []))
+        return 200, {}
+
+    def get_status(pv, params, body):
+        return 200, api.status()
+
+    def get_info(pv, params, body):
+        return 200, api.info()
+
+    def get_version(pv, params, body):
+        return 200, {"version": api.info()["version"]}
+
+    def post_recalculate(pv, params, body):
+        api.recalculate_caches()
+        return 200, {}
+
+    def get_shards_max(pv, params, body):
+        return 200, {"standard": api.max_shards()}
+
+    def post_translate_keys(pv, params, body):
+        req = jbody(body)
+        ids = api.translate_keys(req["index"], req.get("field"),
+                                 req.get("keys", []))
+        return 200, {"ids": ids}
+
+    # internal RPC
+    def post_cluster_message(pv, params, body):
+        msg = jbody(body)
+        server = getattr(api, "message_handler", None)
+        if server is not None:
+            server(msg)
+        return 200, {}
+
+    def get_fragment_blocks(pv, params, body):
+        blocks = api.fragment_blocks(params["index"], params["field"],
+                                     params["view"], int(params["shard"]))
+        return 200, {"blocks": [{"id": b, "checksum": cs.hex()}
+                                for b, cs in sorted(blocks.items())]}
+
+    def get_fragment_block_data(pv, params, body):
+        rows, cols = api.fragment_block_data(
+            params["index"], params["field"], params["view"],
+            int(params["shard"]), int(params["block"]))
+        return 200, {"rowIDs": [int(r) for r in rows],
+                     "columnIDs": [int(c) for c in cols]}
+
+    def post_internal_import(pv, params, body):
+        req = jbody(body)
+        server = getattr(api, "import_handler", None)
+        if server is None:
+            return 400, {"error": "no import handler"}
+        server(req)
+        return 200, {}
+
+    def get_nodes(pv, params, body):
+        return 200, api.hosts()
+
+    table = [
+        (r"/", {"GET": home}),
+        (r"/index", {"GET": get_indexes}),
+        (r"/index/(?P<index>[^/]+)/query", {"POST": post_query}),
+        (r"/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/import",
+         {"POST": post_import}),
+        (r"/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)",
+         {"POST": post_field, "DELETE": delete_field}),
+        (r"/index/(?P<index>[^/]+)",
+         {"GET": get_index, "POST": post_index, "DELETE": delete_index}),
+        (r"/export", {"GET": get_export}),
+        (r"/schema", {"GET": get_schema, "POST": post_schema}),
+        (r"/status", {"GET": get_status}),
+        (r"/info", {"GET": get_info}),
+        (r"/version", {"GET": get_version}),
+        (r"/recalculate-caches", {"POST": post_recalculate}),
+        (r"/internal/shards/max", {"GET": get_shards_max}),
+        (r"/internal/translate/keys", {"POST": post_translate_keys}),
+        (r"/internal/cluster/message", {"POST": post_cluster_message}),
+        (r"/internal/fragment/blocks", {"GET": get_fragment_blocks}),
+        (r"/internal/fragment/block/data", {"GET": get_fragment_block_data}),
+        (r"/internal/import", {"POST": post_internal_import}),
+        (r"/internal/nodes", {"GET": get_nodes}),
+    ]
+    return [(re.compile("^" + p + "$"), methods) for p, methods in table]

@@ -1,0 +1,157 @@
+"""ServerNode — one full pilosa-tpu node process.
+
+Reference: server.go (Server :46 wires holder+cluster+executor,
+receiveMessage :569-663) and server/server.go (Command :60, SetupServer
+:222). Assembles Holder + Cluster + Executor(+MeshPlanner) + API +
+HTTPServer, wires the control-plane message and import handlers, and
+runs the anti-entropy ticker.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from pilosa_tpu.cluster.cluster import STATE_NORMAL, Cluster
+from pilosa_tpu.cluster.harness import handle_cluster_message
+from pilosa_tpu.cluster.node import URI, Node
+from pilosa_tpu.cluster.sync import HolderSyncer
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.exec.executor import Executor
+from pilosa_tpu.server.api import API
+from pilosa_tpu.server.httpclient import HTTPInternalClient
+from pilosa_tpu.server.httpd import HTTPServer
+
+
+class ServerNode:
+    """A runnable node (reference `pilosa server`, cmd/server.go:64)."""
+
+    def __init__(self, bind: str = "127.0.0.1:10101",
+                 peers: list[str] | None = None,
+                 replica_n: int = 1,
+                 use_planner: bool = True,
+                 anti_entropy_interval: float = 0.0,
+                 data_dir: str | None = None):
+        host, _, port = bind.partition(":")
+        self.host, self.port = host or "127.0.0.1", int(port or 10101)
+        # Node identity IS the address — member ids are built the same
+        # way, so local_id always matches its ring entry.
+        self.id = f"{self.host}:{self.port}"
+        self.data_dir = data_dir
+
+        # Membership: static peer list (the gossip-less Static:true mode,
+        # cluster.go:212); each peer "host:port" becomes a Node.
+        members = []
+        all_addrs = sorted(set((peers or []) + [f"{self.host}:{self.port}"]))
+        for i, addr in enumerate(all_addrs):
+            h, _, p = addr.partition(":")
+            members.append(Node(id=addr, uri=URI(host=h, port=int(p)),
+                                is_coordinator=(i == 0)))
+        self.cluster = None
+        if len(members) > 1:
+            self.cluster = Cluster(local_id=self.id, nodes=members,
+                                   replica_n=replica_n,
+                                   client=HTTPInternalClient())
+            self.cluster.set_state(STATE_NORMAL)
+
+        self.holder = Holder(fragment_listener=self._broadcast_shard)
+        planner = None
+        if use_planner:
+            try:
+                from pilosa_tpu.parallel import MeshPlanner
+                planner = MeshPlanner(self.holder)
+            except Exception:
+                planner = None
+        self.executor = Executor(self.holder, cluster=self.cluster,
+                                 node_id=self.id, planner=planner)
+        self.api = API(self.holder, self.executor, cluster=self.cluster)
+        # Handler hooks used by the HTTP router's /internal routes.
+        self.api.message_handler = self.handle_message
+        self.api.import_handler = self.handle_internal_import
+        self.http = HTTPServer(self.api, self.host, self.port)
+        self.port = self.http.port
+
+        self.syncer = None
+        self._sync_timer: threading.Timer | None = None
+        self._anti_entropy_interval = anti_entropy_interval
+        if self.cluster is not None:
+            self.syncer = HolderSyncer(self.holder, self.cluster,
+                                       self.cluster.client)
+
+        if data_dir:
+            from pilosa_tpu.storage.diskstore import DiskStore
+            self.store = DiskStore(data_dir, self.holder)
+            self.store.open()
+        else:
+            self.store = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def open(self) -> None:
+        self.http.serve_background()
+        if self.syncer is not None and self._anti_entropy_interval > 0:
+            self._schedule_sync()
+
+    def _schedule_sync(self) -> None:
+        def tick():
+            try:
+                self.syncer.sync_holder()
+            finally:
+                self._schedule_sync()
+        self._sync_timer = threading.Timer(self._anti_entropy_interval, tick)
+        self._sync_timer.daemon = True
+        self._sync_timer.start()
+
+    def close(self) -> None:
+        if self._sync_timer is not None:
+            self._sync_timer.cancel()
+        if self.store is not None:
+            self.store.close()
+        self.http.close()
+
+    @property
+    def address(self) -> str:
+        return self.http.address
+
+    # -- control plane -----------------------------------------------------
+
+    def _broadcast_shard(self, index: str, field: str, view: str, shard: int):
+        if self.cluster is None:
+            return
+        msg = {"type": "create-shard", "index": index, "field": field,
+               "shard": shard}
+        for node in self.cluster.nodes:
+            if node.id == self.id or node.state == "DOWN":
+                continue
+            try:
+                self.cluster.client.send_message(node, msg)
+            except (ConnectionError, RuntimeError):
+                pass
+
+    def handle_message(self, message: dict) -> None:
+        handle_cluster_message(self.holder, message)
+
+    def handle_internal_import(self, req: dict) -> None:
+        """JSON /internal/import payloads: fragment-level (anti-entropy
+        diff push) or field-level (routed import)."""
+        index, field = req["index"], req["field"]
+        f = self.holder.field(index, field)
+        if f is None:
+            raise LookupError(f"field not found: {index}/{field}")
+        if req.get("kind") == "fragment":
+            v = f.create_view_if_not_exists(req["view"])
+            frag = v.create_fragment_if_not_exists(req["shard"])
+            frag.bulk_import(req["rowIDs"], req["columnIDs"],
+                             clear=req.get("clear", False))
+        elif req.get("values") is not None:
+            f.import_values(req["columnIDs"], req["values"],
+                            clear=req.get("clear", False))
+            self.holder.index(index).add_existence(req["columnIDs"])
+        else:
+            from pilosa_tpu.core import timequantum as tq
+            ts = None
+            if req.get("timestamps") is not None:
+                ts = [tq.parse_time(t) if t else None
+                      for t in req["timestamps"]]
+            f.import_bits(req["rowIDs"], req["columnIDs"], ts,
+                          clear=req.get("clear", False))
+            self.holder.index(index).add_existence(req["columnIDs"])

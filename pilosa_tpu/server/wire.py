@@ -1,0 +1,62 @@
+"""Typed wire codec for internal (node-to-node) query results.
+
+Reference: encoding/proto/proto.go:29 — the protobuf Serializer used for
+``remote=true`` query responses (QueryResponse with typed Row/Pairs/
+ValCount/GroupCounts payloads). Here: a tagged-JSON envelope with the
+same type fidelity; the coordinator decodes back to internal result
+objects before reducing.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pilosa_tpu.core.row import Row
+from pilosa_tpu.exec.result import FieldRow, GroupCount, Pair, ValCount
+
+
+def encode_result(r: Any) -> dict:
+    if isinstance(r, Row):
+        return {"t": "row", "columns": [int(c) for c in r.columns()],
+                "attrs": r.attrs}
+    if isinstance(r, ValCount):
+        return {"t": "valcount", "val": r.val, "count": r.count}
+    if isinstance(r, Pair):
+        return {"t": "pair", "id": r.id, "count": r.count, "key": r.key}
+    if isinstance(r, list):
+        if r and isinstance(r[0], Pair):
+            return {"t": "pairs",
+                    "items": [[p.id, p.count] for p in r]}
+        if r and isinstance(r[0], GroupCount):
+            return {"t": "groupcounts",
+                    "items": [{"group": [[fr.field, fr.row_id]
+                                         for fr in gc.group],
+                               "count": gc.count} for gc in r]}
+        return {"t": "rowids", "items": [int(x) for x in r]}
+    if isinstance(r, bool) or isinstance(r, int) or r is None:
+        return {"t": "scalar", "v": r}
+    raise TypeError(f"unencodable internal result {type(r)}")
+
+
+def decode_result(d: dict) -> Any:
+    t = d.get("t")
+    if t == "row":
+        row = Row.from_columns(d["columns"])
+        row.attrs = d.get("attrs") or {}
+        return row
+    if t == "valcount":
+        return ValCount(d["val"], d["count"])
+    if t == "pair":
+        return Pair(id=d["id"], count=d["count"], key=d.get("key", ""))
+    if t == "pairs":
+        return [Pair(id=i, count=c) for i, c in d["items"]]
+    if t == "groupcounts":
+        return [GroupCount(group=[FieldRow(field=f, row_id=rid)
+                                  for f, rid in item["group"]],
+                           count=item["count"])
+                for item in d["items"]]
+    if t == "rowids":
+        return list(d["items"])
+    if t == "scalar":
+        return d["v"]
+    raise TypeError(f"undecodable internal result {d!r}")

@@ -1,0 +1,14 @@
+"""Durability: per-fragment snapshot + WAL, schema/attr/translate
+persistence, holder reload.
+
+Reference: the op-log + snapshot cycle (roaring.go:4650-4790 op records,
+fragment.go:84 MaxOpN, :2296 enqueueSnapshot, :2337-2393 snapshot temp +
+rename; holder.go:137 Open walks the data dir). Here the WAL is a binary
+record stream per fragment and snapshots are compressed position arrays —
+the host-side truth the device stacks are rebuilt from on boot.
+"""
+
+from pilosa_tpu.storage.diskstore import DiskStore
+from pilosa_tpu.storage.wal import WalReader, WalWriter
+
+__all__ = ["DiskStore", "WalReader", "WalWriter"]

@@ -1,0 +1,301 @@
+"""DiskStore — the durability engine bound to a Holder.
+
+Reference: holder.go Open (:137, data-dir walk → Index.Open → Field.Open
+→ view.open → fragment.Open with mmap + op-log replay), the background
+snapshot queue (fragment.go:187-239, holder.go:163: depth-100 queue, 2
+workers), snapshot write (fragment.go:2337-2393: temp file + rename),
+and per-object meta persistence (.meta / .available.shards / attr and
+translate stores).
+
+Layout under ``data_dir``::
+
+    schema.json
+    <index>/column_attrs.jsonl
+    <index>/translate.jsonl
+    <index>/<field>/row_attrs.jsonl
+    <index>/<field>/translate.jsonl
+    <index>/<field>/<view>/<shard>.snap   # npz: row ids + positions
+    <index>/<field>/<view>/<shard>.wal    # binary op log
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+
+import numpy as np
+
+from pilosa_tpu.config import MAX_OP_N
+from pilosa_tpu.core.attrs import AttrStore
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.core.hostrow import HostRow
+from pilosa_tpu.core.translate import TranslateStore
+from pilosa_tpu.storage.wal import (
+    OP_ADD,
+    OP_CLEAR_ROW,
+    OP_REMOVE,
+    OP_SET_ROW,
+    WalReader,
+    WalWriter,
+)
+
+
+class DiskStore:
+    """Snapshot + WAL persistence for every fragment of a holder."""
+
+    def __init__(self, data_dir: str, holder: Holder,
+                 max_op_n: int = MAX_OP_N, snapshot_workers: int = 2):
+        self.data_dir = data_dir
+        self.holder = holder
+        self.max_op_n = max_op_n
+        os.makedirs(data_dir, exist_ok=True)
+        self._writers: dict[tuple, WalWriter] = {}
+        self._lock = threading.Lock()
+        # Background snapshot queue (holder.go:163: depth 100, 2 workers).
+        self._snap_q: "queue.Queue[tuple | None]" = queue.Queue(maxsize=100)
+        self._snap_pending: set[tuple] = set()
+        self._workers = [threading.Thread(target=self._snapshot_worker,
+                                          daemon=True)
+                         for _ in range(snapshot_workers)]
+
+    # -- paths -------------------------------------------------------------
+
+    def _frag_dir(self, index: str, field: str, view: str) -> str:
+        return os.path.join(self.data_dir, index, field, view)
+
+    def _snap_path(self, key: tuple) -> str:
+        index, field, view, shard = key
+        return os.path.join(self._frag_dir(index, field, view), f"{shard}.snap")
+
+    def _wal_path(self, key: tuple) -> str:
+        index, field, view, shard = key
+        return os.path.join(self._frag_dir(index, field, view), f"{shard}.wal")
+
+    # -- open / reload (holder.go:137) -------------------------------------
+
+    def open(self) -> None:
+        self.holder.op_writer_factory = self._op_writer_factory
+        schema_path = os.path.join(self.data_dir, "schema.json")
+        if os.path.exists(schema_path):
+            with open(schema_path) as f:
+                self.holder.apply_schema(json.load(f))
+        self._attach_stores()
+        self._load_fragments()
+        for w in self._workers:
+            w.start()
+
+    def _attach_stores(self) -> None:
+        """Swap in path-backed attr/translate stores (boltdb/ analog)."""
+        for iname in self.holder.index_names():
+            idx = self.holder.index(iname)
+            idir = os.path.join(self.data_dir, iname)
+            idx.column_attr_store = AttrStore(
+                os.path.join(idir, "column_attrs.jsonl"))
+            idx.translate_store = TranslateStore(
+                os.path.join(idir, "translate.jsonl"))
+            for fname, f in idx.fields.items():
+                fdir = os.path.join(idir, fname)
+                f.row_attr_store = AttrStore(
+                    os.path.join(fdir, "row_attrs.jsonl"))
+                f.translate_store = TranslateStore(
+                    os.path.join(fdir, "translate.jsonl"))
+
+    def _load_fragments(self) -> None:
+        """Walk the data dir; rebuild fragments from snapshot + WAL."""
+        for iname in self.holder.index_names():
+            idx = self.holder.index(iname)
+            idir = os.path.join(self.data_dir, iname)
+            if not os.path.isdir(idir):
+                continue
+            for fname, f in list(idx.fields.items()):
+                fdir = os.path.join(idir, fname)
+                if not os.path.isdir(fdir):
+                    continue
+                for view_name in sorted(os.listdir(fdir)):
+                    vdir = os.path.join(fdir, view_name)
+                    if not os.path.isdir(vdir):
+                        continue
+                    view = f.create_view_if_not_exists(view_name)
+                    shards = set()
+                    for fn in os.listdir(vdir):
+                        if fn.endswith((".snap", ".wal")):
+                            shards.add(int(fn.rsplit(".", 1)[0]))
+                    for shard in sorted(shards):
+                        frag = view.create_fragment_if_not_exists(shard)
+                        self._load_fragment(frag, (iname, fname, view_name,
+                                                   shard))
+
+    def _load_fragment(self, frag, key: tuple) -> None:
+        saved_writer = frag.op_writer
+        frag.op_writer = None  # don't re-log replayed ops
+        try:
+            snap = self._snap_path(key)
+            if os.path.exists(snap):
+                with np.load(snap) as z:
+                    row_ids = z["row_ids"]
+                    offsets = z["offsets"]
+                    positions = z["positions"]
+                for i, rid in enumerate(row_ids.tolist()):
+                    lo, hi = int(offsets[i]), int(offsets[i + 1])
+                    frag.rows[rid] = HostRow.from_positions(positions[lo:hi])
+                frag._invalidate()
+            base = frag.shard * _shard_width()
+            for code, rows, cols in WalReader(self._wal_path(key)):
+                if code == OP_ADD:
+                    frag.bulk_import(rows.tolist(), cols.tolist())
+                elif code == OP_REMOVE:
+                    frag.bulk_import(rows.tolist(), cols.tolist(), clear=True)
+                elif code == OP_SET_ROW:
+                    rid = int(rows[0]) if len(rows) else 0
+                    frag.rows[rid] = HostRow.from_positions(
+                        (cols - np.uint64(base)))
+                    frag._invalidate()
+                elif code == OP_CLEAR_ROW:
+                    rid = int(rows[0]) if len(rows) else 0
+                    frag.rows.pop(rid, None)
+                    frag._invalidate()
+        finally:
+            frag.op_writer = saved_writer
+
+    # -- WAL wiring --------------------------------------------------------
+
+    def _op_writer_factory(self, index: str, field: str, view: str,
+                           shard: int):
+        key = (index, field, view, shard)
+
+        def op_writer(op: str, rows, cols):
+            w = self._writer(key)
+            if op == "setRow":
+                w.append("setRow", rows[:1], cols)
+            else:
+                w.append(op, rows, cols)
+            if w.op_n > self.max_op_n:
+                self._enqueue_snapshot(key)
+        return op_writer
+
+    def _writer(self, key: tuple) -> WalWriter:
+        with self._lock:
+            w = self._writers.get(key)
+            if w is None:
+                w = self._writers[key] = WalWriter(self._wal_path(key))
+            return w
+
+    # -- snapshots (fragment.go:187-239, :2337-2393) -----------------------
+
+    def _enqueue_snapshot(self, key: tuple) -> None:
+        with self._lock:
+            if key in self._snap_pending:
+                return
+            self._snap_pending.add(key)
+        try:
+            self._snap_q.put_nowait(key)
+        except queue.Full:
+            with self._lock:
+                self._snap_pending.discard(key)
+
+    def _snapshot_worker(self) -> None:
+        while True:
+            key = self._snap_q.get()
+            if key is None:
+                return
+            try:
+                self.snapshot_fragment(key)
+            finally:
+                with self._lock:
+                    self._snap_pending.discard(key)
+
+    def snapshot_fragment(self, key: tuple) -> None:
+        """Write <shard>.snap.tmp, fsync-rename, truncate the WAL."""
+        index, field, view, shard = key
+        frag = self.holder.fragment(index, field, view, shard)
+        if frag is None:
+            return
+        with frag._lock:
+            row_ids = np.asarray(sorted(frag.rows), dtype=np.uint64)
+            parts = [frag.rows[int(r)].to_positions() for r in row_ids]
+            offsets = np.zeros(len(parts) + 1, dtype=np.int64)
+            for i, p in enumerate(parts):
+                offsets[i + 1] = offsets[i] + len(p)
+            positions = (np.concatenate(parts) if parts
+                         else np.empty(0, np.uint64))
+            path = self._snap_path(key)
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as fh:
+                np.savez_compressed(fh, row_ids=row_ids, offsets=offsets,
+                                    positions=positions)
+            os.replace(tmp, path)
+            self._writer(key).truncate()
+
+    def snapshot_all(self) -> None:
+        for key in self._all_keys():
+            self.snapshot_fragment(key)
+
+    def _all_keys(self):
+        for iname in self.holder.index_names():
+            idx = self.holder.index(iname)
+            for fname, f in idx.fields.items():
+                for vname, v in f.views.items():
+                    for shard in v.fragments:
+                        yield (iname, fname, vname, shard)
+
+    # -- flush / close -----------------------------------------------------
+
+    def save_schema(self) -> None:
+        path = os.path.join(self.data_dir, "schema.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.holder.schema(), f)
+        os.replace(tmp, path)
+
+    def flush(self) -> None:
+        self.save_schema()
+        self._attach_paths_for_new_objects()
+        self.snapshot_all()
+        for iname in self.holder.index_names():
+            idx = self.holder.index(iname)
+            idx.column_attr_store.save()
+            idx.translate_store.save()
+            for f in idx.fields.values():
+                f.row_attr_store.save()
+                f.translate_store.save()
+
+    def _attach_paths_for_new_objects(self) -> None:
+        """Objects created after open() need their stores path-bound."""
+        for iname in self.holder.index_names():
+            idx = self.holder.index(iname)
+            idir = os.path.join(self.data_dir, iname)
+            if idx.column_attr_store.path is None:
+                store = AttrStore(os.path.join(idir, "column_attrs.jsonl"))
+                store._attrs = idx.column_attr_store._attrs
+                idx.column_attr_store = store
+            if idx.translate_store.path is None:
+                idx.translate_store.path = os.path.join(idir, "translate.jsonl")
+            for fname, f in idx.fields.items():
+                fdir = os.path.join(idir, fname)
+                if f.row_attr_store.path is None:
+                    store = AttrStore(os.path.join(fdir, "row_attrs.jsonl"))
+                    store._attrs = f.row_attr_store._attrs
+                    f.row_attr_store = store
+                if f.translate_store.path is None:
+                    f.translate_store.path = os.path.join(fdir,
+                                                          "translate.jsonl")
+
+    def close(self) -> None:
+        for _ in self._workers:
+            try:
+                self._snap_q.put_nowait(None)
+            except queue.Full:
+                pass
+        self.flush()
+        with self._lock:
+            for w in self._writers.values():
+                w.close()
+            self._writers.clear()
+
+
+def _shard_width() -> int:
+    from pilosa_tpu.config import SHARD_WIDTH
+    return SHARD_WIDTH

@@ -1,0 +1,77 @@
+"""CLI tests: import/export round trip against a live server, check and
+inspect over a data dir, config precedence. Models cmd/*_test.go + ctl/."""
+
+import json
+import os
+import urllib.request
+
+import pytest
+
+from pilosa_tpu import cli
+from pilosa_tpu.server.node import ServerNode
+
+
+@pytest.fixture
+def node(tmp_path):
+    n = ServerNode(bind="127.0.0.1:0", use_planner=False,
+                   data_dir=str(tmp_path / "data"))
+    n.open()
+    yield n
+    n.close()
+
+
+def _post(base, path, body):
+    r = urllib.request.Request(base + path, data=body.encode(), method="POST")
+    return urllib.request.urlopen(r, timeout=10).read()
+
+
+def test_import_export_roundtrip(node, tmp_path, capsys):
+    base = node.address
+    host = base.removeprefix("http://")
+    _post(base, "/index/i", "{}")
+    _post(base, "/index/i/field/f", "{}")
+
+    csv = tmp_path / "bits.csv"
+    csv.write_text("1,3\n1,9\n2,4\n")
+    rc = cli.main(["import", "--host", host, "i", "f", str(csv)])
+    assert rc == 0
+
+    resp = json.loads(_post(base, "/index/i/query", "Row(f=1)"))
+    assert resp["results"][0]["columns"] == [3, 9]
+
+    rc = cli.main(["export", "--host", host, "i", "f"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert sorted(out.strip().splitlines()) == ["1,3", "1,9", "2,4"]
+
+
+def test_check_and_inspect(node, tmp_path, capsys):
+    base = node.address
+    _post(base, "/index/i", "{}")
+    _post(base, "/index/i/field/f", "{}")
+    _post(base, "/index/i/query", "Set(5, f=1)")
+    node.store.flush()
+    data_dir = str(tmp_path / "data")
+
+    assert cli.main(["check", data_dir]) == 0
+    out = capsys.readouterr().out
+    assert "ok snap" in out
+
+    assert cli.main(["inspect", data_dir]) == 0
+    out = capsys.readouterr().out
+    assert "rows=1 bits=1" in out
+
+
+def test_config_precedence(tmp_path, capsys, monkeypatch):
+    cfg = tmp_path / "c.toml"
+    cfg.write_text('bind = "1.2.3.4:9"\nreplica-n = 3\n')
+    monkeypatch.setenv("PILOSA_TPU_REPLICA_N", "5")
+    assert cli.main(["config", "--config", str(cfg)]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["bind"] == "1.2.3.4:9"   # file beats default
+    assert out["replica_n"] == 5        # env beats file
+
+
+def test_generate_config(capsys):
+    assert cli.main(["generate-config"]) == 0
+    assert "bind" in capsys.readouterr().out

@@ -1,0 +1,173 @@
+"""Persistence tests: WAL append/replay/torn-tail, snapshot + reload,
+MaxOpN trigger, attr/translate durability.
+
+Models fragment_internal_test.go's snapshot/reopen cases and the op-log
+recovery contract (roaring.go:4694 checksummed ops).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.config import SHARD_WIDTH
+from pilosa_tpu.core import Holder, FieldOptions
+from pilosa_tpu.core.field import FIELD_TYPE_INT
+from pilosa_tpu.exec import Executor
+from pilosa_tpu.storage import DiskStore, WalReader, WalWriter
+from pilosa_tpu.storage.wal import OP_ADD, OP_REMOVE
+
+
+def test_wal_roundtrip(tmp_path):
+    p = str(tmp_path / "f.wal")
+    w = WalWriter(p)
+    w.append("add", [1, 2], [10, 20])
+    w.append("removeBatch", [3], [30])
+    w.close()
+    ops = list(WalReader(p))
+    assert len(ops) == 2
+    code, rows, cols = ops[0]
+    assert code == OP_ADD
+    assert rows.tolist() == [1, 2] and cols.tolist() == [10, 20]
+    assert ops[1][0] == OP_REMOVE
+
+
+def test_wal_torn_tail(tmp_path):
+    p = str(tmp_path / "f.wal")
+    w = WalWriter(p)
+    w.append("add", [1], [10])
+    w.append("add", [2], [20])
+    w.close()
+    size = os.path.getsize(p)
+    with open(p, "r+b") as f:
+        f.truncate(size - 5)  # tear the second record
+    ops = list(WalReader(p))
+    assert len(ops) == 1
+    assert ops[0][1].tolist() == [1]
+
+
+def make_holder(data_dir):
+    h = Holder()
+    store = DiskStore(data_dir, h)
+    store.open()
+    return h, store
+
+
+def test_wal_replay_after_crash(tmp_path):
+    d = str(tmp_path / "data")
+    h, store = make_holder(d)
+    idx = h.create_index("i")
+    f = idx.create_field("f")
+    e = Executor(h)
+    e.execute("i", "Set(5, f=1) Set(9, f=1)")
+    e.execute("i", "Clear(5, f=1)")
+    store.save_schema()
+    # simulate crash: NO snapshot/flush — only schema.json + WAL on disk
+    h2, store2 = make_holder(d)
+    (row,) = Executor(h2).execute("i", "Row(f=1)")
+    assert row.columns().tolist() == [9]
+
+
+def test_snapshot_and_reload(tmp_path):
+    d = str(tmp_path / "data")
+    h, store = make_holder(d)
+    idx = h.create_index("i")
+    f = idx.create_field("f")
+    v = idx.create_field("v", FieldOptions(type=FIELD_TYPE_INT,
+                                           min=-100, max=100))
+    cols = [1, SHARD_WIDTH + 2, 2 * SHARD_WIDTH + 3]
+    f.import_bits([7] * len(cols), cols)
+    v.import_values([1, 2], [42, -9])
+    store.close()  # flush: schema + snapshots + stores
+
+    h2, store2 = make_holder(d)
+    e2 = Executor(h2)
+    (row,) = e2.execute("i", "Row(f=7)")
+    assert row.columns().tolist() == cols
+    assert e2.execute("i", "Sum(field=v)")[0].val == 33
+    assert h2.field("i", "v").value(2) == (-9, True)
+    # WAL was truncated by the snapshot
+    wal = os.path.join(d, "i", "f", "standard", "0.wal")
+    assert not os.path.exists(wal) or os.path.getsize(wal) == 0
+
+
+def test_snapshot_trigger_on_max_op_n(tmp_path):
+    d = str(tmp_path / "data")
+    h = Holder()
+    store = DiskStore(d, h, max_op_n=10)
+    store.open()
+    idx = h.create_index("i")
+    f = idx.create_field("f")
+    for i in range(25):
+        f.set_bit(1, i)
+    # wait for the background snapshot worker
+    import time
+    deadline = time.time() + 10
+    snap = os.path.join(d, "i", "f", "standard", "0.snap")
+    while time.time() < deadline and not os.path.exists(snap):
+        time.sleep(0.05)
+    assert os.path.exists(snap)
+    store.save_schema()
+    h2, _ = make_holder(d)
+    assert h2.fragment("i", "f", "standard", 0).bit_count() == 25
+
+
+def test_attrs_and_translate_persist(tmp_path):
+    d = str(tmp_path / "data")
+    h, store = make_holder(d)
+    idx = h.create_index("i")
+    f = idx.create_field("f")
+    f.row_attr_store.set_attrs(1, {"color": "red"})
+    idx.column_attr_store.set_attrs(9, {"name": "bob"})
+    kid = f.translate_store.translate_key("alpha")
+    store.close()
+
+    h2, _ = make_holder(d)
+    f2 = h2.field("i", "f")
+    assert f2.row_attr_store.attrs(1) == {"color": "red"}
+    assert h2.index("i").column_attr_store.attrs(9) == {"name": "bob"}
+    assert f2.translate_store.translate_key("alpha", create=False) == kid
+
+
+def test_time_views_persist(tmp_path):
+    import datetime as dt
+    d = str(tmp_path / "data")
+    h, store = make_holder(d)
+    idx = h.create_index("i")
+    t = idx.create_field("t", FieldOptions(type="time", time_quantum="YMD"))
+    t.set_bit(1, 10, timestamp=dt.datetime(2018, 3, 2))
+    store.close()
+    h2, _ = make_holder(d)
+    e2 = Executor(h2)
+    (row,) = e2.execute(
+        "i", "Range(t=1, from='2018-01-01T00:00', to='2019-01-01T00:00')")
+    assert row.columns().tolist() == [10]
+
+
+def test_server_node_with_data_dir(tmp_path):
+    from pilosa_tpu.server.node import ServerNode
+    import urllib.request, json as js
+    d = str(tmp_path / "data")
+    n = ServerNode(bind="127.0.0.1:0", use_planner=False, data_dir=d)
+    n.open()
+    base = n.address
+
+    def post(path, body):
+        r = urllib.request.Request(base + path, data=body.encode(),
+                                   method="POST")
+        return urllib.request.urlopen(r, timeout=10).read()
+
+    post("/index/i", "{}")
+    post("/index/i/field/f", "{}")
+    post("/index/i/query", "Set(123, f=1)")
+    n.close()
+
+    n2 = ServerNode(bind="127.0.0.1:0", use_planner=False, data_dir=d)
+    n2.open()
+    try:
+        r = urllib.request.Request(n2.address + "/index/i/query",
+                                   data=b"Row(f=1)", method="POST")
+        resp = js.loads(urllib.request.urlopen(r, timeout=10).read())
+        assert resp["results"][0]["columns"] == [123]
+    finally:
+        n2.close()
