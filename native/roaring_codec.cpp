@@ -1,0 +1,267 @@
+// Native roaring codec: the hot host-side decode/encode loops.
+//
+// Mirrors pilosa_tpu/roaring.py (the Pilosa wire variant of
+// roaring.go:1046 WriteTo / :5315 readers). This layer plays the role
+// the reference's roaring/ package plays for its runtime: the
+// performance-critical host path between wire/disk bytes and the dense
+// uint32 blocks uploaded to the TPU.
+//
+// C ABI (ctypes-friendly), two-phase calls so Python owns allocation:
+//   roaring_decode_count(buf, len)              -> bit count or -1
+//   roaring_decode(buf, len, out_u64, cap)      -> n written or -1
+//   roaring_encode_bound(pos_u64, n)            -> max encoded bytes
+//   roaring_encode(pos_u64, n, out_u8, cap)     -> bytes written or -1
+//   positions_to_words(pos_u64, n, words_u32, n_words)   (pos < n_words*32)
+//   words_to_positions(words_u32, n_words, out_u64, cap) -> n
+//   popcount_words(words_u32, n_words)          -> total set bits
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 12348;
+constexpr int kTypeArray = 1;
+constexpr int kTypeBitmap = 2;
+constexpr int kTypeRun = 3;
+constexpr int kArrayMax = 4096;
+constexpr int kRunMax = 2048;
+constexpr int kBitmapWords64 = (1 << 16) / 64;
+
+inline uint16_t rd16(const uint8_t* p) {
+  return static_cast<uint16_t>(p[0] | (p[1] << 8));
+}
+inline uint32_t rd32(const uint8_t* p) {
+  return static_cast<uint32_t>(p[0]) | (static_cast<uint32_t>(p[1]) << 8) |
+         (static_cast<uint32_t>(p[2]) << 16) |
+         (static_cast<uint32_t>(p[3]) << 24);
+}
+inline uint64_t rd64(const uint8_t* p) {
+  uint64_t v = 0;
+  std::memcpy(&v, p, 8);  // little-endian hosts only (x86/arm LE)
+  return v;
+}
+inline void wr16(uint8_t* p, uint16_t v) {
+  p[0] = v & 0xFF;
+  p[1] = v >> 8;
+}
+inline void wr32(uint8_t* p, uint32_t v) {
+  p[0] = v & 0xFF;
+  p[1] = (v >> 8) & 0xFF;
+  p[2] = (v >> 16) & 0xFF;
+  p[3] = (v >> 24) & 0xFF;
+}
+inline void wr64(uint8_t* p, uint64_t v) { std::memcpy(p, &v, 8); }
+
+struct Meta {
+  uint64_t key;
+  int typ;
+  int n;
+  uint32_t off;
+};
+
+// Parse header + metas; returns container count or -1.
+int parse_metas(const uint8_t* buf, int64_t len, std::vector<Meta>* metas) {
+  if (len < 8) return -1;
+  uint32_t cookie = rd32(buf);
+  if ((cookie & 0xFFFF) != kMagic) return -1;
+  int count = static_cast<int>(rd32(buf + 4));
+  int64_t meta_off = 8;
+  int64_t offs_off = meta_off + 12LL * count;
+  if (offs_off + 4LL * count > len) return -1;
+  metas->resize(count);
+  for (int i = 0; i < count; i++) {
+    const uint8_t* m = buf + meta_off + 12LL * i;
+    (*metas)[i].key = rd64(m);
+    (*metas)[i].typ = rd16(m + 8);
+    (*metas)[i].n = rd16(m + 10) + 1;
+    (*metas)[i].off = rd32(buf + offs_off + 4LL * i);
+  }
+  return count;
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t roaring_decode_count(const uint8_t* buf, int64_t len) {
+  std::vector<Meta> metas;
+  if (parse_metas(buf, len, &metas) < 0) return -1;
+  int64_t total = 0;
+  for (const Meta& m : metas) total += m.n;
+  return total;
+}
+
+int64_t roaring_decode(const uint8_t* buf, int64_t len, uint64_t* out,
+                       int64_t cap) {
+  std::vector<Meta> metas;
+  if (parse_metas(buf, len, &metas) < 0) return -1;
+  int64_t n_out = 0;
+  for (const Meta& m : metas) {
+    uint64_t base = m.key << 16;
+    const uint8_t* data = buf + m.off;
+    if (n_out + m.n > cap) return -1;
+    switch (m.typ) {
+      case kTypeArray: {
+        if (m.off + 2LL * m.n > len) return -1;
+        for (int i = 0; i < m.n; i++) out[n_out++] = base + rd16(data + 2 * i);
+        break;
+      }
+      case kTypeBitmap: {
+        if (m.off + 8LL * kBitmapWords64 > len) return -1;
+        for (int w = 0; w < kBitmapWords64; w++) {
+          uint64_t word = rd64(data + 8 * w);
+          while (word) {
+            int b = __builtin_ctzll(word);
+            out[n_out++] = base + (static_cast<uint64_t>(w) << 6) + b;
+            word &= word - 1;
+          }
+        }
+        break;
+      }
+      case kTypeRun: {
+        if (m.off + 2 > len) return -1;
+        int run_n = rd16(data);
+        if (m.off + 2 + 4LL * run_n > len) return -1;
+        for (int r = 0; r < run_n; r++) {
+          uint16_t start = rd16(data + 2 + 4 * r);
+          uint16_t last = rd16(data + 2 + 4 * r + 2);
+          for (uint32_t v = start; v <= last; v++) out[n_out++] = base + v;
+        }
+        break;
+      }
+      default:
+        return -1;
+    }
+  }
+  return n_out;
+}
+
+int64_t roaring_encode_bound(const uint64_t* pos, int64_t n) {
+  (void)pos;
+  // Worst case: every position its own array container.
+  return 8 + n * (12 + 4 + 2) + 16;
+}
+
+int64_t roaring_encode(const uint64_t* pos, int64_t n, uint8_t* out,
+                       int64_t cap) {
+  // Group sorted positions by 2^16 key; pick run/array/bitmap per the
+  // reference's optimize() economics (roaring.go:2334).
+  struct Cont {
+    uint64_t key;
+    int typ;
+    int n;
+    int64_t start;  // index into pos
+  };
+  std::vector<Cont> conts;
+  int64_t i = 0;
+  while (i < n) {
+    uint64_t key = pos[i] >> 16;
+    int64_t j = i;
+    int runs = 1;
+    while (j + 1 < n && (pos[j + 1] >> 16) == key) {
+      if (pos[j + 1] != pos[j] + 1) runs++;
+      j++;
+    }
+    int cn = static_cast<int>(j - i + 1);
+    int run_size = 2 + 4 * runs;
+    int array_size = 2 * cn;
+    int typ;
+    if (runs <= kRunMax && run_size < array_size && run_size < 8192)
+      typ = kTypeRun;
+    else if (cn <= kArrayMax)
+      typ = kTypeArray;
+    else
+      typ = kTypeBitmap;
+    conts.push_back({key, typ, cn, i});
+    i = j + 1;
+  }
+  int count = static_cast<int>(conts.size());
+  int64_t head = 8 + 12LL * count + 4LL * count;
+  if (head > cap) return -1;
+  wr32(out, kMagic);
+  wr32(out + 4, static_cast<uint32_t>(count));
+  int64_t off = head;
+  for (int c = 0; c < count; c++) {
+    const Cont& ct = conts[c];
+    uint8_t* m = out + 8 + 12LL * c;
+    wr64(m, ct.key);
+    wr16(m + 8, static_cast<uint16_t>(ct.typ));
+    wr16(m + 10, static_cast<uint16_t>(ct.n - 1));
+    wr32(out + 8 + 12LL * count + 4LL * c, static_cast<uint32_t>(off));
+    // payload
+    const uint64_t* p = pos + ct.start;
+    if (ct.typ == kTypeArray) {
+      if (off + 2LL * ct.n > cap) return -1;
+      for (int k = 0; k < ct.n; k++)
+        wr16(out + off + 2LL * k, static_cast<uint16_t>(p[k] & 0xFFFF));
+      off += 2LL * ct.n;
+    } else if (ct.typ == kTypeRun) {
+      // recount runs
+      std::vector<std::pair<uint16_t, uint16_t>> runs;
+      uint16_t start = static_cast<uint16_t>(p[0] & 0xFFFF);
+      uint16_t prev = start;
+      for (int k = 1; k < ct.n; k++) {
+        uint16_t v = static_cast<uint16_t>(p[k] & 0xFFFF);
+        if (v != prev + 1) {
+          runs.emplace_back(start, prev);
+          start = v;
+        }
+        prev = v;
+      }
+      runs.emplace_back(start, prev);
+      int64_t sz = 2 + 4LL * runs.size();
+      if (off + sz > cap) return -1;
+      wr16(out + off, static_cast<uint16_t>(runs.size()));
+      for (size_t r = 0; r < runs.size(); r++) {
+        wr16(out + off + 2 + 4 * r, runs[r].first);
+        wr16(out + off + 2 + 4 * r + 2, runs[r].second);
+      }
+      off += sz;
+    } else {
+      int64_t sz = 8LL * kBitmapWords64;
+      if (off + sz > cap) return -1;
+      std::memset(out + off, 0, sz);
+      for (int k = 0; k < ct.n; k++) {
+        uint16_t v = static_cast<uint16_t>(p[k] & 0xFFFF);
+        out[off + (v >> 3)] |= static_cast<uint8_t>(1u << (v & 7));
+      }
+      off += sz;
+    }
+  }
+  return off;
+}
+
+void positions_to_words(const uint64_t* pos, int64_t n, uint32_t* words,
+                        int64_t n_words) {
+  for (int64_t k = 0; k < n; k++) {
+    uint64_t p = pos[k];
+    int64_t w = static_cast<int64_t>(p >> 5);
+    if (w < n_words) words[w] |= 1u << (p & 31);
+  }
+}
+
+int64_t words_to_positions(const uint32_t* words, int64_t n_words,
+                           uint64_t* out, int64_t cap) {
+  int64_t n = 0;
+  for (int64_t w = 0; w < n_words; w++) {
+    uint32_t word = words[w];
+    while (word) {
+      int b = __builtin_ctz(word);
+      if (n >= cap) return -1;
+      out[n++] = (static_cast<uint64_t>(w) << 5) + b;
+      word &= word - 1;
+    }
+  }
+  return n;
+}
+
+int64_t popcount_words(const uint32_t* words, int64_t n_words) {
+  int64_t total = 0;
+  for (int64_t w = 0; w < n_words; w++)
+    total += __builtin_popcount(words[w]);
+  return total;
+}
+
+}  // extern "C"

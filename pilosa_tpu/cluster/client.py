@@ -103,6 +103,20 @@ class LocalClient:
         broadcast.go:55-72)."""
         return self._peer(node).handle_message(message)
 
+    def send_import_roaring(self, node, index, field, shard, data: bytes,
+                            clear=False):
+        return self._peer(node).handle_import_roaring(index, field, shard,
+                                                      data, clear)
+
+    def fetch_fragment(self, node, index, field, view, shard) -> bytes:
+        """Whole-fragment payload for resize streaming
+        (client.go:71 RetrieveShardFromURI)."""
+        return self._peer(node).handle_fragment_data(index, field, view, shard)
+
+    def probe(self, node) -> None:
+        """Liveness probe (the /version check of confirmNodeDown)."""
+        self._peer(node)
+
     def send_import(self, node, index, field, shard, rows=None, cols=None,
                     values=None, timestamps=None, clear=False):
         """Field-level import routed to an owning node (api.go:967)."""

@@ -72,7 +72,18 @@ class ClusterNode:
                 pass  # best-effort, like the 50ms-timeout broadcast
 
     def handle_message(self, message: dict) -> None:
-        handle_cluster_message(self.holder, message)
+        t = message.get("type")
+        if t == "resize-instruction":
+            from pilosa_tpu.cluster.resize import apply_resize_instruction
+            apply_resize_instruction(self.holder, self.cluster.client,
+                                     self.cluster, message["sources"])
+        elif t == "cluster-status":
+            from pilosa_tpu.cluster.resize import apply_cluster_status
+            apply_cluster_status(self.cluster, message["nodes"],
+                                 holder=self.holder,
+                                 availability=message.get("availability"))
+        else:
+            handle_cluster_message(self.holder, message)
 
     def handle_import_request(self, index, field, rows=None, cols=None,
                               values=None, timestamps=None,
@@ -118,6 +129,19 @@ class ClusterNode:
         v = f.create_view_if_not_exists(view)
         frag = v.create_fragment_if_not_exists(shard)
         frag.bulk_import(rows, cols, clear=clear)
+
+    def handle_import_roaring(self, index, field, shard, data: bytes,
+                              clear=False):
+        f = self.holder.field(index, field)
+        if f is None:
+            raise LookupError(f"field not found: {index}/{field}")
+        f.import_roaring(shard, data, clear=clear)
+
+    def handle_fragment_data(self, index, field, view, shard) -> bytes:
+        frag = self.holder.fragment(index, field, view, shard)
+        if frag is None:
+            raise LookupError(f"fragment not found: {index}/{field}/{view}/{shard}")
+        return frag.to_roaring()
 
     def handle_schema(self):
         return self.holder.schema()

@@ -1,0 +1,205 @@
+"""Elastic resize: add/remove nodes with fragment redistribution.
+
+Reference: cluster.go resize machinery — `diff` (:745) computes
+added/removed nodes, `fragSources` (:784-868) computes which node streams
+which fragment to whom, `resizeJob` (:1447-1561) distributes
+ResizeInstructions to nodes, `followResizeInstruction` (:1297-1411) makes
+each node fetch its missing fragments from source nodes; one job at a
+time; abortable (api.go:1250).
+
+Instructions travel as control-plane messages ("resize-instruction") so
+the same flow works over the in-process LocalClient and real HTTP.
+Fragments travel as serialized roaring bitmaps (Fragment.to_roaring /
+import_roaring — the reference's fragment stream, client.go:71,
+fragment.go:2436).
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass
+
+from pilosa_tpu.cluster.cluster import (
+    STATE_NORMAL,
+    STATE_RESIZING,
+    Cluster,
+)
+from pilosa_tpu.cluster.node import URI, Node
+
+
+@dataclass
+class ResizeSource:
+    """One fragment a node must fetch (reference ResizeSource)."""
+
+    source_node: str
+    index: str
+    field: str
+    view: str
+    shard: int
+
+
+def fragment_sources(old: Cluster, new: Cluster, schema_fragments) -> dict[str, list[ResizeSource]]:
+    """Pure placement diff: target node id -> fragments to fetch.
+
+    A node in the NEW owner set that wasn't an OLD owner fetches from the
+    first old owner (reference fragSources cluster.go:784-868)."""
+    out: dict[str, list[ResizeSource]] = {}
+    for index, field, view, shard in schema_fragments:
+        old_owners = [n.id for n in old.shard_nodes(index, shard)]
+        new_owners = [n.id for n in new.shard_nodes(index, shard)]
+        for target in new_owners:
+            if target in old_owners or not old_owners:
+                continue
+            out.setdefault(target, []).append(ResizeSource(
+                source_node=old_owners[0], index=index, field=field,
+                view=view, shard=shard))
+    return out
+
+
+def apply_resize_instruction(holder, client, cluster: Cluster,
+                             sources: list[dict]) -> None:
+    """followResizeInstruction (cluster.go:1297): fetch each fragment
+    from its source node and merge it locally."""
+    for s in sources:
+        src = ResizeSource(**s)
+        node = cluster.node_by_id(src.source_node)
+        if node is None:
+            continue
+        data = client.fetch_fragment(node, src.index, src.field, src.view,
+                                     src.shard)
+        f = holder.field(src.index, src.field)
+        if f is None:
+            continue
+        f.import_roaring(src.shard, data, view=src.view)
+
+
+def apply_cluster_status(cluster: Cluster, nodes_json: list[dict],
+                         holder=None, availability: dict | None = None) -> None:
+    """mergeClusterStatus (cluster.go:1943): adopt a broadcast topology
+    and, like the reference's NodeStatus, the sender's per-field shard
+    availability so new members can route queries for shards they don't
+    hold locally."""
+    cluster.nodes = sorted(
+        (Node(id=n["id"],
+              uri=URI(scheme=n["uri"].get("scheme", "http"),
+                      host=n["uri"]["host"], port=n["uri"]["port"]),
+              is_coordinator=n.get("isCoordinator", False))
+         for n in nodes_json),
+        key=lambda n: n.id)
+    cluster._update_state()
+    if holder is not None and availability:
+        for index, fields in availability.items():
+            idx = holder.index(index)
+            if idx is None:
+                continue
+            for field, shards in fields.items():
+                f = idx.field(field)
+                if f is not None:
+                    f.add_remote_available_shards(shards)
+
+
+def holder_availability(holder) -> dict:
+    """{index: {field: [shards]}} from a holder's point of view."""
+    out: dict = {}
+    for iname in holder.index_names():
+        idx = holder.index(iname)
+        out[iname] = {fname: sorted(f.available_shards())
+                      for fname, f in idx.fields.items()}
+    return out
+
+
+class ResizeJob:
+    """Coordinator-driven resize. Known limitation for this round: the
+    fragment inventory is the coordinator's view (schema + broadcast
+    shard availability); remote-only time views are re-synced by
+    anti-entropy after the resize."""
+
+    def __init__(self, cluster: Cluster, holder, client):
+        self.cluster = cluster
+        self.holder = holder
+        self.client = client
+        self.state = "RUNNING"
+
+    def abort(self) -> None:
+        self.state = "ABORTED"
+
+    def _schema_fragments(self):
+        out = set()
+        for iname in self.holder.index_names():
+            idx = self.holder.index(iname)
+            for fname, f in idx.fields.items():
+                views = set(f.views)
+                shards = f.available_shards()
+                for vname in views or set():
+                    for shard in shards:
+                        out.add((iname, fname, vname, shard))
+        return sorted(out)
+
+    def run(self, new_nodes: list[Node]) -> str:
+        old_view = Cluster("_old", [Node(id=n.id, uri=n.uri)
+                                    for n in self.cluster.nodes],
+                           replica_n=self.cluster.replica_n,
+                           partition_n=self.cluster.partition_n)
+        new_view = Cluster("_new", [Node(id=n.id, uri=n.uri)
+                                    for n in new_nodes],
+                           replica_n=self.cluster.replica_n,
+                           partition_n=self.cluster.partition_n)
+        self.cluster.set_state(STATE_RESIZING)
+        try:
+            instructions = fragment_sources(old_view, new_view,
+                                            self._schema_fragments())
+            for target_id, sources in sorted(instructions.items()):
+                if self.state == "ABORTED":
+                    return self.state
+                payload = [asdict(s) for s in sources]
+                if target_id == self.cluster.local_id:
+                    apply_resize_instruction(self.holder, self.client,
+                                             old_view, payload)
+                else:
+                    node = new_view.node_by_id(target_id)
+                    self.client.send_message(
+                        node, {"type": "resize-instruction",
+                               "sources": payload})
+            # Commit: broadcast the new topology + shard availability,
+            # adopt it locally.
+            status = {"type": "cluster-status",
+                      "nodes": [n.to_json() for n in new_nodes],
+                      "availability": holder_availability(self.holder)}
+            for node in new_nodes:
+                if node.id != self.cluster.local_id:
+                    try:
+                        self.client.send_message(node, status)
+                    except (ConnectionError, RuntimeError):
+                        pass
+            apply_cluster_status(self.cluster, status["nodes"])
+            self.state = "DONE"
+            return self.state
+        finally:
+            if self.cluster.state == STATE_RESIZING:
+                self.cluster.set_state(STATE_NORMAL)
+
+
+def check_nodes(cluster: Cluster, client, retries: int = 2) -> list[str]:
+    """Failure detector sweep: probe every peer, confirm before marking
+    down (reference confirmNodeDown cluster.go:1724-1751: /version probe
+    with retry). Returns ids whose state changed."""
+    changed = []
+    for node in cluster.nodes:
+        if node.id == cluster.local_id:
+            continue
+        alive = False
+        for _ in range(retries):
+            try:
+                client.probe(node)
+                alive = True
+                break
+            except ConnectionError:
+                continue
+        if alive and node.state == "DOWN":
+            node.state = "READY"
+            changed.append(node.id)
+        elif not alive and node.state != "DOWN":
+            node.state = "DOWN"
+            changed.append(node.id)
+    if changed:
+        cluster._update_state()
+    return changed

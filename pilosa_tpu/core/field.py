@@ -511,6 +511,13 @@ class Field:
             frag = view.create_fragment_if_not_exists(shard)
             frag.import_values(cids, vals, bsig.bit_depth, clear=clear)
 
+    def import_roaring(self, shard: int, data: bytes, view: str = VIEW_STANDARD,
+                       clear: bool = False) -> int:
+        """Reference Field.importRoaring (field.go:1374)."""
+        v = self.create_view_if_not_exists(view)
+        frag = v.create_fragment_if_not_exists(shard)
+        return frag.import_roaring(data, clear=clear)
+
     # -- schema ------------------------------------------------------------
 
     def info(self) -> dict:

@@ -232,6 +232,32 @@ class Fragment:
                 self._invalidate()
             return changed
 
+    def import_roaring(self, data: bytes, clear: bool = False) -> int:
+        """Merge a serialized roaring bitmap of pos-encoded bits
+        (pos = row*ShardWidth + col_local, fragment.go:3090) into this
+        fragment (reference importRoaring fragment.go:2255 →
+        ImportRoaringBits roaring.go:1511). Returns changed-bit count."""
+        from pilosa_tpu import native
+        positions = native.decode_roaring(data)
+        if len(positions) == 0:
+            return 0
+        rows = (positions // np.uint64(SHARD_WIDTH)).astype(np.uint64)
+        cols = (positions % np.uint64(SHARD_WIDTH)).astype(np.uint64)
+        abs_cols = cols + np.uint64(self.shard * SHARD_WIDTH)
+        return self.bulk_import(rows.tolist(), abs_cols.tolist(), clear=clear)
+
+    def to_roaring(self) -> bytes:
+        """Serialize all bits in the reference's pos-encoded roaring
+        format (the fragment-data transfer format, fragment.go:2436)."""
+        from pilosa_tpu import native
+        parts = []
+        for rid in sorted(self.rows):
+            pos = self.rows[rid].to_positions()
+            parts.append(pos + np.uint64(rid * SHARD_WIDTH))
+        positions = (np.concatenate(parts) if parts
+                     else np.empty(0, dtype=np.uint64))
+        return native.encode_roaring(positions)
+
     # -- reads -------------------------------------------------------------
 
     def row_ids(self) -> list[int]:

@@ -77,7 +77,7 @@ class Executor:
     """Reference executor (executor.go:72)."""
 
     def __init__(self, holder: Holder, cluster=None, node_id: str | None = None,
-                 planner=None):
+                 planner=None, stats=None):
         self.holder = holder
         #: cluster hooks (pilosa_tpu.cluster); None = standalone node.
         self.cluster = cluster
@@ -85,6 +85,8 @@ class Executor:
         #: MeshPlanner (pilosa_tpu.parallel): SPMD fast path for bitmap
         #: trees and Count() — one XLA program over all shards.
         self.planner = planner
+        from pilosa_tpu.obs import NopStats
+        self.stats = stats or NopStats()
 
     def _planner_for(self, c: Call, opt: "ExecOptions"):
         if self.planner is None:
@@ -131,6 +133,16 @@ class Executor:
 
     def _execute_call(self, idx: Index, c: Call, shards: list[int],
                       opt: ExecOptions) -> Any:
+        name = c.name
+        # Per-call stats, tagged by index (reference CountWithCustomTags,
+        # executor.go:295 etc.).
+        self.stats.with_tags(f"index:{idx.name}").count(name)
+        from pilosa_tpu.obs import start_span
+        with start_span(f"Executor.execute{name}"):
+            return self._execute_call_inner(idx, c, shards, opt)
+
+    def _execute_call_inner(self, idx: Index, c: Call, shards: list[int],
+                            opt: ExecOptions) -> Any:
         name = c.name
         if name == "Sum":
             return self._execute_sum(idx, c, shards, opt)

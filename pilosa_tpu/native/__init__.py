@@ -1,0 +1,140 @@
+"""ctypes bindings for the native C++ runtime (native/roaring_codec.cpp).
+
+The native library is built on first use (``make -C native``) and cached;
+every entry point falls back to the pure-numpy implementation
+(pilosa_tpu.roaring / ops.bitops) when the toolchain or library is
+unavailable, so the package never hard-depends on the build.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libpilosa_native.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("PILOSA_TPU_NO_NATIVE") == "1":
+            return None
+        if not os.path.exists(_LIB_PATH):
+            try:
+                subprocess.run(["make", "-C", _NATIVE_DIR, "-s"],
+                               check=True, capture_output=True, timeout=120)
+            except Exception:
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+        lib.roaring_decode_count.restype = ctypes.c_int64
+        lib.roaring_decode_count.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        lib.roaring_decode.restype = ctypes.c_int64
+        lib.roaring_decode.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.uint64, flags="C"), ctypes.c_int64]
+        lib.roaring_encode_bound.restype = ctypes.c_int64
+        lib.roaring_encode_bound.argtypes = [
+            np.ctypeslib.ndpointer(np.uint64, flags="C"), ctypes.c_int64]
+        lib.roaring_encode.restype = ctypes.c_int64
+        lib.roaring_encode.argtypes = [
+            np.ctypeslib.ndpointer(np.uint64, flags="C"), ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.uint8, flags="C"), ctypes.c_int64]
+        lib.positions_to_words.restype = None
+        lib.positions_to_words.argtypes = [
+            np.ctypeslib.ndpointer(np.uint64, flags="C"), ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.uint32, flags="C"), ctypes.c_int64]
+        lib.words_to_positions.restype = ctypes.c_int64
+        lib.words_to_positions.argtypes = [
+            np.ctypeslib.ndpointer(np.uint32, flags="C"), ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.uint64, flags="C"), ctypes.c_int64]
+        lib.popcount_words.restype = ctypes.c_int64
+        lib.popcount_words.argtypes = [
+            np.ctypeslib.ndpointer(np.uint32, flags="C"), ctypes.c_int64]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def decode_roaring(buf: bytes) -> np.ndarray:
+    """Serialized roaring bitmap -> sorted uint64 positions."""
+    lib = _load()
+    if lib is None:
+        from pilosa_tpu import roaring
+        return roaring.decode(buf)
+    n = lib.roaring_decode_count(buf, len(buf))
+    if n < 0:
+        raise ValueError("roaring: invalid buffer")
+    out = np.empty(n, dtype=np.uint64)
+    got = lib.roaring_decode(buf, len(buf), out, n)
+    if got != n:
+        raise ValueError("roaring: decode failed")
+    return out
+
+
+def encode_roaring(positions: np.ndarray) -> bytes:
+    """Sorted uint64 positions -> serialized roaring bitmap."""
+    positions = np.ascontiguousarray(positions, dtype=np.uint64)
+    lib = _load()
+    if lib is None:
+        from pilosa_tpu import roaring
+        return roaring.encode(positions)
+    cap = lib.roaring_encode_bound(positions, len(positions))
+    out = np.empty(cap, dtype=np.uint8)
+    n = lib.roaring_encode(positions, len(positions), out, cap)
+    if n < 0:
+        raise ValueError("roaring: encode failed")
+    return out[:n].tobytes()
+
+
+def positions_to_words(positions: np.ndarray, n_words: int) -> np.ndarray:
+    positions = np.ascontiguousarray(positions, dtype=np.uint64)
+    lib = _load()
+    if lib is None:
+        from pilosa_tpu.ops import bitops
+        return bitops.positions_to_words(positions, n_words)
+    words = np.zeros(n_words, dtype=np.uint32)
+    lib.positions_to_words(positions, len(positions), words, n_words)
+    return words
+
+
+def words_to_positions(words: np.ndarray) -> np.ndarray:
+    words = np.ascontiguousarray(words, dtype=np.uint32)
+    lib = _load()
+    if lib is None:
+        from pilosa_tpu.ops import bitops
+        return bitops.words_to_positions(words)
+    n = lib.popcount_words(words, len(words))
+    out = np.empty(n, dtype=np.uint64)
+    got = lib.words_to_positions(words, len(words), out, n)
+    if got != n:
+        raise RuntimeError("words_to_positions mismatch")
+    return out
+
+
+def popcount_words(words: np.ndarray) -> int:
+    words = np.ascontiguousarray(words, dtype=np.uint32)
+    lib = _load()
+    if lib is None:
+        from pilosa_tpu.ops import bitops
+        return bitops.np_count(words)
+    return int(lib.popcount_words(words, len(words)))

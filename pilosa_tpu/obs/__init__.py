@@ -1,0 +1,32 @@
+"""Observability: stats, tracing, logging.
+
+Reference: stats/ (StatsClient stats.go:31, expvar default, statsd/,
+prometheus/), tracing/ (Tracer/Span tracing.go:32, global singleton :23,
+Jaeger backend via opentracing), logger/ (logger.go), plus the runtime
+monitor in server.go:813-855. Diagnostics phone-home (diagnostics.go) is
+intentionally NOT implemented (always off).
+"""
+
+from pilosa_tpu.obs.logger import Logger, NopLogger, StandardLogger
+from pilosa_tpu.obs.stats import (
+    MemoryStats,
+    NopStats,
+    StatsClient,
+    prometheus_text,
+)
+from pilosa_tpu.obs.tracing import (
+    NopTracer,
+    SimpleTracer,
+    Span,
+    Tracer,
+    get_tracer,
+    set_tracer,
+    start_span,
+)
+
+__all__ = [
+    "Logger", "NopLogger", "StandardLogger",
+    "MemoryStats", "NopStats", "StatsClient", "prometheus_text",
+    "NopTracer", "SimpleTracer", "Span", "Tracer",
+    "get_tracer", "set_tracer", "start_span",
+]

@@ -1,0 +1,107 @@
+"""Stats: tagged counters/gauges/timings.
+
+Reference: stats/stats.go:31 (StatsClient interface: WithTags, Count,
+Gauge, Histogram, Timing, SetLogger), default expvar backend, and
+prometheus/prometheus.go scraped at /metrics. Here MemoryStats is the
+expvar analog and doubles as the Prometheus registry — prometheus_text()
+renders the exposition format without a client library.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Protocol
+
+
+class StatsClient(Protocol):
+    def with_tags(self, *tags: str) -> "StatsClient": ...
+    def count(self, name: str, value: int = 1, rate: float = 1.0) -> None: ...
+    def gauge(self, name: str, value: float) -> None: ...
+    def timing(self, name: str, seconds: float) -> None: ...
+
+
+class NopStats:
+    """Reference NopStatsClient."""
+
+    def with_tags(self, *tags: str) -> "NopStats":
+        return self
+
+    def count(self, name: str, value: int = 1, rate: float = 1.0) -> None:
+        pass
+
+    def gauge(self, name: str, value: float) -> None:
+        pass
+
+    def timing(self, name: str, seconds: float) -> None:
+        pass
+
+
+class MemoryStats:
+    """In-memory tagged metrics (expvar analog + prometheus registry)."""
+
+    def __init__(self, tags: tuple[str, ...] = (), _parent=None):
+        self.tags = tags
+        if _parent is None:
+            self._lock = threading.Lock()
+            self.counters: dict[tuple[str, tuple], float] = {}
+            self.gauges: dict[tuple[str, tuple], float] = {}
+            self.timings: dict[tuple[str, tuple], list[float]] = {}
+        else:
+            self._lock = _parent._lock
+            self.counters = _parent.counters
+            self.gauges = _parent.gauges
+            self.timings = _parent.timings
+
+    def with_tags(self, *tags: str) -> "MemoryStats":
+        child = MemoryStats(tuple(sorted(set(self.tags) | set(tags))),
+                            _parent=self)
+        return child
+
+    def count(self, name: str, value: int = 1, rate: float = 1.0) -> None:
+        with self._lock:
+            key = (name, self.tags)
+            self.counters[key] = self.counters.get(key, 0) + value
+
+    def gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self.gauges[(name, self.tags)] = value
+
+    def timing(self, name: str, seconds: float) -> None:
+        with self._lock:
+            self.timings.setdefault((name, self.tags), []).append(seconds)
+
+    def counter_value(self, name: str, *tags: str) -> float:
+        return self.counters.get((name, tuple(sorted(tags))), 0)
+
+
+def _fmt_labels(tags: tuple[str, ...]) -> str:
+    if not tags:
+        return ""
+    pairs = []
+    for t in tags:
+        k, _, v = t.partition(":")
+        pairs.append(f'{_sanitize(k)}="{v or "true"}"')
+    return "{" + ",".join(pairs) + "}"
+
+
+def _sanitize(name: str) -> str:
+    return "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+
+
+def prometheus_text(stats: MemoryStats) -> str:
+    """Prometheus exposition format (the /metrics payload,
+    prometheus/prometheus.go analog)."""
+    lines = []
+    with stats._lock:
+        for (name, tags), v in sorted(stats.counters.items()):
+            lines.append(f"# TYPE pilosa_{_sanitize(name)} counter")
+            lines.append(f"pilosa_{_sanitize(name)}{_fmt_labels(tags)} {v}")
+        for (name, tags), v in sorted(stats.gauges.items()):
+            lines.append(f"# TYPE pilosa_{_sanitize(name)} gauge")
+            lines.append(f"pilosa_{_sanitize(name)}{_fmt_labels(tags)} {v}")
+        for (name, tags), vals in sorted(stats.timings.items()):
+            n = _sanitize(name)
+            lines.append(f"# TYPE pilosa_{n}_seconds summary")
+            lines.append(f"pilosa_{n}_seconds_count{_fmt_labels(tags)} {len(vals)}")
+            lines.append(f"pilosa_{n}_seconds_sum{_fmt_labels(tags)} {sum(vals)}")
+    return "\n".join(lines) + "\n"

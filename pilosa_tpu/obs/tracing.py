@@ -1,0 +1,104 @@
+"""Tracing: vendor-neutral Tracer/Span with a global singleton.
+
+Reference: tracing/tracing.go (Tracer :32, Span :45, GlobalTracer :23,
+StartSpanFromContext, InjectHTTPHeaders/ExtractHTTPHeaders for
+cross-node propagation). SimpleTracer records spans in memory; a Jaeger/
+OTLP exporter would implement the same two-method interface.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Protocol
+
+TRACE_HEADER = "X-Pilosa-Trace-Id"
+
+
+class Span(Protocol):
+    def finish(self) -> None: ...
+    def set_tag(self, key: str, value) -> None: ...
+
+
+class Tracer(Protocol):
+    def start_span(self, operation: str, parent_id: str | None = None) -> Span: ...
+
+
+class _NopSpan:
+    def finish(self) -> None:
+        pass
+
+    def set_tag(self, key, value) -> None:
+        pass
+
+
+class NopTracer:
+    """Reference NopTracer (tracing.go:52)."""
+
+    def start_span(self, operation: str, parent_id: str | None = None):
+        return _NopSpan()
+
+
+@dataclass
+class RecordedSpan:
+    operation: str
+    start: float
+    parent_id: str | None = None
+    end: float | None = None
+    tags: dict = field(default_factory=dict)
+    span_id: str = ""
+
+    def finish(self) -> None:
+        self.end = time.perf_counter()
+
+    def set_tag(self, key, value) -> None:
+        self.tags[key] = value
+
+    @property
+    def duration(self) -> float | None:
+        return None if self.end is None else self.end - self.start
+
+
+class SimpleTracer:
+    """In-memory recording tracer (test + debugging backend)."""
+
+    def __init__(self, max_spans: int = 10_000):
+        self.spans: list[RecordedSpan] = []
+        self.max_spans = max_spans
+        self._lock = threading.Lock()
+        self._next = 0
+
+    def start_span(self, operation: str, parent_id: str | None = None):
+        span = RecordedSpan(operation=operation, start=time.perf_counter(),
+                            parent_id=parent_id)
+        with self._lock:
+            self._next += 1
+            span.span_id = str(self._next)
+            if len(self.spans) < self.max_spans:
+                self.spans.append(span)
+        return span
+
+
+_global: Tracer = NopTracer()
+
+
+def set_tracer(t: Tracer) -> None:
+    global _global
+    _global = t
+
+
+def get_tracer() -> Tracer:
+    return _global
+
+
+@contextlib.contextmanager
+def start_span(operation: str, parent_id: str | None = None):
+    """with start_span("executor.Execute"): ... — the
+    StartSpanFromContext analog used at executor/API boundaries."""
+    span = _global.start_span(operation, parent_id)
+    try:
+        yield span
+    finally:
+        span.finish()

@@ -193,6 +193,23 @@ class API:
                         values=[values[i] for i in idxs] if values else None,
                         timestamps=ts_out, clear=clear)
 
+    def import_roaring(self, index: str, field: str, shard: int,
+                       data: bytes, clear: bool = False) -> None:
+        """Reference API.ImportRoaring (api.go:368)."""
+        idx = self.holder.index_or_raise(index)
+        f = idx.field(field)
+        if f is None:
+            raise FieldNotFoundError()
+        if self.cluster is not None:
+            for node in self.cluster.shard_nodes(index, shard):
+                if node.id == self.cluster.local_id:
+                    f.import_roaring(shard, data, clear=clear)
+                else:
+                    self.cluster.client.send_import_roaring(
+                        node, index, field, shard, data, clear)
+        else:
+            f.import_roaring(shard, data, clear=clear)
+
     # -- export (api.go:500) -----------------------------------------------
 
     def export_csv(self, index: str, field: str, shard: int) -> str:

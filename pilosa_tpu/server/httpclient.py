@@ -98,3 +98,27 @@ class HTTPInternalClient:
     def send_message(self, node: Node, message: dict):
         self._request(node, "POST", "/internal/cluster/message",
                       json.dumps(message).encode())
+
+    def send_import_roaring(self, node, index, field, shard, data: bytes,
+                            clear=False):
+        path = (f"/index/{index}/field/{field}/import-roaring/{shard}"
+                f"?remote=true" + ("&clear=true" if clear else ""))
+        self._request(node, "POST", path, data)
+
+    def fetch_fragment(self, node, index, field, view, shard) -> bytes:
+        req = urllib.request.Request(self._url(
+            node, f"/internal/fragment/data?index={index}&field={field}"
+                  f"&view={view}&shard={shard}"))
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            raise LookupError(f"{node.id}: {e.read().decode(errors='replace')}")
+        except (urllib.error.URLError, OSError) as e:
+            raise ConnectionError(f"node {node.id} unreachable: {e}") from e
+
+    def probe(self, node) -> None:
+        try:
+            self._request(node, "GET", "/version")
+        except (RuntimeError, LookupError):
+            pass  # alive but unhappy still counts as alive

@@ -99,6 +99,9 @@ def _make_handler(api: API):
             if isinstance(payload, (dict, list)):
                 data = (json.dumps(payload) + "\n").encode()
                 ctype = "application/json"
+            elif isinstance(payload, bytes):
+                data = payload
+                ctype = "application/octet-stream"
             else:
                 data = str(payload).encode()
                 ctype = "text/plain"
@@ -175,6 +178,19 @@ def _build_routes(api: API):
                             clear=clear)
         return 200, {}
 
+    def post_import_roaring(pv, params, body):
+        # remote=true marks a forwarded replica write: apply locally only.
+        if params.get("remote") == "true":
+            f = api.holder.field(pv["index"], pv["field"])
+            if f is None:
+                raise FieldNotFoundError()
+            f.import_roaring(int(pv["shard"]), body,
+                             clear=params.get("clear") == "true")
+        else:
+            api.import_roaring(pv["index"], pv["field"], int(pv["shard"]),
+                               body, clear=params.get("clear") == "true")
+        return 200, {}
+
     def post_query(pv, params, body):
         shards = None
         if params.get("shards"):
@@ -214,6 +230,13 @@ def _build_routes(api: API):
     def get_version(pv, params, body):
         return 200, {"version": api.info()["version"]}
 
+    def get_metrics(pv, params, body):
+        from pilosa_tpu.obs import MemoryStats, prometheus_text
+        stats = getattr(api.executor, "stats", None)
+        if isinstance(stats, MemoryStats):
+            return 200, prometheus_text(stats)
+        return 200, "# no stats backend configured\n"
+
     def post_recalculate(pv, params, body):
         api.recalculate_caches()
         return 200, {}
@@ -233,6 +256,34 @@ def _build_routes(api: API):
         server = getattr(api, "message_handler", None)
         if server is not None:
             server(msg)
+        return 200, {}
+
+    def get_fragment_data(pv, params, body):
+        frag = api.holder.fragment(params["index"], params["field"],
+                                   params["view"], int(params["shard"]))
+        if frag is None:
+            raise FragmentNotFoundError()
+        return 200, frag.to_roaring()
+
+    def post_resize_abort(pv, params, body):
+        job = getattr(api, "resize_job", None)
+        if job is not None:
+            job.abort()
+        return 200, {}
+
+    def post_resize_remove_node(pv, params, body):
+        req = jbody(body)
+        handler = getattr(api, "resize_handler", None)
+        if handler is None:
+            return 400, {"error": "resize not supported on this node"}
+        handler("remove", req.get("id"))
+        return 200, {}
+
+    def post_set_coordinator(pv, params, body):
+        req = jbody(body)
+        if api.cluster is not None:
+            for n in api.cluster.nodes:
+                n.is_coordinator = (n.id == req.get("id"))
         return 200, {}
 
     def get_fragment_blocks(pv, params, body):
@@ -265,6 +316,9 @@ def _build_routes(api: API):
         (r"/index/(?P<index>[^/]+)/query", {"POST": post_query}),
         (r"/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/import",
          {"POST": post_import}),
+        (r"/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/import-roaring/"
+         r"(?P<shard>[0-9]+)",
+         {"POST": post_import_roaring}),
         (r"/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)",
          {"POST": post_field, "DELETE": delete_field}),
         (r"/index/(?P<index>[^/]+)",
@@ -274,11 +328,16 @@ def _build_routes(api: API):
         (r"/status", {"GET": get_status}),
         (r"/info", {"GET": get_info}),
         (r"/version", {"GET": get_version}),
+        (r"/metrics", {"GET": get_metrics}),
         (r"/recalculate-caches", {"POST": post_recalculate}),
         (r"/internal/shards/max", {"GET": get_shards_max}),
         (r"/internal/translate/keys", {"POST": post_translate_keys}),
         (r"/internal/cluster/message", {"POST": post_cluster_message}),
         (r"/internal/fragment/blocks", {"GET": get_fragment_blocks}),
+        (r"/internal/fragment/data", {"GET": get_fragment_data}),
+        (r"/cluster/resize/abort", {"POST": post_resize_abort}),
+        (r"/cluster/resize/remove-node", {"POST": post_resize_remove_node}),
+        (r"/cluster/resize/set-coordinator", {"POST": post_set_coordinator}),
         (r"/internal/fragment/block/data", {"GET": get_fragment_block_data}),
         (r"/internal/import", {"POST": post_internal_import}),
         (r"/internal/nodes", {"GET": get_nodes}),

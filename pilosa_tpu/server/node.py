@@ -53,6 +53,8 @@ class ServerNode:
                                    client=HTTPInternalClient())
             self.cluster.set_state(STATE_NORMAL)
 
+        from pilosa_tpu.obs import MemoryStats
+        self.stats = MemoryStats()
         self.holder = Holder(fragment_listener=self._broadcast_shard)
         planner = None
         if use_planner:
@@ -62,11 +64,13 @@ class ServerNode:
             except Exception:
                 planner = None
         self.executor = Executor(self.holder, cluster=self.cluster,
-                                 node_id=self.id, planner=planner)
+                                 node_id=self.id, planner=planner,
+                                 stats=self.stats)
         self.api = API(self.holder, self.executor, cluster=self.cluster)
         # Handler hooks used by the HTTP router's /internal routes.
         self.api.message_handler = self.handle_message
         self.api.import_handler = self.handle_internal_import
+        self.api.resize_handler = self.resize
         self.http = HTTPServer(self.api, self.host, self.port)
         self.port = self.http.port
 
@@ -128,7 +132,39 @@ class ServerNode:
                 pass
 
     def handle_message(self, message: dict) -> None:
-        handle_cluster_message(self.holder, message)
+        t = message.get("type")
+        if t == "resize-instruction" and self.cluster is not None:
+            from pilosa_tpu.cluster.resize import apply_resize_instruction
+            apply_resize_instruction(self.holder, self.cluster.client,
+                                     self.cluster, message["sources"])
+        elif t == "cluster-status" and self.cluster is not None:
+            from pilosa_tpu.cluster.resize import apply_cluster_status
+            apply_cluster_status(self.cluster, message["nodes"],
+                                 holder=self.holder,
+                                 availability=message.get("availability"))
+        else:
+            handle_cluster_message(self.holder, message)
+
+    def resize(self, action: str, node_id: str | None = None,
+               addr: str | None = None) -> str:
+        """Coordinator-driven membership change (api.go RemoveNode :1220;
+        node addition = reference's join-triggered resize)."""
+        if self.cluster is None:
+            raise RuntimeError("standalone node cannot resize")
+        from pilosa_tpu.cluster.node import URI, Node
+        from pilosa_tpu.cluster.resize import ResizeJob
+        new_nodes = [Node(id=n.id, uri=n.uri, is_coordinator=n.is_coordinator)
+                     for n in self.cluster.nodes]
+        if action == "remove":
+            new_nodes = [n for n in new_nodes if n.id != node_id]
+        elif action == "add":
+            h, _, p = (addr or "").partition(":")
+            new_nodes.append(Node(id=addr, uri=URI(host=h, port=int(p))))
+        else:
+            raise ValueError(f"unknown resize action {action!r}")
+        job = ResizeJob(self.cluster, self.holder, self.cluster.client)
+        self.api.resize_job = job
+        return job.run(new_nodes)
 
     def handle_internal_import(self, req: dict) -> None:
         """JSON /internal/import payloads: fragment-level (anti-entropy

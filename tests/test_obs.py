@@ -1,0 +1,105 @@
+"""Observability tests: stats counting, prometheus exposition, tracing
+spans, logger, /metrics endpoint."""
+
+import io
+import urllib.request
+
+from pilosa_tpu.core import Holder
+from pilosa_tpu.exec import Executor
+from pilosa_tpu.obs import (
+    MemoryStats,
+    NopStats,
+    SimpleTracer,
+    StandardLogger,
+    prometheus_text,
+    set_tracer,
+    start_span,
+)
+from pilosa_tpu.obs.tracing import NopTracer
+
+
+def test_memory_stats_tags():
+    s = MemoryStats()
+    s.count("Query")
+    s.with_tags("index:i").count("Query", 2)
+    s.gauge("goroutines", 5)
+    s.timing("exec", 0.5)
+    assert s.counter_value("Query") == 1
+    assert s.counter_value("Query", "index:i") == 2
+    text = prometheus_text(s)
+    assert 'pilosa_Query{index="i"} 2' in text
+    assert "pilosa_goroutines 5" in text
+    assert "pilosa_exec_seconds_count 1" in text
+
+
+def test_executor_counts_calls():
+    h = Holder()
+    idx = h.create_index("i")
+    idx.create_field("f")
+    stats = MemoryStats()
+    e = Executor(h, stats=stats)
+    e.execute("i", "Set(1, f=1)")
+    e.execute("i", "Count(Row(f=1))")
+    assert stats.counter_value("Set", "index:i") == 1
+    assert stats.counter_value("Count", "index:i") == 1
+    # Count's child Row is not double-counted as a top-level call
+    assert stats.counter_value("Row", "index:i") == 0
+
+
+def test_simple_tracer_records_spans():
+    t = SimpleTracer()
+    set_tracer(t)
+    try:
+        h = Holder()
+        idx = h.create_index("i")
+        idx.create_field("f")
+        e = Executor(h)
+        e.execute("i", "Set(1, f=1)")
+        ops = [s.operation for s in t.spans]
+        assert "Executor.executeSet" in ops
+        assert all(s.duration is not None for s in t.spans)
+    finally:
+        set_tracer(NopTracer())
+
+
+def test_start_span_contextmanager():
+    t = SimpleTracer()
+    set_tracer(t)
+    try:
+        with start_span("custom.op") as span:
+            span.set_tag("k", "v")
+        assert t.spans[0].operation == "custom.op"
+        assert t.spans[0].tags == {"k": "v"}
+    finally:
+        set_tracer(NopTracer())
+
+
+def test_logger_verbose_gate():
+    buf = io.StringIO()
+    log = StandardLogger(stream=buf, verbose=False)
+    log.printf("hello %s", "world")
+    log.debugf("hidden")
+    out = buf.getvalue()
+    assert "hello world" in out and "hidden" not in out
+    log2 = StandardLogger(stream=buf, verbose=True)
+    log2.debugf("shown")
+    assert "shown" in buf.getvalue()
+
+
+def test_metrics_endpoint():
+    from pilosa_tpu.server.node import ServerNode
+    n = ServerNode(bind="127.0.0.1:0", use_planner=False)
+    n.open()
+    try:
+        base = n.address
+        urllib.request.urlopen(urllib.request.Request(
+            base + "/index/i", data=b"{}", method="POST"), timeout=10)
+        urllib.request.urlopen(urllib.request.Request(
+            base + "/index/i/field/f", data=b"{}", method="POST"), timeout=10)
+        urllib.request.urlopen(urllib.request.Request(
+            base + "/index/i/query", data=b"Set(1, f=1)", method="POST"),
+            timeout=10)
+        text = urllib.request.urlopen(base + "/metrics", timeout=10).read().decode()
+        assert 'pilosa_Set{index="i"} 1' in text
+    finally:
+        n.close()

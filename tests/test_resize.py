@@ -1,0 +1,157 @@
+"""Elastic resize + failure-detector tests.
+
+Models cluster_internal_test.go's fragSources cases and the clustertests
+node add/remove flows.
+"""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.cluster import Cluster, Node
+from pilosa_tpu.cluster.harness import LocalCluster
+from pilosa_tpu.cluster.node import URI
+from pilosa_tpu.cluster.resize import (
+    ResizeJob,
+    check_nodes,
+    fragment_sources,
+)
+from pilosa_tpu.config import SHARD_WIDTH
+
+
+def test_fragment_sources_pure():
+    old = Cluster("a", [Node(id="a"), Node(id="b")], replica_n=1)
+    new = Cluster("a", [Node(id="a"), Node(id="b"), Node(id="c")], replica_n=1)
+    frags = [("i", "f", "standard", s) for s in range(20)]
+    srcs = fragment_sources(old, new, frags)
+    # only node c (the new node) fetches anything, and only shards it now owns
+    assert set(srcs) <= {"c"}
+    for s in srcs.get("c", []):
+        assert new.shard_nodes("i", s.shard)[0].id == "c"
+        assert s.source_node in ("a", "b")
+
+
+def seed(lc: LocalCluster, n_shards=6):
+    lc.create_index("i")
+    lc.create_field("i", "f")
+    cols = [s * SHARD_WIDTH + s for s in range(n_shards)]
+    for c in cols:
+        lc.query("i", f"Set({c}, f=1)")
+    return cols
+
+
+def test_grow_cluster_in_process():
+    lc = LocalCluster(2)
+    cols = seed(lc)
+    assert lc.query("i", "Count(Row(f=1))") == [len(cols)]
+
+    # Boot a third node and join it.
+    from pilosa_tpu.cluster.harness import ClusterNode
+    from pilosa_tpu.cluster.cluster import STATE_NORMAL
+    new_member = Node(id="node2", uri=URI(port=10103))
+    member_list = [Node(id=n.id, uri=n.uri) for n in lc[0].cluster.nodes]
+    c2 = Cluster("node2", member_list + [new_member], replica_n=1,
+                 client=lc.client)
+    c2.set_state(STATE_NORMAL)
+    cn2 = ClusterNode("node2", c2)
+    cn2.apply_schema(lc[0].holder.schema())
+    lc.client.register("node2", cn2)
+    lc.nodes.append(cn2)
+
+    job = ResizeJob(lc[0].cluster, lc[0].holder, lc.client)
+    state = job.run([Node(id=n.id, uri=n.uri) for n in lc[0].cluster.nodes]
+                    + [new_member])
+    assert state == "DONE"
+    assert len(lc[0].cluster.nodes) == 3
+    # All data still reachable, from any coordinator.
+    for node in range(3):
+        assert lc.query("i", "Count(Row(f=1))", node=node) == [len(cols)]
+
+
+def test_shrink_cluster_in_process():
+    lc = LocalCluster(3, replica_n=2)
+    cols = seed(lc)
+    victim = "node2"
+    keep = [Node(id=n.id, uri=n.uri, is_coordinator=n.is_coordinator)
+            for n in lc[0].cluster.nodes if n.id != victim]
+    job = ResizeJob(lc[0].cluster, lc[0].holder, lc.client)
+    assert job.run(keep) == "DONE"
+    lc.client.down.add(victim)  # victim actually gone
+    for node in range(2):
+        assert lc.query("i", "Count(Row(f=1))", node=node) == [len(cols)]
+
+
+def test_resize_abort():
+    lc = LocalCluster(2)
+    seed(lc)
+    job = ResizeJob(lc[0].cluster, lc[0].holder, lc.client)
+    job.abort()
+    state = job.run([Node(id=n.id, uri=n.uri) for n in lc[0].cluster.nodes]
+                    + [Node(id="nodeX", uri=URI(port=10199))])
+    assert state == "ABORTED"
+    assert len(lc[0].cluster.nodes) == 2  # membership unchanged
+
+
+def test_check_nodes_failure_detector():
+    lc = LocalCluster(3, replica_n=2)
+    c0 = lc[0].cluster
+    assert check_nodes(c0, lc.client) == []
+    lc.client.down.add("node1")
+    changed = check_nodes(c0, lc.client)
+    assert changed == ["node1"]
+    assert c0.node_by_id("node1").state == "DOWN"
+    assert c0.state == "DEGRADED"
+    lc.client.down.discard("node1")
+    assert check_nodes(c0, lc.client) == ["node1"]
+    assert c0.state == "NORMAL"
+
+
+def test_http_resize_remove_node():
+    """Full HTTP flow: 3 servers, coordinator removes one via the REST
+    resize route, data remains queryable."""
+    import json
+    import socket
+    import urllib.request
+    from pilosa_tpu.server.node import ServerNode
+
+    ports = []
+    for _ in range(3):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        s.close()
+    addrs = [f"127.0.0.1:{p}" for p in ports]
+    nodes = [ServerNode(bind=a, peers=[x for x in addrs if x != a],
+                        replica_n=2, use_planner=False) for a in addrs]
+    for n in nodes:
+        n.open()
+    try:
+        base = nodes[0].address
+
+        def post(path, body):
+            r = urllib.request.Request(base + path, data=body.encode(),
+                                       method="POST")
+            return json.loads(urllib.request.urlopen(r, timeout=10).read()
+                              or b"{}")
+
+        post("/index/i", "{}")
+        post("/index/i/field/f", "{}")
+        cols = [s * SHARD_WIDTH for s in range(5)]
+        for c in cols:
+            post("/index/i/query", f"Set({c}, f=1)")
+        assert post("/index/i/query", "Count(Row(f=1))") == \
+            {"results": [len(cols)]}
+
+        victim = sorted(addrs)[-1]
+        post("/cluster/resize/remove-node", json.dumps({"id": victim}))
+        st = json.loads(urllib.request.urlopen(base + "/status",
+                                               timeout=10).read())
+        assert len(st["nodes"]) == 2
+        nodes[[i for i, a in enumerate(addrs) if a == victim][0]].close()
+        assert post("/index/i/query", "Count(Row(f=1))") == \
+            {"results": [len(cols)]}
+    finally:
+        for n in nodes:
+            try:
+                n.close()
+            except Exception:
+                pass

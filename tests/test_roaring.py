@@ -1,0 +1,128 @@
+"""Roaring wire-format tests: python/native parity, round trips over all
+container types, malformed input rejection, fragment + HTTP integration.
+
+Models roaring/roaring_internal_test.go marshal/unmarshal cases and the
+go-fuzz UnmarshalBinary harness (roaring/fuzzer.go) in miniature.
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import native, roaring
+from pilosa_tpu.config import SHARD_WIDTH
+from pilosa_tpu.core import Holder
+
+
+def cases(rng):
+    yield np.empty(0, dtype=np.uint64)                       # empty
+    yield np.array([0], dtype=np.uint64)                     # single
+    yield np.arange(100, dtype=np.uint64)                    # one run
+    yield np.array([1, 5, 9, 70000, 70001], dtype=np.uint64)  # array+run mix
+    yield np.uint64(1) << np.arange(16, 40, dtype=np.uint64)  # sparse keys
+    dense = rng.choice(1 << 16, 60000, replace=False).astype(np.uint64)
+    yield np.sort(dense)                                     # bitmap container
+    multi = rng.choice(1 << 22, 50000, replace=False).astype(np.uint64)
+    yield np.sort(multi)                                     # many containers
+    yield np.arange(0, 1 << 16, dtype=np.uint64)             # full run container
+
+
+@pytest.mark.parametrize("case_i", range(8))
+def test_roundtrip_python(case_i, rng):
+    pos = list(cases(rng))[case_i]
+    buf = roaring.encode(pos)
+    got = roaring.decode(buf)
+    assert np.array_equal(got, pos)
+
+
+@pytest.mark.parametrize("case_i", range(8))
+def test_python_native_parity(case_i, rng):
+    if not native.available():
+        pytest.skip("native lib unavailable")
+    pos = list(cases(rng))[case_i]
+    # native encode -> python decode, and vice versa
+    nbuf = native.encode_roaring(pos)
+    assert np.array_equal(roaring.decode(nbuf), pos)
+    pbuf = roaring.encode(pos)
+    assert np.array_equal(native.decode_roaring(pbuf), pos)
+
+
+def test_native_available():
+    # g++ is baked into the image; the native build must succeed here.
+    assert native.available()
+
+
+def test_container_type_choices(rng):
+    # run container for contiguous data
+    buf = roaring.encode(np.arange(5000, dtype=np.uint64))
+    _, count = struct.unpack_from("<II", buf, 0)
+    _, typ, _ = struct.unpack_from("<QHH", buf, 8)
+    assert typ == roaring.TYPE_RUN
+    # array for small scattered
+    buf = roaring.encode(np.array([1, 100, 9999], dtype=np.uint64))
+    _, typ, _ = struct.unpack_from("<QHH", buf, 8)
+    assert typ == roaring.TYPE_ARRAY
+    # bitmap for dense scattered
+    dense = np.sort(rng.choice(1 << 16, 30000, replace=False).astype(np.uint64))
+    buf = roaring.encode(dense * np.uint64(2))  # kill runs; > ARRAY_MAX
+    _, typ, _ = struct.unpack_from("<QHH", buf, 8)
+    assert typ == roaring.TYPE_BITMAP
+
+
+def test_malformed_buffers_rejected():
+    with pytest.raises(ValueError):
+        roaring.decode(b"")
+    with pytest.raises(ValueError):
+        roaring.decode(b"\x00\x00\x00\x00\x01\x00\x00\x00")  # bad cookie
+    if native.available():
+        with pytest.raises(ValueError):
+            native.decode_roaring(b"\xff" * 4)
+        # truncated container data must not crash the native decoder
+        good = roaring.encode(np.arange(10, dtype=np.uint64))
+        with pytest.raises(ValueError):
+            native.decode_roaring(good[: len(good) - 4])
+
+
+def test_fragment_import_export_roaring():
+    h = Holder()
+    idx = h.create_index("i")
+    f = idx.create_field("f")
+    # pos encoding: row*SHARD_WIDTH + col
+    pos = np.array([0 * SHARD_WIDTH + 5,
+                    3 * SHARD_WIDTH + 7,
+                    3 * SHARD_WIDTH + 9], dtype=np.uint64)
+    buf = native.encode_roaring(pos)
+    changed = f.import_roaring(shard=0, data=buf)
+    assert changed == 3
+    assert f.row(0).columns().tolist() == [5]
+    assert f.row(3).columns().tolist() == [7, 9]
+    frag = h.fragment("i", "f", "standard", 0)
+    back = native.decode_roaring(frag.to_roaring())
+    assert np.array_equal(back, pos)
+    # clear path
+    f.import_roaring(shard=0, data=native.encode_roaring(pos[:1]), clear=True)
+    assert f.row(0).columns().tolist() == []
+
+
+def test_http_import_roaring_endpoint():
+    import urllib.request
+    from pilosa_tpu.server.node import ServerNode
+    n = ServerNode(bind="127.0.0.1:0", use_planner=False)
+    n.open()
+    try:
+        base = n.address
+        for path, body in [("/index/i", b"{}"), ("/index/i/field/f", b"{}")]:
+            urllib.request.urlopen(urllib.request.Request(
+                base + path, data=body, method="POST"), timeout=10)
+        pos = np.array([2 * SHARD_WIDTH + 42], dtype=np.uint64)
+        urllib.request.urlopen(urllib.request.Request(
+            base + "/index/i/field/f/import-roaring/1",
+            data=native.encode_roaring(pos), method="POST"), timeout=10)
+        import json
+        r = urllib.request.Request(base + "/index/i/query",
+                                   data=b"Row(f=2)", method="POST")
+        resp = json.loads(urllib.request.urlopen(r, timeout=10).read())
+        assert resp["results"][0]["columns"] == [SHARD_WIDTH + 42]
+    finally:
+        n.close()
