@@ -146,6 +146,8 @@ int64_t roaring_encode_bound(const uint64_t* pos, int64_t n) {
 
 int64_t roaring_encode(const uint64_t* pos, int64_t n, uint8_t* out,
                        int64_t cap) {
+  // PRECONDITION: pos is strictly increasing (unique-sorted); the Python
+  // binding (pilosa_tpu/native/__init__.py encode_roaring) enforces it.
   // Group sorted positions by 2^16 key; pick run/array/bitmap per the
   // reference's optimize() economics (roaring.go:2334).
   struct Cont {

@@ -71,6 +71,12 @@ class Fragment:
 
         self.rows: dict[int, HostRow] = {}
         self.generation = 0
+        #: Mutex vector (fragment.go:3094): lazily-built local-pos -> row_id
+        #: map so mutex lookups/imports are O(1) per column instead of a
+        #: scan over every row. None = not built / dirty. Maintained
+        #: incrementally by set_bit/clear_bit; any other mutation of
+        #: ``rows`` must reset it to None.
+        self._col_row: dict[int, int] | None = None
         self._lock = threading.RLock()
         # device caches: row_id -> (gen, jax.Array[W]); stack key -> (gen, ids, jax.Array[n, W])
         self._dev_rows: dict[int, tuple[int, jax.Array]] = {}
@@ -107,6 +113,8 @@ class Fragment:
                 hr = self.rows[row_id] = HostRow()
             changed = hr.add(pos)
             if changed:
+                if self.mutex and self._col_row is not None:
+                    self._col_row[pos] = row_id
                 self._invalidate()
                 if self.op_writer:
                     self.op_writer("add", [row_id], [column_id])
@@ -120,6 +128,9 @@ class Fragment:
                 return False
             changed = hr.remove(pos)
             if changed:
+                if (self.mutex and self._col_row is not None
+                        and self._col_row.get(pos) == row_id):
+                    del self._col_row[pos]
                 self._invalidate()
                 if self.op_writer:
                     self.op_writer("remove", [row_id], [column_id])
@@ -135,6 +146,7 @@ class Fragment:
             hr = self.rows.pop(row_id, None)
             if hr is None or hr.count() == 0:
                 return False
+            self._col_row = None
             self._invalidate()
             if self.op_writer:
                 cols = (hr.to_positions() + np.uint64(self.shard * SHARD_WIDTH))
@@ -147,6 +159,7 @@ class Fragment:
             seg = row.segment(self.shard)
             words = np.asarray(seg) if seg is not None else bitops.np_zero_row()
             self.rows[row_id] = HostRow.from_words(words)
+            self._col_row = None
             self._invalidate()
             if self.op_writer:
                 cols = bitops.words_to_positions(words) + np.uint64(self.shard * SHARD_WIDTH)
@@ -180,6 +193,7 @@ class Fragment:
                 else:
                     changed += hr.add_many(local[mask])
             if changed:
+                self._col_row = None
                 self._invalidate()
                 if self.op_writer:
                     self.op_writer("removeBatch" if clear else "addBatch",
@@ -189,42 +203,44 @@ class Fragment:
     def bulk_import_mutex(self, row_ids, column_ids) -> int:
         """Mutex-field import: setting (row, col) clears any other row's bit
         in that column; last write per column wins (reference
-        bulkImportMutex fragment.go:2108). Batched: one pass over existing
-        rows to find steals, then grouped add/remove."""
+        bulkImportMutex fragment.go:2108). Steals are found through the
+        column->row mutex vector (O(1) per column, fragment.go:3094), not
+        by scanning every row."""
         with self._lock:
             if len(row_ids) != len(column_ids):
                 raise ValueError("row/column length mismatch")
             base = np.uint64(self.shard * SHARD_WIDTH)
-            desired: dict[int, int] = {}
+            desired: dict[int, int] = {}  # local pos -> row id
             for rid, cid in zip(row_ids, column_ids):
-                self._local(int(cid))  # bounds check
-                desired[int(cid)] = int(rid)
-            cols = np.asarray(sorted(desired), dtype=np.uint64)
-            local = cols - base
+                desired[self._local(int(cid))] = int(rid)
+            vec = self._mutex_map()
             changed = 0
             # Clear any column whose bit currently lives in a different row.
-            for rid in list(self.rows):
-                hr = self.rows[rid]
-                present = local[np.isin(local, hr.to_positions(), assume_unique=True)]
-                steal = np.asarray(
-                    [p for p in present.tolist() if desired[int(p + base)] != rid],
-                    dtype=np.uint64,
-                )
-                if len(steal):
-                    changed += hr.remove_many(steal)
-                    if self.op_writer:
-                        self.op_writer("removeBatch", [rid] * len(steal),
-                                       (steal + base).tolist())
+            steals: dict[int, list[int]] = {}
+            for pos, rid in desired.items():
+                cur = vec.get(pos)
+                if cur is not None and cur != rid:
+                    steals.setdefault(cur, []).append(pos)
+            for rid, lpos in steals.items():
+                stolen = np.asarray(lpos, dtype=np.uint64)
+                changed += self.rows[rid].remove_many(stolen)
+                for p in lpos:
+                    vec.pop(p, None)
+                if self.op_writer:
+                    self.op_writer("removeBatch", [rid] * len(lpos),
+                                   (stolen + base).tolist())
             # Set the desired bits, grouped by row.
             by_row: dict[int, list[int]] = {}
-            for cid, rid in desired.items():
-                by_row.setdefault(rid, []).append(cid - int(base))
+            for pos, rid in desired.items():
+                by_row.setdefault(rid, []).append(pos)
             for rid, lpos in by_row.items():
                 hr = self.rows.get(rid)
                 if hr is None:
                     hr = self.rows[rid] = HostRow()
                 added = hr.add_many(np.asarray(lpos, dtype=np.uint64))
                 changed += added
+                for p in lpos:
+                    vec[p] = rid
                 if added and self.op_writer:
                     self.op_writer("addBatch", [rid] * len(lpos),
                                    [p + int(base) for p in lpos])
@@ -250,12 +266,13 @@ class Fragment:
         """Serialize all bits in the reference's pos-encoded roaring
         format (the fragment-data transfer format, fragment.go:2436)."""
         from pilosa_tpu import native
-        parts = []
-        for rid in sorted(self.rows):
-            pos = self.rows[rid].to_positions()
-            parts.append(pos + np.uint64(rid * SHARD_WIDTH))
-        positions = (np.concatenate(parts) if parts
-                     else np.empty(0, dtype=np.uint64))
+        with self._lock:  # to_positions may flush pending adds
+            parts = []
+            for rid in sorted(self.rows):
+                pos = self.rows[rid].to_positions()
+                parts.append(pos + np.uint64(rid * SHARD_WIDTH))
+            positions = (np.concatenate(parts) if parts
+                         else np.empty(0, dtype=np.uint64))
         return native.encode_roaring(positions)
 
     # -- reads -------------------------------------------------------------
@@ -314,10 +331,23 @@ class Fragment:
         """Mutex/bool vector Get (fragment.go:3117): which row holds this
         column's bit, if any."""
         pos = self._local(column_id)
+        if self.mutex:
+            return self._mutex_map().get(pos)
         for rid, hr in self.rows.items():
             if hr.contains(pos):
                 return rid
         return None
+
+    def _mutex_map(self) -> dict[int, int]:
+        """The column vector, rebuilt from rows when dirty."""
+        with self._lock:
+            if self._col_row is None:
+                m: dict[int, int] = {}
+                for rid in sorted(self.rows):
+                    for p in self.rows[rid].to_positions().tolist():
+                        m[int(p)] = rid
+                self._col_row = m
+            return self._col_row
 
     # -- BSI ---------------------------------------------------------------
 
@@ -499,29 +529,31 @@ class Fragment:
         same positions can't collide."""
         import hashlib
         blocks: dict[int, "hashlib._Hash"] = {}
-        for rid in sorted(self.rows):
-            hr = self.rows[rid]
-            if hr.n == 0:
-                continue
-            b = rid // block_rows
-            h = blocks.get(b)
-            if h is None:
-                h = blocks[b] = hashlib.blake2b(digest_size=16)
-            h.update(np.uint64(rid).tobytes())
-            h.update(np.uint64(hr.n).tobytes())
-            h.update(hr.to_positions().tobytes())
+        with self._lock:  # to_positions may flush pending adds
+            for rid in sorted(self.rows):
+                hr = self.rows[rid]
+                if hr.n == 0:
+                    continue
+                b = rid // block_rows
+                h = blocks.get(b)
+                if h is None:
+                    h = blocks[b] = hashlib.blake2b(digest_size=16)
+                h.update(np.uint64(rid).tobytes())
+                h.update(np.uint64(hr.n).tobytes())
+                h.update(hr.to_positions().tobytes())
         return {b: h.digest() for b, h in blocks.items()}
 
     def block_data(self, block: int, block_rows: int = HASH_BLOCK_SIZE) -> tuple[np.ndarray, np.ndarray]:
         """(row_ids, column_ids) of all bits in a checksum block."""
         rows_out, cols_out = [], []
         base = np.uint64(self.shard * SHARD_WIDTH)
-        for rid in sorted(self.rows):
-            if rid // block_rows != block:
-                continue
-            pos = self.rows[rid].to_positions()
-            rows_out.append(np.full(len(pos), rid, dtype=np.uint64))
-            cols_out.append(pos + base)
+        with self._lock:  # to_positions may flush pending adds
+            for rid in sorted(self.rows):
+                if rid // block_rows != block:
+                    continue
+                pos = self.rows[rid].to_positions()
+                rows_out.append(np.full(len(pos), rid, dtype=np.uint64))
+                cols_out.append(pos + base)
         if not rows_out:
             return np.empty(0, np.uint64), np.empty(0, np.uint64)
         return np.concatenate(rows_out), np.concatenate(cols_out)

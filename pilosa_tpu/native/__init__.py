@@ -94,6 +94,10 @@ def decode_roaring(buf: bytes) -> np.ndarray:
 def encode_roaring(positions: np.ndarray) -> bytes:
     """Sorted uint64 positions -> serialized roaring bitmap."""
     positions = np.ascontiguousarray(positions, dtype=np.uint64)
+    # The native encoder requires strictly-increasing input; duplicates
+    # would inflate container N and double-count on decode.
+    if len(positions) and not (positions[:-1] < positions[1:]).all():
+        positions = np.unique(positions)
     lib = _load()
     if lib is None:
         from pilosa_tpu import roaring

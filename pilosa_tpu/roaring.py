@@ -79,7 +79,9 @@ def encode(positions: np.ndarray) -> bytes:
     """Sorted uint64 positions -> serialized roaring bitmap (containers
     chosen by the reference's optimize() economics, roaring.go:2334)."""
     positions = np.asarray(positions, dtype=np.uint64)
-    if len(positions) and not (positions[:-1] <= positions[1:]).all():
+    # Strictly-increasing check: sorted-with-duplicates input must also be
+    # deduped or container N / run lengths double-count on decode.
+    if len(positions) and not (positions[:-1] < positions[1:]).all():
         positions = np.unique(positions)
     keys = (positions >> np.uint64(16)).astype(np.uint64)
     lows = (positions & np.uint64(0xFFFF)).astype(np.uint16)

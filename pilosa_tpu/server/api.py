@@ -222,10 +222,12 @@ class API:
         if frag is None:
             raise FragmentNotFoundError()
         buf = io.StringIO()
-        for rid in frag.row_ids():
-            hr = frag.rows[rid]
+        with frag._lock:  # to_positions may flush pending adds
+            pairs = [(rid, frag.rows[rid].to_positions())
+                     for rid in frag.row_ids()]
+        for rid, positions in pairs:
             base = shard * SHARD_WIDTH
-            for pos in hr.to_positions():
+            for pos in positions:
                 col = int(pos) + base
                 if f.keys:
                     rk = f.translate_store.translate_id(rid) or str(rid)

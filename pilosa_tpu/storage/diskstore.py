@@ -46,10 +46,14 @@ class DiskStore:
     """Snapshot + WAL persistence for every fragment of a holder."""
 
     def __init__(self, data_dir: str, holder: Holder,
-                 max_op_n: int = MAX_OP_N, snapshot_workers: int = 2):
+                 max_op_n: int = MAX_OP_N, snapshot_workers: int = 2,
+                 fsync_appends: bool = False):
         self.data_dir = data_dir
         self.holder = holder
         self.max_op_n = max_op_n
+        #: fsync every WAL record (strict durability; default matches the
+        #: reference's buffered op-log writes).
+        self.fsync_appends = fsync_appends
         os.makedirs(data_dir, exist_ok=True)
         self._writers: dict[tuple, WalWriter] = {}
         self._lock = threading.Lock()
@@ -179,7 +183,8 @@ class DiskStore:
         with self._lock:
             w = self._writers.get(key)
             if w is None:
-                w = self._writers[key] = WalWriter(self._wal_path(key))
+                w = self._writers[key] = WalWriter(
+                    self._wal_path(key), fsync_appends=self.fsync_appends)
             return w
 
     # -- snapshots (fragment.go:187-239, :2337-2393) -----------------------
@@ -226,7 +231,11 @@ class DiskStore:
             with open(tmp, "wb") as fh:
                 np.savez_compressed(fh, row_ids=row_ids, offsets=offsets,
                                     positions=positions)
+                fh.flush()
+                os.fsync(fh.fileno())
             os.replace(tmp, path)
+            _fsync_dir(os.path.dirname(path))
+            # Snapshot is durable; only now may the WAL be discarded.
             self._writer(key).truncate()
 
     def snapshot_all(self) -> None:
@@ -294,6 +303,18 @@ class DiskStore:
             for w in self._writers.values():
                 w.close()
             self._writers.clear()
+
+
+def _fsync_dir(path: str) -> None:
+    """Make a rename durable by fsyncing the containing directory."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return  # platform without directory fds
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
 
 
 def _shard_width() -> int:

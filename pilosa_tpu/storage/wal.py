@@ -39,10 +39,17 @@ _OP_CODES = {"add": OP_ADD, "addBatch": OP_ADD,
 
 
 class WalWriter:
-    """Appender with op counting (MaxOpN snapshot trigger)."""
+    """Appender with op counting (MaxOpN snapshot trigger).
 
-    def __init__(self, path: str):
+    ``fsync_appends=False`` (default) matches the reference's op-log
+    durability (user+OS buffered writes, crash may lose the tail);
+    True fsyncs every record for strict durability at a write-latency
+    cost.
+    """
+
+    def __init__(self, path: str, fsync_appends: bool = False):
         self.path = path
+        self.fsync_appends = fsync_appends
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
@@ -62,13 +69,25 @@ class WalWriter:
                                    zlib.crc32(payload) & 0xFFFFFFFF))
         self._f.write(payload)
         self._f.flush()
+        if self.fsync_appends:
+            os.fsync(self._f.fileno())
         self.op_n += 1
 
+    def sync(self) -> None:
+        """Flush user+OS buffers so appended records survive a crash."""
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
     def truncate(self) -> None:
-        """Called after a snapshot subsumes the log (fragment.go:2393)."""
+        """Called after a snapshot subsumes the log (fragment.go:2393).
+
+        Callers must make the snapshot durable (fsync file + dir) BEFORE
+        truncating, or a crash in between loses the fragment.
+        """
         self._f.seek(0)
         self._f.truncate()
         self._f.flush()
+        os.fsync(self._f.fileno())
         self.op_n = 0
 
     def close(self) -> None:

@@ -160,3 +160,98 @@ def test_fragment_checksum_blocks():
     assert mine[0] != theirs[0] and mine[2] == theirs[2]
     rows, cols = g.block_data(0)
     assert rows.tolist() == [5, 5] and cols.tolist() == [100, 101]
+
+
+# ------------------------------------------------- write fast paths (round 2)
+
+def test_hostrow_pending_buffer_semantics(rng):
+    """Single-bit adds buffer before merging; every read path must see
+    buffered bits (add/remove/contains/count_range/to_words/to_positions)."""
+    r = HostRow()
+    want = set()
+    for p in rng.choice(SHARD_WIDTH, size=600, replace=False).tolist():
+        assert r.add(p)
+        want.add(p)
+    # re-add buffered + merged bits: no change
+    for p in list(want)[:50]:
+        assert not r.add(p)
+    assert r.count() == len(want)
+    sample = list(want)[:20]
+    assert all(r.contains(p) for p in sample)
+    # remove a buffered bit and a merged bit
+    victims = sample[:2]
+    for v in victims:
+        assert r.remove(v)
+        want.discard(v)
+    assert r.count() == len(want)
+    assert sorted(r.to_positions().tolist()) == sorted(want)
+    assert r.count_range(0, SHARD_WIDTH) == len(want)
+
+
+def test_hostrow_interleaved_single_and_bulk(rng):
+    r = HostRow()
+    singles = rng.choice(SHARD_WIDTH, size=300, replace=False).tolist()
+    for p in singles[:150]:
+        r.add(p)
+    bulk = rng.choice(SHARD_WIDTH, size=400, replace=False)
+    r.add_many(bulk)
+    for p in singles[150:]:
+        r.add(p)
+    want = set(singles) | set(bulk.tolist())
+    assert r.count() == len(want)
+    assert sorted(r.to_positions().tolist()) == sorted(want)
+
+
+def test_mutex_map_interleaved_ops():
+    """Mutex vector stays consistent across single-bit, bulk, clear_row."""
+    f = frag(mutex=True)
+    f.bulk_import_mutex([1, 2, 3], [10, 20, 30])
+    assert f.row_for_column(10) == 1
+    # single-bit steal
+    f.set_bit(5, 10)
+    assert f.row_for_column(10) == 5
+    assert not f.contains(1, 10)
+    # bulk steal back
+    f.bulk_import_mutex([1], [10])
+    assert f.row_for_column(10) == 1
+    assert not f.contains(5, 10)
+    # clear_row dirties the map; rebuild must drop row 2's columns
+    f.clear_row(2)
+    assert f.row_for_column(20) is None
+    assert f.row_for_column(30) == 3
+    # bulk_import (non-mutex path, e.g. WAL replay) also dirties it
+    f.bulk_import([7], [40])
+    assert f.row_for_column(40) == 7
+
+
+def test_mutex_import_scales_past_row_scan():
+    """100k-row mutex import: per-bit work must not scan all rows
+    (VERDICT weak #7; reference keeps a mutex vector, fragment.go:3094)."""
+    import time
+    f = frag(mutex=True)
+    n = 100_000
+    rows = np.arange(n, dtype=np.uint64)
+    cols = np.arange(n, dtype=np.uint64) % SHARD_WIDTH
+    t0 = time.monotonic()
+    f.bulk_import_mutex(rows.tolist(), cols.tolist())
+    # steal every column into new rows — the old quadratic path took
+    # minutes here; the vectorized path is well under a second.
+    f.bulk_import_mutex((rows + np.uint64(n)).tolist(), cols.tolist())
+    elapsed = time.monotonic() - t0
+    assert f.row_for_column(0) == n
+    assert elapsed < 30, f"mutex import too slow: {elapsed:.1f}s"
+
+
+def test_mutex_single_bit_uses_vector():
+    """set_bit on a mutex fragment with many rows stays O(1) per write."""
+    f = frag(mutex=True)
+    n = 20_000
+    f.bulk_import_mutex(list(range(n)), list(range(n)))
+    import time
+    t0 = time.monotonic()
+    for c in range(200):
+        f.set_bit(n + 1, c)  # steals column c from row c
+    elapsed = time.monotonic() - t0
+    assert f.row_for_column(0) == n + 1
+    assert f.row_for_column(199) == n + 1
+    assert elapsed < 10, f"mutex set_bit too slow: {elapsed:.1f}s"
