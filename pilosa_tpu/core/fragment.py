@@ -48,6 +48,16 @@ BSI_EXISTS_BIT = 0
 BSI_SIGN_BIT = 1
 BSI_OFFSET_BIT = 2
 
+#: Row-group tiling (SURVEY §7): streaming count paths materialize at most
+#: this many rows on device at once, so TopN/GroupBy over huge fields
+#: (1M+ rows; BASELINE "TopN ranked cache 1M×10M") run in O(tile) HBM
+#: instead of O(rows) — the reference's analog is per-container iteration
+#: (fragment.go:1570-1740).
+ROW_TILE = 512
+#: Row sets at or below this size use the cached whole-stack fast path
+#: (repeat queries hit HBM-resident blocks with zero re-upload).
+STACK_CACHE_MAX_ROWS = 1024
+
 
 class Fragment:
     """One shard of one view of one field."""
@@ -321,6 +331,37 @@ class Fragment:
         """Row result for one bitmap row (reference fragment.row :602)."""
         return Row({self.shard: self.device_row(row_id)})
 
+    def intersection_counts(self, row_ids, seg) -> np.ndarray:
+        """popcount(row & seg) for each row id — the exact-count engine
+        behind TopN/GroupBy/MinRow/MaxRow. Small id sets ride the cached
+        device stack; large ones stream fixed [ROW_TILE, W] tiles so
+        device memory is O(tile) regardless of field cardinality."""
+        ids = [int(r) for r in row_ids]
+        if not ids:
+            return np.empty(0, dtype=np.int64)
+        seg = seg if isinstance(seg, jax.Array) else jnp.asarray(seg)
+        if len(ids) <= STACK_CACHE_MAX_ROWS:
+            stack = self.device_stack(tuple(ids))
+            return np.asarray(pallas_kernels.pair_count(stack, seg, "and"),
+                              dtype=np.int64)
+        out = np.empty(len(ids), dtype=np.int64)
+        # Fixed tile shape (zero-padded tail) → one compiled kernel. The
+        # lock spans the whole sweep so the counts vector reflects one
+        # atomic fragment state (matching the device_stack path).
+        mat = np.zeros((ROW_TILE, WORDS_PER_SHARD), dtype=np.uint32)
+        with self._lock:
+            for lo in range(0, len(ids), ROW_TILE):
+                chunk = ids[lo:lo + ROW_TILE]
+                for i, r in enumerate(chunk):
+                    mat[i] = self.row_words(r)
+                if len(chunk) < ROW_TILE:
+                    mat[len(chunk):] = 0
+                counts = np.asarray(
+                    pallas_kernels.pair_count(jnp.asarray(mat), seg, "and"),
+                    dtype=np.int64)
+                out[lo:lo + len(chunk)] = counts[:len(chunk)]
+        return out
+
     def row_counts(self) -> tuple[np.ndarray, np.ndarray]:
         """(row_ids, counts) from the incrementally-maintained host counts."""
         ids = np.asarray(sorted(self.rows), dtype=np.uint64)
@@ -453,9 +494,7 @@ class Fragment:
         if len(ids) == 0:
             return []
         if src is not None:
-            seg = self._filter_seg(src)
-            stack = self.device_stack(tuple(int(i) for i in ids))
-            counts = np.asarray(pallas_kernels.pair_count(stack, seg, "and"))
+            counts = self.intersection_counts(ids, self._filter_seg(src))
         else:
             counts = np.asarray([self.rows[int(i)].count() if int(i) in self.rows else 0
                                  for i in ids], dtype=np.int64)
@@ -497,9 +536,7 @@ class Fragment:
         seg = filter_row.segment(self.shard)
         if seg is None:
             return ids, np.zeros(len(ids), dtype=np.int64)
-        stack = self.device_stack(tuple(ids))
-        return ids, np.asarray(pallas_kernels.pair_count(stack, seg, "and"),
-                               dtype=np.int64)
+        return ids, self.intersection_counts(ids, seg)
 
     def min_row(self, filter_row: Row | None = None) -> tuple[int, int]:
         """(min row id with any bit [∩ filter], its count) or (0, 0)

@@ -49,7 +49,6 @@ from pilosa_tpu.exec.result import (
     merge_row_ids,
     sort_pairs,
 )
-from pilosa_tpu.ops import pallas_kernels
 from pilosa_tpu.pql import BETWEEN, NEQ, Call, Condition, Query, parse
 from pilosa_tpu.pql import ast as pql_ast
 
@@ -758,9 +757,9 @@ class Executor:
                     seg = base.segment(shard)
                     if seg is None:
                         return
-                    stack = frags[level].device_stack(tuple(rows))
-                    cnts = np.asarray(
-                        pallas_kernels.pair_count(stack, seg, "and"))
+                    # Row-group-tiled device counts: O(tile) HBM even for
+                    # 1M-row last-level fields (fragment.intersection_counts).
+                    cnts = frags[level].intersection_counts(rows, seg)
                     counts = list(zip(rows, cnts.tolist()))
                 for r, cnt in counts:
                     if len(results) >= limit:
