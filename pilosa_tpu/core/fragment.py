@@ -57,6 +57,10 @@ ROW_TILE = 512
 #: Row sets at or below this size use the cached whole-stack fast path
 #: (repeat queries hit HBM-resident blocks with zero re-upload).
 STACK_CACHE_MAX_ROWS = 1024
+#: With ``reuse=True``, up to this many streamed tiles stay device-resident
+#: so repeated sweeps over the same row set (GroupBy: one per group prefix)
+#: skip re-materialization; larger sets fall back to pure streaming.
+MAX_RESIDENT_TILES = 8
 
 
 class Fragment:
@@ -276,13 +280,10 @@ class Fragment:
         """Serialize all bits in the reference's pos-encoded roaring
         format (the fragment-data transfer format, fragment.go:2436)."""
         from pilosa_tpu import native
-        with self._lock:  # to_positions may flush pending adds
-            parts = []
-            for rid in sorted(self.rows):
-                pos = self.rows[rid].to_positions()
-                parts.append(pos + np.uint64(rid * SHARD_WIDTH))
-            positions = (np.concatenate(parts) if parts
-                         else np.empty(0, dtype=np.uint64))
+        parts = [pos + np.uint64(rid * SHARD_WIDTH)
+                 for rid, pos in self.rows_snapshot()]
+        positions = (np.concatenate(parts) if parts
+                     else np.empty(0, dtype=np.uint64))
         return native.encode_roaring(positions)
 
     # -- reads -------------------------------------------------------------
@@ -297,11 +298,22 @@ class Fragment:
         return min(self.rows) if self.rows else None
 
     def row_words(self, row_id: int) -> np.ndarray:
-        """Host dense block for one row (zeros if absent)."""
-        hr = self.rows.get(row_id)
-        if hr is None:
-            return bitops.np_zero_row()
-        return hr.to_words()
+        """Host dense block for one row (zeros if absent). Locked: the
+        materialization may flush pending adds (hostrow._flush)."""
+        with self._lock:
+            hr = self.rows.get(row_id)
+            if hr is None:
+                return bitops.np_zero_row()
+            return hr.to_words()
+
+    def rows_snapshot(self) -> list[tuple[int, np.ndarray]]:
+        """Atomic [(row_id, positions)] snapshot of every row, sorted by
+        id — THE way to read all rows for serialization/checksums (the
+        position materialization may flush pending adds, so it must
+        happen under the fragment lock)."""
+        with self._lock:
+            return [(rid, self.rows[rid].to_positions())
+                    for rid in sorted(self.rows)]
 
     def device_row(self, row_id: int) -> jax.Array:
         """Device block for one row, cached until next mutation."""
@@ -331,11 +343,17 @@ class Fragment:
         """Row result for one bitmap row (reference fragment.row :602)."""
         return Row({self.shard: self.device_row(row_id)})
 
-    def intersection_counts(self, row_ids, seg) -> np.ndarray:
+    def intersection_counts(self, row_ids, seg,
+                            reuse: bool = False) -> np.ndarray:
         """popcount(row & seg) for each row id — the exact-count engine
         behind TopN/GroupBy/MinRow/MaxRow. Small id sets ride the cached
         device stack; large ones stream fixed [ROW_TILE, W] tiles so
-        device memory is O(tile) regardless of field cardinality."""
+        device memory is O(tile) regardless of field cardinality.
+
+        ``reuse=True`` keeps up to MAX_RESIDENT_TILES streamed tiles
+        device-resident (generation-checked) so a caller sweeping the same
+        row set against many segments — GroupBy's last level, one sweep
+        per group prefix — pays materialization and upload once."""
         ids = [int(r) for r in row_ids]
         if not ids:
             return np.empty(0, dtype=np.int64)
@@ -345,19 +363,33 @@ class Fragment:
             return np.asarray(pallas_kernels.pair_count(stack, seg, "and"),
                               dtype=np.int64)
         out = np.empty(len(ids), dtype=np.int64)
-        # Fixed tile shape (zero-padded tail) → one compiled kernel. The
-        # lock spans the whole sweep so the counts vector reflects one
-        # atomic fragment state (matching the device_stack path).
-        mat = np.zeros((ROW_TILE, WORDS_PER_SHARD), dtype=np.uint32)
+        n_tiles = (len(ids) + ROW_TILE - 1) // ROW_TILE
+        cache_tiles = reuse and n_tiles <= MAX_RESIDENT_TILES
+        # Fixed tile shape (zero-padded tail) → one compiled kernel.
+        # Deliberate: the lock spans the whole sweep, including device
+        # dispatches, so the counts vector reflects one atomic fragment
+        # state — writers stall for the sweep, exactly like the
+        # reference's fragment.top holding f.mu for its full walk
+        # (fragment.go:1570). Tile keys are positional ("ic_tile", lo),
+        # NOT id-set-keyed, so a fragment never pins more than
+        # MAX_RESIDENT_TILES tiles: a different id set simply replaces
+        # them (device_stack verifies the stored ids before reuse).
+        mat = None if cache_tiles else np.zeros(
+            (ROW_TILE, WORDS_PER_SHARD), dtype=np.uint32)
         with self._lock:
             for lo in range(0, len(ids), ROW_TILE):
                 chunk = ids[lo:lo + ROW_TILE]
-                for i, r in enumerate(chunk):
-                    mat[i] = self.row_words(r)
-                if len(chunk) < ROW_TILE:
-                    mat[len(chunk):] = 0
+                if cache_tiles:
+                    arr = self.device_stack(tuple(chunk),
+                                            key=("ic_tile", lo))
+                else:
+                    for i, r in enumerate(chunk):
+                        mat[i] = self.row_words(r)
+                    if len(chunk) < ROW_TILE:
+                        mat[len(chunk):] = 0
+                    arr = jnp.asarray(mat)
                 counts = np.asarray(
-                    pallas_kernels.pair_count(jnp.asarray(mat), seg, "and"),
+                    pallas_kernels.pair_count(arr, seg, "and"),
                     dtype=np.int64)
                 out[lo:lo + len(chunk)] = counts[:len(chunk)]
         return out
@@ -566,31 +598,27 @@ class Fragment:
         same positions can't collide."""
         import hashlib
         blocks: dict[int, "hashlib._Hash"] = {}
-        with self._lock:  # to_positions may flush pending adds
-            for rid in sorted(self.rows):
-                hr = self.rows[rid]
-                if hr.n == 0:
-                    continue
-                b = rid // block_rows
-                h = blocks.get(b)
-                if h is None:
-                    h = blocks[b] = hashlib.blake2b(digest_size=16)
-                h.update(np.uint64(rid).tobytes())
-                h.update(np.uint64(hr.n).tobytes())
-                h.update(hr.to_positions().tobytes())
+        for rid, pos in self.rows_snapshot():
+            if len(pos) == 0:
+                continue
+            b = rid // block_rows
+            h = blocks.get(b)
+            if h is None:
+                h = blocks[b] = hashlib.blake2b(digest_size=16)
+            h.update(np.uint64(rid).tobytes())
+            h.update(np.uint64(len(pos)).tobytes())
+            h.update(pos.tobytes())
         return {b: h.digest() for b, h in blocks.items()}
 
     def block_data(self, block: int, block_rows: int = HASH_BLOCK_SIZE) -> tuple[np.ndarray, np.ndarray]:
         """(row_ids, column_ids) of all bits in a checksum block."""
         rows_out, cols_out = [], []
         base = np.uint64(self.shard * SHARD_WIDTH)
-        with self._lock:  # to_positions may flush pending adds
-            for rid in sorted(self.rows):
-                if rid // block_rows != block:
-                    continue
-                pos = self.rows[rid].to_positions()
-                rows_out.append(np.full(len(pos), rid, dtype=np.uint64))
-                cols_out.append(pos + base)
+        for rid, pos in self.rows_snapshot():
+            if rid // block_rows != block:
+                continue
+            rows_out.append(np.full(len(pos), rid, dtype=np.uint64))
+            cols_out.append(pos + base)
         if not rows_out:
             return np.empty(0, np.uint64), np.empty(0, np.uint64)
         return np.concatenate(rows_out), np.concatenate(cols_out)

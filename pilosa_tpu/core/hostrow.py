@@ -47,13 +47,20 @@ class HostRow:
             self.positions = None
 
     def _flush(self) -> None:
-        """Merge buffered single-bit adds into the sorted position array."""
+        """Merge buffered single-bit adds into the sorted position array.
+
+        Only called with the owning fragment's lock held (all mutators and
+        flushing readers take it). Ordering matters for LOCKLESS readers
+        (Fragment.contains / rows_list peek at ``positions``/``_pending``
+        without the lock): the merged array is published before the
+        pending set is cleared, so a concurrent reader sees every bit in
+        at least one of the two."""
         if not self._pending:
             return
         fresh = np.fromiter(self._pending, dtype=np.uint64,
                             count=len(self._pending))
-        self._pending.clear()
         self.positions = np.sort(np.concatenate((self.positions, fresh)))
+        self._pending.clear()
         self._maybe_densify()
 
     # -- mutation ---------------------------------------------------------

@@ -418,8 +418,17 @@ class Executor:
             s, cnt = frag.sum(filt, f.bsi_group.bit_depth)
             return ValCount(s + cnt * f.bsi_group.base, cnt)
 
+        local_batch = None
+        if self.planner is not None and self.planner.supports_aggregate(idx, c):
+            f = idx.field(field_name)
+
+            def local_batch(shs):
+                s, cnt = self.planner.execute_sum(idx, c, list(shs))
+                return ValCount(s + cnt * f.bsi_group.base, cnt)
+
         result = self.map_reduce(idx, shards, c, opt, map_fn,
-                                 lambda p, v: v if p is None else p.add(v))
+                                 lambda p, v: v if p is None else p.add(v),
+                                 local_batch_fn=local_batch)
         result = result or ValCount()
         return ValCount() if result.count == 0 else result
 
@@ -447,7 +456,19 @@ class Executor:
                 return v
             return p.smaller(v) if is_min else p.larger(v)
 
-        result = self.map_reduce(idx, shards, c, opt, map_fn, reduce_fn) or ValCount()
+        local_batch = None
+        if self.planner is not None and self.planner.supports_aggregate(idx, c):
+            f = idx.field(field_name)
+
+            def local_batch(shs):
+                v, cnt = self.planner.execute_min_max(idx, c, list(shs),
+                                                      is_min)
+                if cnt == 0:
+                    return ValCount()
+                return ValCount(v + f.bsi_group.base, cnt)
+
+        result = self.map_reduce(idx, shards, c, opt, map_fn, reduce_fn,
+                                 local_batch_fn=local_batch) or ValCount()
         return ValCount() if result.count == 0 else result
 
     def _execute_min_max_row(self, idx: Index, c: Call, shards, opt,
@@ -519,8 +540,68 @@ class Executor:
 
         merged = self.map_reduce(
             idx, shards, c, opt,
-            lambda shard: self._top_n_shard(idx, c, shard), reduce_fn) or []
+            lambda shard: self._top_n_shard(idx, c, shard), reduce_fn,
+            local_batch_fn=self._topn_batch_fn(idx, c)) or []
         return sort_pairs(merged)
+
+    def _topn_batch_fn(self, idx: Index, c: Call):
+        """Planner TopN: one sparse-aware streamed device program for ALL
+        local shards (planner.execute_topn_pairs) instead of a per-shard
+        loop, preserving per-shard filter/threshold/truncate semantics.
+        Returns None when the call needs the per-shard path (tanimoto
+        needs per-shard src counts; unplannable filter trees)."""
+        if self.planner is None:
+            return None
+        field_name = c.args.get("_field")
+        f = idx.field(field_name) if field_name else None
+        if f is None or f.field_type == FIELD_TYPE_INT:
+            return None
+        tanimoto, _ = c.uint_arg("tanimotoThreshold")
+        if tanimoto > 0:
+            return None
+        if len(c.children) > 1:
+            return None
+        filter_call = c.children[0] if c.children else None
+        if filter_call is not None and not self.planner.supports(filter_call):
+            return None
+        row_ids, has_ids = c.uint_slice_arg("ids")
+        n, _ = c.uint_arg("n")
+        if has_ids:
+            n = 0  # explicit ids: no truncation (fragment.go:1575)
+        min_threshold, _ = c.uint_arg("threshold")
+        if min_threshold == 0:
+            min_threshold = DEFAULT_MIN_THRESHOLD
+
+        def batch(shs: list[int]) -> list[Pair]:
+            # cache_type 'none' errors only if a fragment exists, exactly
+            # like the per-shard path (which never reaches the check when
+            # holder.fragment returns None for every shard).
+            if f.options.cache_type == "none":
+                if any(self.holder.fragment(idx.name, field_name,
+                                            VIEW_STANDARD, s) is not None
+                       for s in shs):
+                    raise QueryError(
+                        f'cannot compute TopN(), field has no cache: '
+                        f'"{field_name}"')
+                return []
+            triplets = self.planner.execute_topn_pairs(
+                idx, field_name, VIEW_STANDARD, list(shs), filter_call,
+                row_ids=[int(r) for r in row_ids] if has_ids else None)
+            by_shard: dict[int, list[tuple[int, int]]] = {}
+            for shard, rid, cnt in triplets:
+                if cnt > 0:
+                    by_shard.setdefault(shard, []).append((rid, cnt))
+            acc: list[Pair] = []
+            for shard in sorted(by_shard):
+                raw = sorted(by_shard[shard], key=lambda p: (-p[1], p[0]))
+                pairs = self._top_filter_pairs(f, None, raw, None, 0,
+                                               min_threshold, c)
+                if n:
+                    pairs = pairs[:n]
+                acc = merge_pairs(acc, pairs)
+            return acc
+
+        return batch
 
     def _top_n_shard(self, idx: Index, c: Call, shard: int) -> list[Pair]:
         """Exact per-shard TopN: device-batched intersection counts over the
@@ -561,7 +642,19 @@ class Executor:
         # layer the threshold/tanimoto/attr-filter predicates on top.
         raw = frag.top(n=0, src=src,
                        row_ids=[int(r) for r in row_ids] if has_ids else None)
+        pairs = self._top_filter_pairs(f, frag, raw, src, tanimoto,
+                                       min_threshold, c)
+        if n:
+            pairs = pairs[:n]
+        return pairs
 
+    def _top_filter_pairs(self, f, frag, raw, src, tanimoto: int,
+                          min_threshold: int, c: Call) -> list[Pair]:
+        """Threshold/tanimoto/attr predicates over sorted (rid, count)
+        pairs of ONE shard (fragment.go:1617-1691). ``frag``/``src`` are
+        only needed when tanimoto > 0."""
+        attr_name = c.args.get("attrName")
+        attr_values = c.args.get("attrValues")
         src_count = src.count() if (src is not None and tanimoto > 0) else 0
         allowed_attrs = set(attr_values) if (attr_name and attr_values) else None
 
@@ -580,8 +673,6 @@ class Executor:
                 if attrs.get(attr_name) not in allowed_attrs:
                     continue
             pairs.append(Pair(id=rid, count=cnt))
-        if n:
-            pairs = pairs[:n]
         return pairs
 
     # ------------------------------------------------------------------
@@ -758,8 +849,10 @@ class Executor:
                     if seg is None:
                         return
                     # Row-group-tiled device counts: O(tile) HBM even for
-                    # 1M-row last-level fields (fragment.intersection_counts).
-                    cnts = frags[level].intersection_counts(rows, seg)
+                    # 1M-row last-level fields; reuse=True keeps moderate
+                    # tile sets device-resident across group prefixes.
+                    cnts = frags[level].intersection_counts(rows, seg,
+                                                            reuse=True)
                     counts = list(zip(rows, cnts.tolist()))
                 for r, cnt in counts:
                     if len(results) >= limit:

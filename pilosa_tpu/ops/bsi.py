@@ -208,7 +208,11 @@ def host_sum(exists, sign, bits, filt, depth: int) -> tuple[int, int]:
 @functools.partial(jax.jit, static_argnames=("depth",))
 def _min_unsigned(bits, filt, depth: int):
     """Vectorized minUnsigned (fragment.go:1173): greedy bit-serial descent.
-    Returns (lo, hi, count) — value as uint32 pair."""
+    Returns (lo, hi, count) — value as uint32 pair.
+
+    Shape-polymorphic: ``bits [depth, ..., W]``, ``filt [..., W]`` yields
+    per-``...`` results (the planner runs it over [S, W] shard stacks to
+    get every shard's minimum in one program)."""
     lo = jnp.uint32(0)
     hi = jnp.uint32(0)
     count = jnp.int32(0)
@@ -216,7 +220,7 @@ def _min_unsigned(bits, filt, depth: int):
         cand = bitops.b_andnot(filt, bits[i])
         c = bitops.count(cand)
         has = c > 0
-        filt = jnp.where(has, cand, filt)
+        filt = jnp.where(has[..., None], cand, filt)
         addbit = jnp.where(has, jnp.uint32(0), jnp.uint32(1))
         if i < 32:
             lo = lo | (addbit << jnp.uint32(i))
@@ -231,7 +235,8 @@ def _min_unsigned(bits, filt, depth: int):
 
 @functools.partial(jax.jit, static_argnames=("depth",))
 def _max_unsigned(bits, filt, depth: int):
-    """Vectorized maxUnsigned (fragment.go:1218)."""
+    """Vectorized maxUnsigned (fragment.go:1218). Shape-polymorphic like
+    ``_min_unsigned``."""
     lo = jnp.uint32(0)
     hi = jnp.uint32(0)
     count = jnp.int32(0)
@@ -239,7 +244,7 @@ def _max_unsigned(bits, filt, depth: int):
         cand = bitops.b_and(filt, bits[i])
         c = bitops.count(cand)
         has = c > 0
-        filt = jnp.where(has, cand, filt)
+        filt = jnp.where(has[..., None], cand, filt)
         addbit = jnp.where(has, jnp.uint32(1), jnp.uint32(0))
         if i < 32:
             lo = lo | (addbit << jnp.uint32(i))

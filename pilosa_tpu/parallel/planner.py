@@ -103,18 +103,164 @@ class MeshPlanner:
         # immune to int32 overflow past ~2k full shards.
         return int(np.asarray(fn(*arrays), dtype=np.int64).sum())
 
-    def execute_bitmap(self, idx: Index, c: Call, shards: list[int]) -> Row:
-        """Evaluate the tree to a Row whose segments are device slices of
-        the stacked result (no host sync)."""
-        if not shards:
-            return Row()
+    def _tree_stack(self, idx: Index, c: Call, shards: list[int]) -> jax.Array:
+        """Evaluate a bitmap tree to its stacked [S_pad, W] device array."""
         self._index_name = idx.name
         leaves: list[tuple] = []
         sig = self._signature(idx, c, leaves)
         arrays = [self._fetch_leaf(idx, leaf, tuple(shards)) for leaf in leaves]
         fn = self._compiled(("row",) + sig, c, idx, reduce=None)
-        out = fn(*arrays)  # [S_pad, W]
+        return fn(*arrays)
+
+    def execute_bitmap(self, idx: Index, c: Call, shards: list[int]) -> Row:
+        """Evaluate the tree to a Row whose segments are device slices of
+        the stacked result (no host sync)."""
+        if not shards:
+            return Row()
+        out = self._tree_stack(idx, c, shards)  # [S_pad, W]
         return Row({shard: out[i] for i, shard in enumerate(shards)})
+
+    # ------------------------------------------------------------------
+    # aggregates (VERDICT r1 #4): Sum/Min/Max as ONE SPMD program over
+    # the BSI leaf stacks + optional filter tree, instead of the per-shard
+    # host loop (reference executor.go:406-999). Rows() stays host-side by
+    # design: it is a row-id metadata scan with no device math to batch.
+    # ------------------------------------------------------------------
+
+    def supports_aggregate(self, idx: Index, c: Call) -> bool:
+        """True for Sum/Min/Max calls whose (optional) filter child is a
+        plannable bitmap tree over an existing BSI field."""
+        if c.name not in ("Sum", "Min", "Max"):
+            return False
+        if len(c.children) > 1:
+            return False
+        if c.children and not self.supports(c.children[0]):
+            return False
+        field_name, ok = c.string_arg("field")
+        if not ok:
+            return False
+        f = idx.field(field_name)
+        return f is not None and f.bsi_group is not None
+
+    def _bsi_inputs(self, idx: Index, c: Call, shards: list[int]):
+        """(exists, sign, [depth,S,W] stack, filt, depth) device arrays."""
+        field_name, _ = c.string_arg("field")
+        f = idx.field(field_name)
+        depth = f.bsi_group.bit_depth
+        self._index_name = idx.name
+        exists, sign, bits = self._fetch_leaf(
+            idx, ("bsi", field_name, depth), tuple(shards))
+        if c.children:
+            filt = self._tree_stack(idx, c.children[0], shards)
+        else:
+            filt = _jit_full_like(exists)
+        stack = jnp.stack(bits, axis=0) if bits else \
+            jnp.zeros((0,) + exists.shape, exists.dtype)
+        return f, exists, sign, stack, filt, depth
+
+    def execute_sum(self, idx: Index, c: Call, shards: list[int]):
+        """Global (sum-of-base-offsets, count) in one device program; the
+        executor applies the BSI base (reference fragment.sum :1111 under
+        executeSum :406)."""
+        if not shards:
+            return 0, 0
+        _, exists, sign, stack, filt, depth = self._bsi_inputs(idx, c, shards)
+        cnt, pos, neg = bsi_ops.sum_counts(exists, sign, stack, filt, depth)
+        count = int(np.asarray(cnt, dtype=np.int64).sum())
+        pos = np.asarray(pos, dtype=np.int64).sum(axis=-1)
+        neg = np.asarray(neg, dtype=np.int64).sum(axis=-1)
+        total = sum((1 << i) * (int(pos[i]) - int(neg[i]))
+                    for i in range(depth))
+        return total, count
+
+    def execute_min_max(self, idx: Index, c: Call, shards: list[int],
+                        is_min: bool):
+        """Global (value, count) pre-base: every shard's extremum computed
+        in one stacked program (the shape-polymorphic bit-serial descent of
+        ops.bsi), host-folded with the reference's smaller/larger rule."""
+        if not shards:
+            return 0, 0
+        _, exists, sign, stack, filt, depth = self._bsi_inputs(idx, c, shards)
+        cons_cnt, alt_cnt, a, b = _agg_min_max(exists, sign, stack, filt,
+                                               depth, is_min)
+        cons_cnt = np.asarray(cons_cnt)
+        alt_cnt = np.asarray(alt_cnt)
+        # lo/hi stay scalar when no magnitude bit reached their half
+        # (e.g. hi for depth<=32); broadcast to per-shard vectors.
+        a = tuple(np.broadcast_to(np.asarray(x), cons_cnt.shape) for x in a)
+        b = tuple(np.broadcast_to(np.asarray(x), cons_cnt.shape) for x in b)
+        best_val, best_cnt = 0, 0
+        for s in range(len(shards)):
+            if cons_cnt[s] == 0:
+                continue
+            if alt_cnt[s] > 0:
+                v = bsi_ops._join_u64(a[0][s], a[1][s])
+                cnt = int(a[2][s])
+                v = -v if is_min else v
+            else:
+                v = bsi_ops._join_u64(b[0][s], b[1][s])
+                cnt = int(b[2][s])
+                v = v if is_min else -v
+            if best_cnt == 0 or (v < best_val if is_min else v > best_val):
+                best_val, best_cnt = v, cnt
+        return best_val, best_cnt
+
+    # ------------------------------------------------------------------
+    # TopN batched counts: sparse-aware global row streaming. Instead of a
+    # dense [rows, S, W] cube (impossible at reference scale) or one
+    # device dispatch per shard (the r1 host loop), all (shard, row)
+    # pairs PRESENT in local fragments are concatenated and streamed as
+    # fixed [T, W] tiles; each tile gathers its per-shard filter segments
+    # on device. Dispatch count is ceil(total_present_rows / T) with no
+    # per-shard boundaries.
+    # ------------------------------------------------------------------
+
+    #: rows per TopN streaming tile (device mem: 2 * T * W * 4 bytes).
+    TOPN_TILE = 512
+
+    def execute_topn_pairs(self, idx: Index, field_name: str, view: str,
+                           shards: list[int], filter_call: Call | None,
+                           row_ids=None):
+        """Per-shard (shard, row_id, count) triplets for TopN, exactly the
+        per-fragment semantics of fragment.top (threshold filtering stays
+        per shard in the executor, matching executeTopNShards merge
+        semantics, executor.go:902)."""
+        pairs: list[tuple[int, int]] = []  # (shard_idx, row_id)
+        frags = {}
+        allowed = (set(int(r) for r in row_ids)
+                   if row_ids is not None else None)
+        for si, shard in enumerate(shards):
+            frag = self.holder.fragment(idx.name, field_name, view, shard)
+            if frag is None:
+                continue
+            frags[si] = frag
+            for rid in frag.rows_list(among=allowed):
+                pairs.append((si, rid))
+        if not pairs:
+            return []
+        if filter_call is None:
+            # Host-maintained counts; no device work at all.
+            return [(shards[si], rid, frags[si].rows[rid].count())
+                    for si, rid in pairs]
+        filt = self._tree_stack(idx, filter_call, shards)  # [S_pad, W]
+        T = self.TOPN_TILE
+        mat = np.zeros((T, WORDS_PER_SHARD), dtype=np.uint32)
+        sidx = np.zeros(T, dtype=np.int32)
+        out: list[tuple[int, int, int]] = []
+        for lo in range(0, len(pairs), T):
+            chunk = pairs[lo:lo + T]
+            for i, (si, rid) in enumerate(chunk):
+                mat[i] = frags[si].row_words(rid)
+                sidx[i] = si
+            if len(chunk) < T:
+                mat[len(chunk):] = 0
+                sidx[len(chunk):] = 0
+            counts = np.asarray(
+                _tile_gather_count(jnp.asarray(mat), filt,
+                                   jnp.asarray(sidx)))
+            for i, (si, rid) in enumerate(chunk):
+                out.append((shards[si], rid, int(counts[i])))
+        return out
 
     def invalidate(self) -> None:
         self._stack_cache.clear()
@@ -456,3 +602,38 @@ def _eval_node(sig: tuple, args) -> jax.Array:
 @jax.jit
 def _jit_or(a, b):
     return jnp.bitwise_or(a, b)
+
+
+@jax.jit
+def _jit_full_like(a):
+    return jnp.full_like(a, jnp.uint32(0xFFFFFFFF))
+
+
+@functools.partial(jax.jit, static_argnames=("depth", "is_min"))
+def _agg_min_max(exists, sign, stack, filt, depth: int, is_min: bool):
+    """Per-shard Min/Max fold over stacked [S, W] BSI rows.
+
+    Returns (consider_count[S], alt_count[S], a, b) where ``a`` is the
+    (lo, hi, count) of the branch taken when the sign class exists in the
+    shard (negatives for Min / positives for Max, fragment.go:1146/:1189)
+    and ``b`` the fallback branch; the host selects per shard.
+    """
+    consider = jnp.bitwise_and(exists, filt)
+    cons_cnt = bitops.count(consider)
+    if is_min:
+        alt = jnp.bitwise_and(sign, consider)       # negatives
+        a = bsi_ops._max_unsigned(stack, alt, depth)   # min = -max(|neg|)
+    else:
+        alt = bitops.b_andnot(consider, sign)        # positives
+        a = bsi_ops._max_unsigned(stack, alt, depth)   # max = max(pos)
+    alt_cnt = bitops.count(alt)
+    b = bsi_ops._min_unsigned(stack, consider, depth)
+    return cons_cnt, alt_cnt, a, b
+
+
+@jax.jit
+def _tile_gather_count(mat, filt_stack, sidx):
+    """counts[t] = popcount(mat[t] & filt_stack[sidx[t]]) — the TopN tile
+    kernel: per-row filter segments gathered on device, fused popcount."""
+    gathered = jnp.take(filt_stack, sidx, axis=0)
+    return bitops.intersection_count(mat, gathered)

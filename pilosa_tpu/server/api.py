@@ -222,10 +222,7 @@ class API:
         if frag is None:
             raise FragmentNotFoundError()
         buf = io.StringIO()
-        with frag._lock:  # to_positions may flush pending adds
-            pairs = [(rid, frag.rows[rid].to_positions())
-                     for rid in frag.row_ids()]
-        for rid, positions in pairs:
+        for rid, positions in frag.rows_snapshot():
             base = shard * SHARD_WIDTH
             for pos in positions:
                 col = int(pos) + base

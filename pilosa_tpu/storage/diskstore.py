@@ -218,8 +218,9 @@ class DiskStore:
         if frag is None:
             return
         with frag._lock:
-            row_ids = np.asarray(sorted(frag.rows), dtype=np.uint64)
-            parts = [frag.rows[int(r)].to_positions() for r in row_ids]
+            snap_rows = frag.rows_snapshot()
+            row_ids = np.asarray([r for r, _ in snap_rows], dtype=np.uint64)
+            parts = [p for _, p in snap_rows]
             offsets = np.zeros(len(parts) + 1, dtype=np.int64)
             for i, p in enumerate(parts):
                 offsets[i + 1] = offsets[i] + len(p)
@@ -236,6 +237,8 @@ class DiskStore:
             os.replace(tmp, path)
             _fsync_dir(os.path.dirname(path))
             # Snapshot is durable; only now may the WAL be discarded.
+            # The outer lock keeps the WAL truncation atomic with the
+            # snapshot (no append may land between them).
             self._writer(key).truncate()
 
     def snapshot_all(self) -> None:

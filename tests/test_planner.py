@@ -166,3 +166,101 @@ def test_cluster_nodes_use_planner():
     assert lc.query("i", "Count(Row(f=9))") == [3]
     # planner actually engaged on at least one node
     assert any(cn.executor.planner._fn_cache for cn in lc.nodes)
+
+
+# ------------------------------------------- aggregates on the mesh (round 2)
+
+AGG_QUERIES = [
+    "Sum(field=v)",
+    "Sum(Row(f=1), field=v)",
+    "Sum(Intersect(Row(f=1), Row(g=2)), field=v)",
+    "Min(field=v)",
+    "Min(Row(f=2), field=v)",
+    "Max(field=v)",
+    "Max(Row(f=2), field=v)",
+    "Min(Row(v < 0), field=v)",
+    "Max(Row(v >= -100), field=v)",
+]
+
+
+@pytest.mark.parametrize("q", AGG_QUERIES)
+def test_planner_aggregates_match_scalar(env, rng, q):
+    """Sum/Min/Max through one SPMD program == per-shard scalar path
+    (VERDICT r1 #4: planner must cover aggregates)."""
+    h, idx, plain, fast = env
+    seed(idx, rng)
+    (want,) = plain.execute("i", q)
+    (got,) = fast.execute("i", q)
+    assert (got.val, got.count) == (want.val, want.count), q
+
+
+def test_planner_agg_supports(env, rng):
+    h, idx, plain, fast = env
+    seed(idx, rng)
+    from pilosa_tpu.pql import parse
+    p = fast.planner
+    assert p.supports_aggregate(idx, parse("Sum(field=v)").calls[0])
+    assert p.supports_aggregate(idx, parse("Min(Row(f=1), field=v)").calls[0])
+    assert not p.supports_aggregate(idx, parse("Sum(field=f)").calls[0])
+    assert not p.supports_aggregate(idx, parse("Count(Row(f=1))").calls[0])
+    # Unknown filter field: supported structurally, raises at execution —
+    # matching the scalar path.
+    from pilosa_tpu.errors import FieldNotFoundError
+    with pytest.raises(FieldNotFoundError):
+        fast.execute("i", "Sum(Row(nosuch=1), field=v)")
+    with pytest.raises(FieldNotFoundError):
+        plain.execute("i", "Sum(Row(nosuch=1), field=v)")
+
+
+def test_planner_agg_empty_field(env, rng):
+    """Aggregate over a BSI field with no values set."""
+    h, idx, plain, fast = env
+    idx.create_field("w", FieldOptions(type=FIELD_TYPE_INT, min=0, max=10))
+    idx.create_field("f")
+    for q in ("Sum(field=w)", "Min(field=w)", "Max(field=w)"):
+        (want,) = plain.execute("i", q)
+        (got,) = fast.execute("i", q)
+        assert (got.val, got.count) == (want.val, want.count) == (0, 0), q
+
+
+TOPN_QUERIES = [
+    "TopN(f, n=4)",
+    "TopN(f)",
+    "TopN(f, Row(g=1), n=3)",
+    "TopN(f, Intersect(Row(g=1), Row(g=2)), n=5)",
+    "TopN(f, Row(g=0), n=2, threshold=10)",
+    "TopN(f, ids=[0, 2, 4])",
+    "TopN(f, Row(g=3), ids=[1, 3])",
+]
+
+
+@pytest.mark.parametrize("q", TOPN_QUERIES)
+def test_planner_topn_matches_scalar(env, rng, q):
+    """TopN through the sparse-aware streamed planner path == per-shard
+    scalar path (VERDICT r1 #4: TopN pass-1 counts on the mesh)."""
+    h, idx, plain, fast = env
+    seed(idx, rng)
+    (want,) = plain.execute("i", q)
+    (got,) = fast.execute("i", q)
+    assert [(p.id, p.count) for p in got] == \
+        [(p.id, p.count) for p in want], q
+
+
+def test_planner_topn_streams_tiles(env, rng, monkeypatch):
+    """The planner TopN path must bound device stacks by TOPN_TILE."""
+    from pilosa_tpu.parallel import planner as planmod
+    h, idx, plain, fast = env
+    seed(idx, rng, n_rows=40)
+    monkeypatch.setattr(MeshPlanner, "TOPN_TILE", 16)
+    seen = {"max": 0}
+    real = planmod._tile_gather_count
+
+    def spy(mat, filt, sidx):
+        seen["max"] = max(seen["max"], int(mat.shape[0]))
+        return real(mat, filt, sidx)
+
+    monkeypatch.setattr(planmod, "_tile_gather_count", spy)
+    (got,) = fast.execute("i", "TopN(f, Row(g=1), n=5)")
+    (want,) = plain.execute("i", "TopN(f, Row(g=1), n=5)")
+    assert seen["max"] == 16
+    assert [(p.id, p.count) for p in got] == [(p.id, p.count) for p in want]
