@@ -43,6 +43,17 @@ class InternalClient(Protocol):
         half of anti-entropy, fragment.go:2986)."""
         ...
 
+    def translate_keys(self, node: Node, index: str, field: str | None,
+                       keys: list[str]) -> list[int]:
+        """Allocate/look up keys on a peer — the coordinator-primary RPC
+        (reference http/translator.go)."""
+        ...
+
+    def translate_entries(self, node: Node, index: str, field: str | None,
+                          after_id: int) -> list[tuple[int, str]]:
+        """Entry stream for replica catch-up (translate.go:93)."""
+        ...
+
 
 class NopClient:
     """Standalone stub: remote calls are errors (clusters of one never
@@ -58,6 +69,12 @@ class NopClient:
         raise RuntimeError("nop client cannot reach remote nodes")
 
     def import_bits(self, node, index, field, view, shard, rows, cols, clear):
+        raise RuntimeError("nop client cannot reach remote nodes")
+
+    def translate_keys(self, node, index, field, keys):
+        raise RuntimeError("nop client cannot reach remote nodes")
+
+    def translate_entries(self, node, index, field, after_id):
         raise RuntimeError("nop client cannot reach remote nodes")
 
 
@@ -123,3 +140,10 @@ class LocalClient:
         return self._peer(node).handle_import_request(
             index, field, rows=rows, cols=cols, values=values,
             timestamps=timestamps, clear=clear)
+
+    def translate_keys(self, node, index, field, keys):
+        return self._peer(node).handle_translate_keys(index, field, keys)
+
+    def translate_entries(self, node, index, field, after_id):
+        return self._peer(node).handle_translate_entries(index, field,
+                                                         after_id)

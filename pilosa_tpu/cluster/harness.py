@@ -59,6 +59,10 @@ class ClusterNode:
         self.cluster = cluster
         self.executor = Executor(self.holder, cluster=cluster,
                                  node_id=node_id, planner=planner)
+        from pilosa_tpu.cluster.translate_sync import ClusterKeyTranslator
+        self.translator = ClusterKeyTranslator(self.holder, cluster,
+                                               cluster.client)
+        self.executor.translator = self.translator
 
     def _broadcast_shard(self, index: str, field: str, view: str, shard: int):
         msg = {"type": "create-shard", "index": index, "field": field,
@@ -149,6 +153,15 @@ class ClusterNode:
     def apply_schema(self, schema) -> None:
         self.holder.apply_schema(schema)
 
+    def handle_translate_keys(self, index, field, keys) -> list[int]:
+        """Coordinator-side allocation (http/translator.go analog); the
+        translator short-circuits to local stores on the coordinator."""
+        return self.translator(index, field, list(keys))
+
+    def handle_translate_entries(self, index, field, after_id):
+        from pilosa_tpu.cluster.translate_sync import translate_entries
+        return translate_entries(self.holder, index, field, after_id)
+
 
 class LocalCluster:
     """N in-process nodes sharing a LocalClient transport."""
@@ -189,6 +202,13 @@ class LocalCluster:
         """Run through one node as coordinator (Cluster.Query analog,
         test/pilosa.go:247)."""
         return self.nodes[node].executor.execute(index, query)
+
+    def sync_translation(self) -> int:
+        """Run the replica entry-stream pull on every node (the
+        anti-entropy translation step); returns entries applied."""
+        from pilosa_tpu.cluster.translate_sync import sync_translation
+        return sum(sync_translation(cn.holder, cn.cluster, self.client)
+                   for cn in self.nodes)
 
     def down(self, node_id: str) -> None:
         """Fault injection: the pumba 'pause container' analog

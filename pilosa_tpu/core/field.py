@@ -453,11 +453,16 @@ class Field:
     def import_bits(self, row_ids, column_ids, timestamps=None,
                     clear: bool = False) -> None:
         """Reference Field.Import (field.go:1204): group bits by view and
-        shard, then bulk-import per fragment."""
+        shard, then bulk-import per fragment. The by-shard split is a
+        vectorized sort (argsort + boundary search), not a per-bit Python
+        loop — 100M-bit imports group in seconds."""
         row_ids = np.asarray(row_ids, dtype=np.uint64)
         column_ids = np.asarray(column_ids, dtype=np.uint64)
         if timestamps is None:
-            timestamps = [None] * len(row_ids)
+            self._import_view_bits(
+                VIEW_STANDARD if not self.options.no_standard_view else None,
+                row_ids, column_ids, clear)
+            return
         data_by_view: dict[str, tuple[list, list]] = {}
         q = self.time_quantum()
         for rid, cid, ts in zip(row_ids.tolist(), column_ids.tolist(), timestamps):
@@ -473,18 +478,31 @@ class Field:
                 rows.append(rid)
                 cols.append(cid)
         for name, (rows, cols) in data_by_view.items():
-            view = self.create_view_if_not_exists(name)
-            by_shard: dict[int, tuple[list, list]] = {}
-            for rid, cid in zip(rows, cols):
-                r, c = by_shard.setdefault(cid // SHARD_WIDTH, ([], []))
-                r.append(rid)
-                c.append(cid)
-            for shard, (r, c) in by_shard.items():
-                frag = view.create_fragment_if_not_exists(shard)
-                if self.uses_mutex() and not clear:
-                    frag.bulk_import_mutex(r, c)
-                else:
-                    frag.bulk_import(r, c, clear=clear)
+            self._import_view_bits(name, np.asarray(rows, dtype=np.uint64),
+                                   np.asarray(cols, dtype=np.uint64), clear)
+
+    def _import_view_bits(self, view_name: str | None, row_ids: np.ndarray,
+                          column_ids: np.ndarray, clear: bool) -> None:
+        """Vectorized by-shard scatter of one view's bit batch."""
+        if view_name is None or len(row_ids) == 0:
+            return
+        view = self.create_view_if_not_exists(view_name)
+        shards = (column_ids // np.uint64(SHARD_WIDTH)).astype(np.int64)
+        order = np.argsort(shards, kind="stable")
+        shards = shards[order]
+        row_ids = row_ids[order]
+        column_ids = column_ids[order]
+        uniq, starts = np.unique(shards, return_index=True)
+        bounds = np.append(starts, len(shards))
+        for i, shard in enumerate(uniq.tolist()):
+            lo, hi = int(bounds[i]), int(bounds[i + 1])
+            frag = view.create_fragment_if_not_exists(int(shard))
+            if self.uses_mutex() and not clear:
+                frag.bulk_import_mutex(row_ids[lo:hi].tolist(),
+                                       column_ids[lo:hi].tolist())
+            else:
+                frag.bulk_import(row_ids[lo:hi], column_ids[lo:hi],
+                                 clear=clear)
 
     def import_values(self, column_ids, values, clear: bool = False) -> None:
         """Reference importValue (field.go:1285): validates range, grows

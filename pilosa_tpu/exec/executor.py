@@ -84,6 +84,9 @@ class Executor:
         #: MeshPlanner (pilosa_tpu.parallel): SPMD fast path for bitmap
         #: trees and Count() — one XLA program over all shards.
         self.planner = planner
+        #: cluster key-allocation hook: (index, field|None, keys) -> ids.
+        #: None = allocate in the local store (standalone / coordinator).
+        self.translator = None
         from pilosa_tpu.obs import NopStats
         self.stats = stats or NopStats()
 
@@ -572,6 +575,11 @@ class Executor:
         if min_threshold == 0:
             min_threshold = DEFAULT_MIN_THRESHOLD
 
+        attr_name = c.args.get("attrName")
+        attr_values = c.args.get("attrValues")
+        allowed_attrs = set(attr_values) if (attr_name and attr_values) \
+            else None
+
         def batch(shs: list[int]) -> list[Pair]:
             # cache_type 'none' errors only if a fragment exists, exactly
             # like the per-shard path (which never reaches the check when
@@ -584,20 +592,30 @@ class Executor:
                         f'cannot compute TopN(), field has no cache: '
                         f'"{field_name}"')
                 return []
-            triplets = self.planner.execute_topn_pairs(
+            per_shard = self.planner.execute_topn_counts(
                 idx, field_name, VIEW_STANDARD, list(shs), filter_call,
                 row_ids=[int(r) for r in row_ids] if has_ids else None)
-            by_shard: dict[int, list[tuple[int, int]]] = {}
-            for shard, rid, cnt in triplets:
-                if cnt > 0:
-                    by_shard.setdefault(shard, []).append((rid, cnt))
             acc: list[Pair] = []
-            for shard in sorted(by_shard):
-                raw = sorted(by_shard[shard], key=lambda p: (-p[1], p[0]))
-                pairs = self._top_filter_pairs(f, None, raw, None, 0,
-                                               min_threshold, c)
-                if n:
-                    pairs = pairs[:n]
+            for shard in sorted(per_shard):
+                # Arrives sorted (count desc, id asc); threshold is an
+                # order-preserving mask, then attr filter, then truncate
+                # — same order as _top_filter_pairs.
+                ids, counts = per_shard[shard]
+                keep = counts >= min_threshold
+                ids, counts = ids[keep], counts[keep]
+                if len(ids) == 0:
+                    continue
+                if allowed_attrs is None and n:
+                    ids, counts = ids[:n], counts[:n]
+                pairs: list[Pair] = []
+                for rid, cnt in zip(ids.tolist(), counts.tolist()):
+                    if allowed_attrs is not None:
+                        attrs = f.row_attr_store.attrs(rid)
+                        if attrs.get(attr_name) not in allowed_attrs:
+                            continue
+                    pairs.append(Pair(id=rid, count=cnt))
+                    if n and len(pairs) >= n:
+                        break
                 acc = merge_pairs(acc, pairs)
             return acc
 
@@ -1067,6 +1085,18 @@ class Executor:
     # key translation (reference executor.go:2610-2905)
     # ------------------------------------------------------------------
 
+    def _xlate(self, idx: Index, f, key: str) -> int:
+        """Allocate/lookup one key's id. With a cluster translator set,
+        allocation routes to the coordinator (the sole id authority,
+        reference translate.go:93 primary model); standalone nodes
+        allocate locally."""
+        if self.translator is not None:
+            return self.translator(idx.name,
+                                   f.name if f is not None else None,
+                                   [key])[0]
+        store = (f if f is not None else idx).translate_store
+        return store.translate_key(key)
+
     def _translate_call(self, idx: Index, c: Call) -> Call:
         """Map string keys to ids in-place on a clone."""
         c = c.clone()
@@ -1080,7 +1110,7 @@ class Executor:
             if not idx.options.keys:
                 raise QueryError(f"string 'col' value not allowed unless "
                                  f"index 'keys' option enabled: {col!r}")
-            c.args["_col"] = idx.translate_store.translate_key(col)
+            c.args["_col"] = self._xlate(idx, None, col)
         # Row keys (field-level).
         for key in list(c.args):
             if pql_ast.is_reserved_arg(key):
@@ -1090,7 +1120,7 @@ class Executor:
                 continue
             val = c.args[key]
             if isinstance(val, str) and f.keys:
-                c.args[key] = f.translate_store.translate_key(val)
+                c.args[key] = self._xlate(idx, f, val)
         row = c.args.get("_row")
         if isinstance(row, str):
             fname = c.args.get("_field")
@@ -1098,7 +1128,7 @@ class Executor:
             if f is None or not f.keys:
                 raise QueryError("string 'row' value not allowed unless "
                                  "field 'keys' option enabled")
-            c.args["_row"] = f.translate_store.translate_key(row)
+            c.args["_row"] = self._xlate(idx, f, row)
         # Rows()/GroupBy-child cursor args (reference translateCall
         # executor.go:2634-2637: rowKey="previous", colKey="column").
         if c.name == "Rows":
@@ -1109,13 +1139,13 @@ class Executor:
                 if f is None or not f.keys:
                     raise QueryError("string 'previous' value not allowed "
                                      "unless field 'keys' option enabled")
-                c.args["previous"] = f.translate_store.translate_key(p)
+                c.args["previous"] = self._xlate(idx, f, p)
             col = c.args.get("column")
             if isinstance(col, str):
                 if not idx.options.keys:
                     raise QueryError("string 'column' value not allowed "
                                      "unless index 'keys' option enabled")
-                c.args["column"] = idx.translate_store.translate_key(col)
+                c.args["column"] = self._xlate(idx, None, col)
         for ch in c.children:
             self._translate_call_rec(idx, ch)
         for v in c.args.values():

@@ -67,6 +67,10 @@ def _load() -> ctypes.CDLL | None:
         lib.popcount_words.restype = ctypes.c_int64
         lib.popcount_words.argtypes = [
             np.ctypeslib.ndpointer(np.uint32, flags="C"), ctypes.c_int64]
+        lib.intersection_count_words.restype = ctypes.c_int64
+        lib.intersection_count_words.argtypes = [
+            np.ctypeslib.ndpointer(np.uint32, flags="C"),
+            np.ctypeslib.ndpointer(np.uint32, flags="C"), ctypes.c_int64]
         _lib = lib
         return _lib
 
@@ -142,3 +146,14 @@ def popcount_words(words: np.ndarray) -> int:
         from pilosa_tpu.ops import bitops
         return bitops.np_count(words)
     return int(lib.popcount_words(words, len(words)))
+
+
+def intersection_count_words(a: np.ndarray, b: np.ndarray) -> int:
+    """Fused popcount(a & b) on the host — the CPU-baseline kernel."""
+    a = np.ascontiguousarray(a.reshape(-1), dtype=np.uint32)
+    b = np.ascontiguousarray(b.reshape(-1), dtype=np.uint32)
+    lib = _load()
+    if lib is None:
+        from pilosa_tpu.ops import bitops
+        return bitops.np_count(a & b)
+    return int(lib.intersection_count_words(a, b, len(a)))

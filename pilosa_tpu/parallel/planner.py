@@ -206,60 +206,49 @@ class MeshPlanner:
         return best_val, best_cnt
 
     # ------------------------------------------------------------------
-    # TopN batched counts: sparse-aware global row streaming. Instead of a
-    # dense [rows, S, W] cube (impossible at reference scale) or one
-    # device dispatch per shard (the r1 host loop), all (shard, row)
-    # pairs PRESENT in local fragments are concatenated and streamed as
-    # fixed [T, W] tiles; each tile gathers its per-shard filter segments
-    # on device. Dispatch count is ceil(total_present_rows / T) with no
-    # per-shard boundaries.
+    # TopN batched counts. Filterless: each fragment's generation-cached
+    # sorted counts (O(results) repeat queries — the rankCache
+    # replacement). Filtered: ONE compiled filter tree over all shards,
+    # then each fragment's two-tier count sweep (host membership for
+    # sparse rows, tiled device popcounts for dense rows —
+    # fragment.intersection_counts), so data motion tracks actual set
+    # bits, not rows x shard-width.
     # ------------------------------------------------------------------
 
-    #: rows per TopN streaming tile (device mem: 2 * T * W * 4 bytes).
-    TOPN_TILE = 512
-
-    def execute_topn_pairs(self, idx: Index, field_name: str, view: str,
-                           shards: list[int], filter_call: Call | None,
-                           row_ids=None):
-        """Per-shard (shard, row_id, count) triplets for TopN, exactly the
-        per-fragment semantics of fragment.top (threshold filtering stays
-        per shard in the executor, matching executeTopNShards merge
+    def execute_topn_counts(self, idx: Index, field_name: str, view: str,
+                            shards: list[int], filter_call: Call | None,
+                            row_ids=None) -> dict[int, tuple]:
+        """shard -> (ids, counts) arrays SORTED by count desc / id asc,
+        preserving per-fragment semantics (threshold filtering stays per
+        shard in the executor, matching executeTopNShards merge
         semantics, executor.go:902)."""
-        pairs: list[tuple[int, int]] = []  # (shard_idx, row_id)
-        frags = {}
-        allowed = (set(int(r) for r in row_ids)
+        allowed = (np.asarray(sorted(set(int(r) for r in row_ids)),
+                              dtype=np.uint64)
                    if row_ids is not None else None)
+        out: dict[int, tuple] = {}
+        filt = None
+        if filter_call is not None:
+            filt = self._tree_stack(idx, filter_call, shards)  # [S_pad, W]
         for si, shard in enumerate(shards):
             frag = self.holder.fragment(idx.name, field_name, view, shard)
             if frag is None:
                 continue
-            frags[si] = frag
-            for rid in frag.rows_list(among=allowed):
-                pairs.append((si, rid))
-        if not pairs:
-            return []
-        if filter_call is None:
-            # Host-maintained counts; no device work at all.
-            return [(shards[si], rid, frags[si].rows[rid].count())
-                    for si, rid in pairs]
-        filt = self._tree_stack(idx, filter_call, shards)  # [S_pad, W]
-        T = self.TOPN_TILE
-        mat = np.zeros((T, WORDS_PER_SHARD), dtype=np.uint32)
-        sidx = np.zeros(T, dtype=np.int32)
-        out: list[tuple[int, int, int]] = []
-        for lo in range(0, len(pairs), T):
-            chunk = pairs[lo:lo + T]
-            for i, (si, rid) in enumerate(chunk):
-                mat[i] = frags[si].row_words(rid)
-                sidx[i] = si
-            if len(chunk) < T:
-                mat[len(chunk):] = 0
-                sidx[len(chunk):] = 0
-            counts = np.asarray(
-                _tile_gather_count(jnp.asarray(mat), filt,
-                                   jnp.asarray(sidx)))
-            for i, (si, rid) in enumerate(chunk):
-                out.append((shards[si], rid, int(counts[i])))
+            if filt is None:
+                ids, counts = frag.top_counts()  # cached sorted order
+                if allowed is not None and len(ids):
+                    keep = np.isin(ids, allowed)
+                    ids, counts = ids[keep], counts[keep]
+                if len(ids):
+                    out[shard] = (ids, counts)
+                continue
+            ids, _ = frag.row_counts()
+            if allowed is not None and len(ids):
+                ids = ids[np.isin(ids, allowed, assume_unique=True)]
+            if not len(ids):
+                continue
+            counts = frag.intersection_counts(ids, filt[si])
+            order = np.lexsort((ids, -counts))
+            out[shard] = (ids[order], counts[order])
         return out
 
     def invalidate(self) -> None:
@@ -631,9 +620,3 @@ def _agg_min_max(exists, sign, stack, filt, depth: int, is_min: bool):
     return cons_cnt, alt_cnt, a, b
 
 
-@jax.jit
-def _tile_gather_count(mat, filt_stack, sidx):
-    """counts[t] = popcount(mat[t] & filt_stack[sidx[t]]) — the TopN tile
-    kernel: per-row filter segments gathered on device, fused popcount."""
-    gathered = jnp.take(filt_stack, sidx, axis=0)
-    return bitops.intersection_count(mat, gathered)

@@ -36,6 +36,17 @@ class API:
         self.executor = executor
         self.cluster = cluster
         self.syncer = syncer
+        #: cluster key-allocation hook: (index, field|None, keys) -> ids
+        #: (ClusterKeyTranslator); None = allocate locally.
+        self.translator = None
+
+    def _xlate_keys(self, idx, f, keys: Iterable[str]) -> list[int]:
+        keys = list(keys)
+        if self.translator is not None:
+            return self.translator(idx.name,
+                                   f.name if f is not None else None, keys)
+        store = (f if f is not None else idx).translate_store
+        return [store.translate_key(k) for k in keys]
 
     # -- query (api.go:135) ------------------------------------------------
 
@@ -125,10 +136,9 @@ class API:
         if f is None:
             raise FieldNotFoundError()
         if row_keys is not None:
-            row_ids = [f.translate_store.translate_key(k) for k in row_keys]
+            row_ids = self._xlate_keys(idx, f, row_keys)
         if column_keys is not None:
-            column_ids = [idx.translate_store.translate_key(k)
-                          for k in column_keys]
+            column_ids = self._xlate_keys(idx, None, column_keys)
         ts = None
         if timestamps is not None:
             ts = [tq.parse_time(t) if t else None for t in timestamps]
@@ -150,8 +160,7 @@ class API:
         if f is None:
             raise FieldNotFoundError()
         if column_keys is not None:
-            column_ids = [idx.translate_store.translate_key(k)
-                          for k in column_keys]
+            column_ids = self._xlate_keys(idx, None, column_keys)
         column_ids = list(column_ids)
         values = list(values)
         if self.cluster is not None:
@@ -264,13 +273,29 @@ class API:
 
     def translate_keys(self, index: str, field: str | None,
                        keys: list[str]) -> list[int]:
+        """Public + /internal/translate/keys surface. Routes through the
+        cluster translator (coordinator allocates; on the coordinator
+        itself this is a local allocation, so the internal RPC
+        terminates here — no forwarding loop)."""
+        idx = self.holder.index_or_raise(index)
+        f = None
+        if field:
+            f = idx.field(field)
+            if f is None:
+                raise FieldNotFoundError()
+        return self._xlate_keys(idx, f, keys)
+
+    def translate_entries(self, index: str, field: str | None,
+                          after_id: int) -> list[tuple[int, str]]:
+        """/internal/translate/entries: the replica entry stream
+        (reference translate.go:93 MultiTranslateEntryReader)."""
         idx = self.holder.index_or_raise(index)
         if field:
             f = idx.field(field)
             if f is None:
                 raise FieldNotFoundError()
-            return [f.translate_store.translate_key(k) for k in keys]
-        return [idx.translate_store.translate_key(k) for k in keys]
+            return f.translate_store.entries_since(after_id)
+        return idx.translate_store.entries_since(after_id)
 
     def recalculate_caches(self) -> None:
         """Row counts are maintained exactly; nothing to rebuild. Kept for

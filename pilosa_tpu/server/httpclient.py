@@ -122,3 +122,17 @@ class HTTPInternalClient:
             self._request(node, "GET", "/version")
         except (RuntimeError, LookupError):
             pass  # alive but unhappy still counts as alive
+
+    def translate_keys(self, node, index, field, keys):
+        body = json.dumps({"index": index, "field": field,
+                           "keys": list(keys)}).encode()
+        resp = self._request(node, "POST", "/internal/translate/keys", body)
+        return resp["ids"]
+
+    def translate_entries(self, node, index, field, after_id):
+        path = (f"/internal/translate/entries?index={index}"
+                f"&after={int(after_id)}")
+        if field:
+            path += f"&field={field}"
+        resp = self._request(node, "GET", path)
+        return [(int(i), k) for i, k in resp["entries"]]

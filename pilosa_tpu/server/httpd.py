@@ -250,6 +250,12 @@ def _build_routes(api: API):
                                  req.get("keys", []))
         return 200, {"ids": ids}
 
+    def get_translate_entries(pv, params, body):
+        entries = api.translate_entries(params["index"],
+                                        params.get("field"),
+                                        int(params.get("after", 0)))
+        return 200, {"entries": [[i, k] for i, k in entries]}
+
     # internal RPC
     def post_cluster_message(pv, params, body):
         msg = jbody(body)
@@ -332,6 +338,7 @@ def _build_routes(api: API):
         (r"/recalculate-caches", {"POST": post_recalculate}),
         (r"/internal/shards/max", {"GET": get_shards_max}),
         (r"/internal/translate/keys", {"POST": post_translate_keys}),
+        (r"/internal/translate/entries", {"GET": get_translate_entries}),
         (r"/internal/cluster/message", {"POST": post_cluster_message}),
         (r"/internal/fragment/blocks", {"GET": get_fragment_blocks}),
         (r"/internal/fragment/data", {"GET": get_fragment_data}),

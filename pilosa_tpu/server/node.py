@@ -80,6 +80,13 @@ class ServerNode:
         if self.cluster is not None:
             self.syncer = HolderSyncer(self.holder, self.cluster,
                                        self.cluster.client)
+            # Coordinator-primary key allocation (translate.go:93 model):
+            # every keyed allocation routes to the coordinator.
+            from pilosa_tpu.cluster.translate_sync import ClusterKeyTranslator
+            translator = ClusterKeyTranslator(self.holder, self.cluster,
+                                              self.cluster.client)
+            self.executor.translator = translator
+            self.api.translator = translator
 
         if data_dir:
             from pilosa_tpu.storage.diskstore import DiskStore
@@ -98,6 +105,9 @@ class ServerNode:
     def _schedule_sync(self) -> None:
         def tick():
             try:
+                from pilosa_tpu.cluster.translate_sync import sync_translation
+                sync_translation(self.holder, self.cluster,
+                                 self.cluster.client)
                 self.syncer.sync_holder()
             finally:
                 self._schedule_sync()

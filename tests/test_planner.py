@@ -251,16 +251,22 @@ def test_planner_topn_streams_tiles(env, rng, monkeypatch):
     from pilosa_tpu.parallel import planner as planmod
     h, idx, plain, fast = env
     seed(idx, rng, n_rows=40)
-    monkeypatch.setattr(MeshPlanner, "TOPN_TILE", 16)
+    from pilosa_tpu.ops import pallas_kernels
+    from pilosa_tpu.core import fragment as fragmod
+    monkeypatch.setattr(fragmod, "STACK_CACHE_MAX_ROWS", 8)
+    monkeypatch.setattr(fragmod, "ROW_TILE", 8)
     seen = {"max": 0}
-    real = planmod._tile_gather_count
+    real = pallas_kernels.pair_count
 
-    def spy(mat, filt, sidx):
-        seen["max"] = max(seen["max"], int(mat.shape[0]))
-        return real(mat, filt, sidx)
+    def spy(a, b, op="and"):
+        if hasattr(a, "ndim") and a.ndim == 2:
+            seen["max"] = max(seen["max"], int(a.shape[0]))
+        return real(a, b, op)
 
-    monkeypatch.setattr(planmod, "_tile_gather_count", spy)
+    monkeypatch.setattr(pallas_kernels, "pair_count", spy)
     (got,) = fast.execute("i", "TopN(f, Row(g=1), n=5)")
     (want,) = plain.execute("i", "TopN(f, Row(g=1), n=5)")
-    assert seen["max"] == 16
+    # Dense rows stream in bounded tiles; sparse rows never touch the
+    # device at all (host membership path).
+    assert seen["max"] <= 8
     assert [(p.id, p.count) for p in got] == [(p.id, p.count) for p in want]

@@ -1,0 +1,143 @@
+"""Cluster key-translation replication (reference translate.go:93,
+holder.go:785-878, http/translator.go): the coordinator is the sole id
+allocator; every node resolves the same key to the same id no matter
+which node receives the query or import, and replicas catch up via the
+entry stream."""
+
+import json
+import socket
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.cluster.harness import LocalCluster
+from pilosa_tpu.core.field import FieldOptions
+from pilosa_tpu.core.index import IndexOptions
+
+
+def _mk_keyed_cluster(n=3):
+    lc = LocalCluster(n)
+    lc.create_index("k", IndexOptions(keys=True))
+    lc.create_field("k", "f", FieldOptions(keys=True))
+    return lc
+
+
+def test_same_key_same_id_any_node():
+    lc = _mk_keyed_cluster()
+    # Allocate the same keys through different nodes: ids must agree.
+    ids = [lc.nodes[i].translator("k", "f", ["alpha", "beta"])
+           for i in range(3)]
+    assert ids[0] == ids[1] == ids[2]
+    # Index (column) keys too.
+    cids = [lc.nodes[i].translator("k", None, ["c1", "c2"]) for i in range(3)]
+    assert cids[0] == cids[1] == cids[2]
+    # Distinct keys get distinct ids even when allocated via
+    # different nodes.
+    a = lc.nodes[1].translator("k", "f", ["gamma"])[0]
+    b = lc.nodes[2].translator("k", "f", ["delta"])[0]
+    assert a != b
+
+
+def test_query_via_any_node_consistent():
+    lc = _mk_keyed_cluster()
+    # Writes through different nodes using keys.
+    lc.nodes[1].executor.execute("k", 'Set("c1", f="r1")')
+    lc.nodes[2].executor.execute("k", 'Set("c2", f="r1")')
+    lc.nodes[0].executor.execute("k", 'Set("c3", f="r2")')
+    for i in range(3):
+        (cnt,) = lc.nodes[i].executor.execute("k", 'Count(Row(f="r1"))')
+        assert cnt == 2, (i, cnt)
+        (cnt2,) = lc.nodes[i].executor.execute("k", 'Count(Row(f="r2"))')
+        assert cnt2 == 1, (i, cnt2)
+
+
+def test_reverse_translation_after_sync():
+    lc = _mk_keyed_cluster()
+    lc.nodes[1].executor.execute("k", 'Set("c9", f="r9")')
+    lc.sync_translation()
+    # Every node can reverse-translate ids allocated elsewhere.
+    for cn in lc.nodes:
+        idx = cn.holder.index("k")
+        f = idx.field("f")
+        rid = f.translate_store.translate_key("r9", create=False)
+        cid = idx.translate_store.translate_key("c9", create=False)
+        assert rid is not None and cid is not None
+        assert f.translate_store.translate_id(rid) == "r9"
+        assert idx.translate_store.translate_id(cid) == "c9"
+    # Row() keys resolve on a node that never saw the write.
+    (row,) = lc.nodes[2].executor.execute("k", 'Row(f="r9")')
+    assert row.keys == ["c9"]
+
+
+def test_coordinator_down_existing_keys_still_resolve():
+    lc = _mk_keyed_cluster()
+    lc.nodes[1].translator("k", "f", ["seen"])
+    lc.down("node0")  # coordinator gone
+    # Known key resolves from the local replica copy.
+    assert lc.nodes[1].translator("k", "f", ["seen"]) is not None
+    # Unknown key cannot be allocated without the authority.
+    with pytest.raises(ConnectionError):
+        lc.nodes[1].translator("k", "f", ["never-seen"])
+
+
+def test_http_cluster_translation():
+    """Two ServerNodes over real HTTP: keyed writes via the
+    non-coordinator agree with the coordinator."""
+    from pilosa_tpu.server.node import ServerNode
+
+    ports = []
+    for _ in range(2):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        s.close()
+    addrs = [f"127.0.0.1:{p}" for p in ports]
+    nodes = [ServerNode(bind=a, peers=[x for x in addrs if x != a],
+                        use_planner=False) for a in addrs]
+    for n in nodes:
+        n.open()
+    try:
+        # Coordinator = sorted-first address.
+        coord = min(addrs)
+        other = max(addrs)
+        coord_node = next(n for n in nodes if n.id == coord)
+        other_node = next(n for n in nodes if n.id == other)
+
+        def post(addr, path, body=""):
+            r = urllib.request.Request(f"http://{addr}{path}",
+                                       data=body.encode(), method="POST")
+            return json.loads(urllib.request.urlopen(r, timeout=10).read()
+                              or b"{}")
+
+        post(other, "/index/k", json.dumps({"options": {"keys": True}}))
+        post(other, "/index/k/field/f",
+             json.dumps({"options": {"keys": True}}))
+        # Writes with keys through BOTH nodes.
+        post(other, "/index/k/query", 'Set("c1", f="r1")')
+        post(coord, "/index/k/query", 'Set("c2", f="r1")')
+        for addr in addrs:
+            got = post(addr, "/index/k/query", 'Count(Row(f="r1"))')
+            assert got == {"results": [2]}, (addr, got)
+        # The id maps agree between the nodes for the shared keys.
+        f_coord = coord_node.holder.index("k").field("f")
+        f_other = other_node.holder.index("k").field("f")
+        rid = f_coord.translate_store.translate_key("r1", create=False)
+        assert rid is not None
+        assert f_other.translate_store.translate_key(
+            "r1", create=False) == rid
+        # Entry-stream catch-up over HTTP.
+        from pilosa_tpu.cluster.translate_sync import sync_translation
+        coord_node.api.translate_keys("k", "f", ["coord-only"])
+        applied = sync_translation(other_node.holder, other_node.cluster,
+                                   other_node.cluster.client)
+        assert applied >= 1
+        assert f_other.translate_store.translate_key(
+            "coord-only", create=False) == f_coord.translate_store. \
+            translate_key("coord-only", create=False)
+    finally:
+        for n in nodes:
+            try:
+                n.close()
+            except Exception:
+                pass
