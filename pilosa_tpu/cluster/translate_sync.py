@@ -85,8 +85,11 @@ def sync_translation(holder: Holder, cluster, client) -> int:
                     for fname, f in sorted(idx.fields.items())]
         for iname, fname, store in targets:
             try:
-                entries = client.translate_entries(coord, iname, fname,
-                                                   store.max_id())
+                # Pull from the contiguous watermark, NOT max_id():
+                # apply_entries advances _next past ids this replica never
+                # saw, so max_id() can skip over coordinator entries.
+                entries = client.translate_entries(
+                    coord, iname, fname, store.replication_watermark())
             except (ConnectionError, LookupError):
                 continue
             if entries:

@@ -400,27 +400,24 @@ class Fragment:
                     dense_ids.append(r)
                     dense_slots.append(i)
                 else:
-                    sparse_pos.append(hr.to_positions())
-                    sparse_slots.append(i)
+                    p = hr.to_positions()
+                    if len(p):  # empty rows (post clear/steal) count 0
+                        sparse_pos.append(p)
+                        sparse_slots.append(i)
 
             if sparse_pos:
                 seg_host = np.asarray(seg, dtype=np.uint32)
                 lens = np.fromiter((len(p) for p in sparse_pos),
                                    dtype=np.int64, count=len(sparse_pos))
-                pos = (np.concatenate(sparse_pos) if lens.sum()
-                       else np.empty(0, np.uint64))
-                if len(pos):
-                    word = (pos >> np.uint64(5)).astype(np.int64)
-                    bit = np.left_shift(
-                        np.uint32(1), (pos & np.uint64(31)).astype(np.uint32))
-                    hits = ((seg_host[word] & bit) != 0).astype(np.int64)
-                    offsets = np.zeros(len(lens), dtype=np.int64)
-                    np.cumsum(lens[:-1], out=offsets[1:])
-                    # reduceat copies the next element for zero-length
-                    # rows; mask them back to 0.
-                    sums = np.add.reduceat(hits, offsets)
-                    sums[lens == 0] = 0
-                    out[sparse_slots] = sums
+                pos = np.concatenate(sparse_pos)
+                word = (pos >> np.uint64(5)).astype(np.int64)
+                bit = np.left_shift(
+                    np.uint32(1), (pos & np.uint64(31)).astype(np.uint32))
+                hits = ((seg_host[word] & bit) != 0).astype(np.int64)
+                # All lens > 0, so every reduceat offset is < len(hits).
+                offsets = np.zeros(len(lens), dtype=np.int64)
+                np.cumsum(lens[:-1], out=offsets[1:])
+                out[sparse_slots] = np.add.reduceat(hits, offsets)
 
             if dense_ids:
                 if len(dense_ids) <= STACK_CACHE_MAX_ROWS:

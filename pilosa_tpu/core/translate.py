@@ -23,6 +23,11 @@ class TranslateStore:
         self._fwd: dict[str, int] = {}
         self._rev: dict[int, str] = {}
         self._next = 1  # ids start at 1 (boltdb/translate.go sequence)
+        #: contiguous replication watermark: highest id W such that every
+        #: id in [1, W] is present. apply_entries may skip ids allocated
+        #: on the coordinator by other writers, so replica pulls resume
+        #: from here, not max_id() (which _next races ahead of).
+        self._watermark = 0
         self._lock = threading.RLock()
         if path and os.path.exists(path):
             self._load()
@@ -55,6 +60,16 @@ class TranslateStore:
     def max_id(self) -> int:
         with self._lock:
             return self._next - 1
+
+    def replication_watermark(self) -> int:
+        """Highest id up to which the store is gap-free — the safe
+        ``entries_since`` cursor for replica pulls."""
+        with self._lock:
+            w = self._watermark
+            while (w + 1) in self._rev:
+                w += 1
+            self._watermark = w
+            return w
 
     # -- replication feed (cluster layer streams entries id-ascending) -----
 

@@ -358,3 +358,28 @@ def test_intersection_counts_streaming_equivalence(rng, monkeypatch):
         fragmod.ROW_TILE = old_tile
     np.testing.assert_array_equal(fast, slow)
     assert fast[0] == 30  # row 0 ∩ itself
+
+
+def test_intersection_counts_trailing_empty_sparse_rows():
+    """ADVICE r2 (high): empty HostRows persisting after clear_bit made
+    np.add.reduceat see an offset == len(hits) and raise IndexError when
+    the LAST sparse row(s) in the queried id set had zero positions."""
+    from pilosa_tpu.core.fragment import Fragment
+    from pilosa_tpu.core.row import Row
+    import numpy as np
+
+    frag = Fragment("i", "f", "standard", 0)
+    frag.set_bit(1, 10)
+    frag.set_bit(1, 20)
+    frag.set_bit(5, 10)
+    frag.clear_bit(5, 10)          # row 5 now empty but still present
+    src = Row({0: frag.row_words(1)})
+    pairs = frag.top(src=src)      # used to raise IndexError
+    assert pairs == [(1, 2)]
+    counts = frag.intersection_counts([1, 5], frag.row_words(1))
+    assert counts.tolist() == [2, 0]
+    # Empty row in the MIDDLE plus trailing empty row.
+    frag.set_bit(9, 10)
+    frag.clear_bit(9, 10)
+    counts = frag.intersection_counts([1, 5, 9], frag.row_words(1))
+    assert counts.tolist() == [2, 0, 0]

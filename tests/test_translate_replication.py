@@ -141,3 +141,25 @@ def test_http_cluster_translation():
                 n.close()
             except Exception:
                 pass
+
+
+def test_watermark_pull_fills_gaps():
+    """ADVICE r2: apply_entries advances _next past unseen ids, so pulling
+    entries_since(max_id()) skips coordinator entries with smaller ids.
+    The contiguous replication watermark must not."""
+    from pilosa_tpu.core.translate import TranslateStore
+
+    coord = TranslateStore()
+    for k in ("a", "b", "c", "d"):   # ids 1..4
+        coord.translate_key(k)
+    replica = TranslateStore()
+    # Replica first learns only id 4 (e.g. via a query touching "d").
+    replica.apply_entries([(4, "d")])
+    assert replica.max_id() == 4          # _next raced ahead
+    assert replica.replication_watermark() == 0
+    entries = coord.entries_since(replica.replication_watermark())
+    replica.apply_entries(entries)
+    for k in ("a", "b", "c", "d"):
+        assert replica.translate_key(k, create=False) == \
+            coord.translate_key(k, create=False)
+    assert replica.replication_watermark() == 4
