@@ -127,6 +127,26 @@ def bench_star_trace(extra):
     extra["cpu_threaded_qps"] = round(cpu_qps, 2)
     extra["cpu_threads"] = n_cpu
 
+    # ---- device link characterization ----
+    # On this deployment the TPU sits behind a tunnel: ONE synchronous
+    # device->host pull costs ~100ms of link latency no matter how small
+    # the array. Every metric below that needs a device sync is bounded
+    # by this floor; the system answers are (a) the TransferBatcher --
+    # concurrent queries share one stacked transfer per wave -- and (b)
+    # the epoch-invalidated result cache for repeated reads.
+    import jax.numpy as jnp
+
+    _tiny = jax.device_put(np.arange(8, dtype=np.int32))
+    _sumf = jax.jit(lambda v: jnp.sum(v))
+    int(_sumf(_tiny))
+    floors = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        int(_sumf(_tiny))
+        floors.append(time.perf_counter() - t0)
+    extra["device_sync_floor_ms"] = round(
+        statistics.median(floors) * 1e3, 2)
+
     # ---- executor + planner path ----
     shards = list(range(n_shards))
     planner = MeshPlanner(h, make_mesh())
@@ -136,18 +156,41 @@ def bench_star_trace(extra):
     (got,) = ex.execute("bench", q, shards=shards)
     assert got == expected, (got, expected)
 
-    def run():
-        (r,) = ex.execute("bench", q, shards=shards)
-        return r
-
-    qps, p50 = _timer(run, N_QUERIES, threads=THREADS)
+    # Pipelined throughput through the FULL stack (parse, cache check,
+    # translate, planner, batcher), result cache bypassed so every query
+    # runs its device program and delivers its count to the host.
+    ex.execute("bench", q, shards=shards, cache=False)  # warm async path
+    t0 = time.perf_counter()
+    futs = [ex.execute_async("bench", q, shards=shards, cache=False)
+            for _ in range(N_QUERIES)]
+    results = [f.result() for f in futs]
+    dt = time.perf_counter() - t0
+    assert all(r == [expected] for r in results)
+    qps = N_QUERIES / dt
     extra["executor_count_intersect_qps"] = round(qps, 1)
+
+    # Sequential latency: cold (one full device round-trip per query,
+    # floor-bound by the link) and cached (the system behavior for any
+    # repeated read until the next write).
+    lat = []
+    for _ in range(min(N_LAT, 15)):
+        t0 = time.perf_counter()
+        ex.execute("bench", q, shards=shards, cache=False)
+        lat.append(time.perf_counter() - t0)
+    extra["executor_count_intersect_cold_p50_ms"] = round(
+        statistics.median(lat) * 1e3, 2)
+    lat = []
+    for _ in range(N_LAT):
+        t0 = time.perf_counter()
+        ex.execute("bench", q, shards=shards)
+        lat.append(time.perf_counter() - t0)
+    p50 = statistics.median(lat) * 1e3
     extra["executor_count_intersect_p50_ms"] = round(p50, 3)
     extra["cols"] = n_shards * SHARD_WIDTH
 
     # Raw-kernel continuity number (r1's measure): pipelined, no executor.
-    a = planner._stack_rows("f", "standard", 1, tuple(shards))
-    b = planner._stack_rows("g", "standard", 2, tuple(shards))
+    a = planner._stack_rows(idx, "f", "standard", 1, tuple(shards))
+    b = planner._stack_rows(idx, "g", "standard", 2, tuple(shards))
 
     import jax.numpy as jnp
 
@@ -162,6 +205,23 @@ def bench_star_trace(extra):
     outs = [kernel(a, b) for _ in range(N_QUERIES)]
     jax.block_until_ready(outs)
     extra["raw_kernel_qps"] = round(N_QUERIES / (time.perf_counter() - t0), 1)
+
+    # Enqueue-rate only (above) is NOT a query rate: nothing forces each
+    # call's result off the device, and the tunnel pipelines/elides, so
+    # the number is unstable run to run. The honest kernel ceiling is
+    # "counts delivered to the host" through the same batcher the
+    # executor uses — bare kernel + transfer, zero executor logic.
+    from pilosa_tpu.parallel.batcher import TransferBatcher
+
+    bt = TransferBatcher()
+    post = lambda host: int(host.astype(np.int64).sum())  # noqa: E731
+    bt.submit(kernel(a, b), post).result()  # warm stacker
+    t0 = time.perf_counter()
+    futs = [bt.submit(kernel(a, b), post) for _ in range(N_QUERIES)]
+    vals = [f.result() for f in futs]
+    extra["kernel_delivered_qps"] = round(
+        N_QUERIES / (time.perf_counter() - t0), 1)
+    assert vals[0] == expected
 
     # ---- one pass through HTTP (config-1 surface parity) ----
     try:
@@ -212,11 +272,14 @@ def _bench_http(extra, expected):
         rng = np.random.default_rng(11)
         for fld, rid in (("f", 1), ("g", 2)):
             body = json.dumps({
-                "rows": [rid] * n_bits,
-                "cols": rng.integers(0, cols, n_bits).tolist()})
+                "rowIDs": [rid] * n_bits,
+                "columnIDs": rng.integers(0, cols, n_bits).tolist()})
             post(f"/index/b/field/{fld}/import", body)
         q = "Count(Intersect(Row(f=1), Row(g=2)))"
-        post("/index/b/query", q)  # warm
+        warm = post("/index/b/query", q)
+        # r2 silently counted an EMPTY index here (wrong wire field
+        # names); never trust an unasserted benchmark query.
+        assert warm["results"][0] > 0, warm
 
         def run():
             return post("/index/b/query", q)

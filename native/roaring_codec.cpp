@@ -279,4 +279,122 @@ int64_t intersection_count_words(const uint32_t* a, const uint32_t* b,
   return total;
 }
 
+void scatter_row_blocks(const uint64_t* cols, int64_t n, int exp,
+                        uint32_t* blocks, int64_t n_shards,
+                        int64_t words_per_shard, uint8_t* touched) {
+  // Bulk-import scatter for ONE bitmap row: absolute column ids ->
+  // dense per-shard word blocks (blocks is [n_shards, words_per_shard],
+  // caller-zeroed). The order-insensitivity of a bitset means no sort
+  // is needed — this is what lets the import path hit memory-bandwidth
+  // rates where the reference walks roaring containers per bit batch
+  // (fragment.go:1997 -> AddN).
+  //
+  // Two-phase for cache locality: a direct scatter across all blocks
+  // misses cache on every bit (the block array spans 100s of MB), so
+  // first radix-PARTITION the local positions by shard — the ~n_shards
+  // sequential write heads stay cache-resident — then set bits shard by
+  // shard into one block that fits in L2.
+  const uint64_t mask = (1ULL << exp) - 1;
+  // Small batches: partitioning overhead isn't worth it.
+  if (n < (1 << 18) || n_shards <= 4) {
+    for (int64_t k = 0; k < n; k++) {
+      uint64_t c = cols[k];
+      uint64_t shard = c >> exp;
+      if (static_cast<int64_t>(shard) >= n_shards) continue;
+      uint64_t local = c & mask;
+      blocks[shard * words_per_shard + (local >> 5)] |= 1u << (local & 31);
+      touched[shard] = 1;
+    }
+    return;
+  }
+  std::vector<int64_t> counts(n_shards + 1, 0);
+  for (int64_t k = 0; k < n; k++) {
+    uint64_t shard = cols[k] >> exp;
+    if (static_cast<int64_t>(shard) < n_shards) counts[shard + 1]++;
+  }
+  for (int64_t s = 0; s < n_shards; s++) counts[s + 1] += counts[s];
+  std::vector<uint32_t> part(counts[n_shards]);
+  std::vector<int64_t> head(counts.begin(), counts.end() - 1);
+  for (int64_t k = 0; k < n; k++) {
+    uint64_t c = cols[k];
+    uint64_t shard = c >> exp;
+    if (static_cast<int64_t>(shard) >= n_shards) continue;
+    part[head[shard]++] = static_cast<uint32_t>(c & mask);
+  }
+  for (int64_t s = 0; s < n_shards; s++) {
+    int64_t lo = counts[s], hi = counts[s + 1];
+    if (lo == hi) continue;
+    uint32_t* block = blocks + s * words_per_shard;
+    for (int64_t k = lo; k < hi; k++) {
+      uint32_t local = part[k];
+      block[local >> 5] |= 1u << (local & 31);
+    }
+    touched[s] = 1;
+  }
+}
+
+void scatter_bsi_blocks(const uint64_t* cols, const int64_t* vals, int64_t n,
+                        int exp, int depth, uint32_t* blocks,
+                        int64_t n_shards, int64_t words_per_shard,
+                        uint8_t* touched) {
+  // BSI bulk-import scatter: (column, value) pairs -> dense bit-plane
+  // blocks. blocks is [n_shards, depth+2, words_per_shard] caller-zeroed;
+  // per shard the row order is exists, sign, then magnitude planes
+  // (fragment BSI layout, reference fragment.go:87-93 + importValue
+  // :2205). Shard-partitions first so one shard's whole plane set
+  // (~(depth+2) * 128 KiB) stays cache-resident while its bits land.
+  // Duplicated columns follow last-write-wins like sequential writes:
+  // the exists plane doubles as the batch's seen-set (caller guarantees
+  // a FRESH view), so a duplicate clears the column across all planes
+  // before the new value lands — no host-side dedupe sort needed.
+  const uint64_t mask = (1ULL << exp) - 1;
+  const int64_t rows = depth + 2;
+  std::vector<int64_t> counts(n_shards + 1, 0);
+  for (int64_t k = 0; k < n; k++) {
+    uint64_t shard = cols[k] >> exp;
+    if (static_cast<int64_t>(shard) < n_shards) counts[shard + 1]++;
+  }
+  for (int64_t s = 0; s < n_shards; s++) counts[s + 1] += counts[s];
+  std::vector<uint32_t> plocal(counts[n_shards]);
+  std::vector<int64_t> pval(counts[n_shards]);
+  std::vector<int64_t> head(counts.begin(), counts.end() - 1);
+  for (int64_t k = 0; k < n; k++) {
+    uint64_t c = cols[k];
+    uint64_t shard = c >> exp;
+    if (static_cast<int64_t>(shard) >= n_shards) continue;
+    int64_t at = head[shard]++;
+    plocal[at] = static_cast<uint32_t>(c & mask);
+    pval[at] = vals[k];
+  }
+  for (int64_t s = 0; s < n_shards; s++) {
+    int64_t lo = counts[s], hi = counts[s + 1];
+    if (lo == hi) continue;
+    uint32_t* base = blocks + s * rows * words_per_shard;
+    for (int64_t k = lo; k < hi; k++) {
+      uint32_t local = plocal[k];
+      int64_t w = local >> 5;
+      uint32_t bit = 1u << (local & 31);
+      if (base[w] & bit) {  // duplicate column: clear every plane bit
+        for (int64_t r = 1; r < rows; r++)
+          base[r * words_per_shard + w] &= ~bit;
+      }
+      base[w] |= bit;  // exists row
+      int64_t v = pval[k];
+      uint64_t mag;
+      if (v < 0) {
+        base[words_per_shard + w] |= bit;  // sign row
+        mag = static_cast<uint64_t>(-v);
+      } else {
+        mag = static_cast<uint64_t>(v);
+      }
+      while (mag) {
+        int i = __builtin_ctzll(mag);
+        mag &= mag - 1;
+        if (i < depth) base[(2 + i) * words_per_shard + w] |= bit;
+      }
+    }
+    touched[s] = 1;
+  }
+}
+
 }  // extern "C"

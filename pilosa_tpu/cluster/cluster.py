@@ -9,6 +9,7 @@ Reference: cluster.go (struct :186, state machine :47-50, partitionNodes
 from __future__ import annotations
 
 import threading
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable
 
 from pilosa_tpu.config import DEFAULT_PARTITION_N
@@ -131,37 +132,62 @@ class Cluster:
                    reduce_fn: Callable[[Any, Any], Any],
                    local_batch_fn: Callable[[list[int]], Any] | None = None) -> Any:
         """``local_batch_fn`` lets the mesh planner take this node's whole
-        shard batch as one SPMD program instead of a per-shard loop."""
+        shard batch as one SPMD program instead of a per-shard loop.
+
+        Node groups run CONCURRENTLY (the reference's per-node goroutines,
+        executor.go:2517): the local device program and every remote HTTP
+        query overlap, so cluster latency is max(node) not sum(nodes)."""
         nodes = [n for n in self.nodes if n.state != "DOWN"]
         result = None
         pending = list(shards)
+        pql = str(c)  # serialize the node-boundary query once
+
+        def run_local(node_shards: list[int]):
+            if local_batch_fn is not None:
+                return local_batch_fn(node_shards)
+            acc = None
+            for shard in node_shards:
+                acc = reduce_fn(acc, map_fn(shard))
+            return acc
+
+        def run_remote(node_id: str, node_shards: list[int]):
+            node = self.node_by_id(node_id)
+            return self.client.query_node(node, idx.name, pql, node_shards,
+                                          remote=True)[0]
+
         while pending:
             groups = self.shards_by_node(nodes, idx.name, pending)
             failed: list[int] = []
-            done: list[int] = []
-            for node_id, node_shards in groups.items():
-                if node_id == self.local_id:
-                    if local_batch_fn is not None:
-                        acc = local_batch_fn(node_shards)
-                    else:
-                        acc = None
-                        for shard in node_shards:
-                            acc = reduce_fn(acc, map_fn(shard))
+            tasks: list[tuple[str, list[int], Any]] = []
+            if len(groups) == 1:  # no thread-pool overhead single-node
+                (node_id, node_shards), = groups.items()
+                try:
+                    acc = (run_local(node_shards)
+                           if node_id == self.local_id
+                           else run_remote(node_id, node_shards))
                     result = acc if result is None else reduce_fn(result, acc)
-                    done.extend(node_shards)
-                else:
-                    try:
-                        node = self.node_by_id(node_id)
-                        res = self.client.query_node(
-                            node, idx.name, str(c), node_shards, remote=True)
-                        result = res[0] if result is None else \
-                            reduce_fn(result, res[0])
-                        done.extend(node_shards)
-                    except ConnectionError:
-                        # Failover: drop the node, re-map its shards onto
-                        # replicas (executor.go:2492-2503).
-                        nodes = [n for n in nodes if n.id != node_id]
-                        failed.extend(node_shards)
+                except ConnectionError:
+                    nodes = [n for n in nodes if n.id != node_id]
+                    failed.extend(node_shards)
+            else:
+                with ThreadPoolExecutor(max_workers=len(groups)) as pool:
+                    for node_id, node_shards in groups.items():
+                        if node_id == self.local_id:
+                            fut = pool.submit(run_local, node_shards)
+                        else:
+                            fut = pool.submit(run_remote, node_id, node_shards)
+                        tasks.append((node_id, node_shards, fut))
+                    for node_id, node_shards, fut in tasks:
+                        try:
+                            acc = fut.result()
+                        except ConnectionError:
+                            # Failover: drop the node, re-map its shards
+                            # onto replicas (executor.go:2492-2503).
+                            nodes = [n for n in nodes if n.id != node_id]
+                            failed.extend(node_shards)
+                            continue
+                        result = acc if result is None else \
+                            reduce_fn(result, acc)
             pending = failed
         return result
 

@@ -22,8 +22,12 @@ ATTR_BLOCK_SIZE = 100
 class AttrStore:
     """id -> {attr: value} with checksummed blocks for replica diffing."""
 
-    def __init__(self, path: str | None = None):
+    def __init__(self, path: str | None = None, epoch=None):
         self.path = path
+        #: index mutation epoch (core.index.Epoch): attr writes change
+        #: query results (Row attrs, TopN attr filters), so they must
+        #: invalidate epoch-stamped result caches too.
+        self.epoch = epoch
         self._attrs: dict[int, dict[str, Any]] = {}
         self._lock = threading.RLock()
         if path and os.path.exists(path):
@@ -46,6 +50,8 @@ class AttrStore:
                     cur[k] = v
             if not cur:
                 del self._attrs[id_]
+            if self.epoch is not None:
+                self.epoch.bump()
 
     def set_bulk_attrs(self, attrs_by_id: dict[int, dict[str, Any]]) -> None:
         with self._lock:

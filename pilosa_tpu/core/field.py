@@ -25,6 +25,7 @@ from pilosa_tpu.config import (
     DEFAULT_CACHE_SIZE,
     EXISTENCE_FIELD_NAME,
     SHARD_WIDTH,
+    WORDS_PER_SHARD,
 )
 from pilosa_tpu.core import timequantum as tq
 from pilosa_tpu.core.attrs import AttrStore
@@ -177,7 +178,7 @@ class Field:
     def __init__(self, index: str, name: str, options: FieldOptions | None = None,
                  stats=None, row_attr_store: AttrStore | None = None,
                  translate_store: TranslateStore | None = None,
-                 fragment_listener=None, op_writer_factory=None):
+                 fragment_listener=None, op_writer_factory=None, epoch=None):
         # The internal existence field is the one reserved name allowed to
         # bypass validation (reference index.go:336 createFieldIfNotExists).
         if name != EXISTENCE_FIELD_NAME:
@@ -187,7 +188,10 @@ class Field:
         self.options = options or FieldOptions()
         self._validate_options()
         self.stats = stats
-        self.row_attr_store = row_attr_store or AttrStore()
+        #: index-level mutation epoch (core.index.Epoch), threaded down to
+        #: fragments so any mutation invalidates epoch-stamped caches.
+        self.epoch = epoch
+        self.row_attr_store = row_attr_store or AttrStore(epoch=epoch)
         self.translate_store = translate_store or TranslateStore()
         self.fragment_listener = fragment_listener
         self.op_writer_factory = op_writer_factory
@@ -254,7 +258,8 @@ class Field:
                          cache_size=self.options.cache_size,
                          mutex=self.uses_mutex(), stats=self.stats,
                          fragment_listener=self.fragment_listener,
-                         op_writer_factory=self.op_writer_factory)
+                         op_writer_factory=self.op_writer_factory,
+                         epoch=self.epoch)
                 self.views[name] = v
             return v
 
@@ -483,33 +488,101 @@ class Field:
 
     def _import_view_bits(self, view_name: str | None, row_ids: np.ndarray,
                           column_ids: np.ndarray, clear: bool) -> None:
-        """Vectorized by-shard scatter of one view's bit batch."""
+        """Vectorized by-shard scatter of one view's bit batch.
+
+        Throughput notes (this is the 100M-bit bulk path, reference
+        bulkImport fragment.go:1997): all math runs in int64/int32 —
+        numpy's uint64 divide/compare are scalar-loop slow — the shard
+        split is ONE stable integer argsort (radix for int keys), and
+        each shard slice is handed pre-sorted to the fragment so no
+        downstream re-sort or re-unique happens."""
         if view_name is None or len(row_ids) == 0:
             return
         view = self.create_view_if_not_exists(view_name)
-        shards = (column_ids // np.uint64(SHARD_WIDTH)).astype(np.int64)
-        order = np.argsort(shards, kind="stable")
+        if (not clear and not self.uses_mutex() and len(row_ids) >= 65536
+                and self._scatter_import(view, row_ids, column_ids)):
+            return
+        cols = column_ids.astype(np.int64, copy=False)
+        rows = row_ids.astype(np.int64, copy=False)
+        exp = SHARD_WIDTH.bit_length() - 1
+        shards = (cols >> exp).astype(np.int32)
+        local = (cols & (SHARD_WIDTH - 1)).astype(np.uint32)
+        order = np.argsort(shards, kind="stable")  # radix on int32
         shards = shards[order]
-        row_ids = row_ids[order]
-        column_ids = column_ids[order]
-        uniq, starts = np.unique(shards, return_index=True)
-        bounds = np.append(starts, len(shards))
-        for i, shard in enumerate(uniq.tolist()):
+        rows = rows[order]
+        local = local[order]
+        cut = np.flatnonzero(shards[1:] != shards[:-1]) + 1
+        bounds = np.concatenate(([0], cut, [len(shards)]))
+        for i in range(len(bounds) - 1):
             lo, hi = int(bounds[i]), int(bounds[i + 1])
-            frag = view.create_fragment_if_not_exists(int(shard))
+            frag = view.create_fragment_if_not_exists(int(shards[lo]))
+            seg_rows, seg_local = rows[lo:hi], local[lo:hi]
             if self.uses_mutex() and not clear:
-                frag.bulk_import_mutex(row_ids[lo:hi].tolist(),
-                                       column_ids[lo:hi].tolist())
+                # Mutex semantics are last-write-per-column: keep BATCH
+                # order (the stable shard sort preserved it) — sorting
+                # here would silently rewrite which row wins.
+                base = np.uint64(int(shards[lo]) * SHARD_WIDTH)
+                frag.bulk_import_mutex(
+                    seg_rows.tolist(),
+                    (seg_local.astype(np.uint64) + base).tolist())
             else:
-                frag.bulk_import(row_ids[lo:hi], column_ids[lo:hi],
-                                 clear=clear)
+                # (row, pos) sort of the small per-shard slice.
+                sub = np.lexsort((seg_local, seg_rows))
+                frag.bulk_import_sorted_local(seg_rows[sub], seg_local[sub],
+                                              clear=clear)
+
+    #: heavy-row scatter import applies when the batch has at most this
+    #: many distinct rows (each row costs one O(n) mask + one scatter).
+    _SCATTER_MAX_ROWS = 8
+    #: refuse to allocate more than this much dense block buffer per row.
+    _SCATTER_MAX_BYTES = 1 << 30
+
+    def _scatter_import(self, view, row_ids: np.ndarray,
+                        column_ids: np.ndarray) -> bool:
+        """Sort-free bulk import for batches dominated by few rows (the
+        realistic bulk-load shape and the reference's import benchmarks):
+        one native O(n) pass scatters each row's columns straight into
+        dense per-shard blocks, which the fragments adopt or OR in.
+        Returns False (untouched state) when the shape doesn't fit —
+        many distinct rows, huge shard span, or no native lib."""
+        from pilosa_tpu import native
+        if not native.available():
+            return False
+        rows = row_ids
+        distinct = np.unique(rows[:4096])
+        if len(distinct) > self._SCATTER_MAX_ROWS:
+            return False
+        masks = [rows == rid for rid in distinct.tolist()]
+        covered = masks[0].sum()
+        for m in masks[1:]:
+            covered += m.sum()
+        if int(covered) != len(rows):  # sample missed rows: bail
+            return False
+        exp = SHARD_WIDTH.bit_length() - 1
+        n_shards = (int(column_ids.max()) >> exp) + 1
+        if n_shards * WORDS_PER_SHARD * 4 > self._SCATTER_MAX_BYTES:
+            return False
+        for rid, mask in zip(distinct.tolist(), masks):
+            out = native.scatter_row_blocks(
+                column_ids[mask] if len(masks) > 1 else column_ids,
+                exp, n_shards, WORDS_PER_SHARD)
+            if out is None:
+                return False
+            blocks, touched = out
+            for shard in np.flatnonzero(touched).tolist():
+                frag = view.create_fragment_if_not_exists(int(shard))
+                # Copy the row out of the big buffer so an adopted dense
+                # block never pins all shards' blocks via the base array.
+                frag.merge_row_words(int(rid), blocks[shard].copy())
+        return True
 
     def import_values(self, column_ids, values, clear: bool = False) -> None:
         """Reference importValue (field.go:1285): validates range, grows
         bit depth once for the batch."""
         bsig = self._require_bsi()
-        if not clear:
-            lo, hi = min(values), max(values)
+        values_arr = np.asarray(values, dtype=np.int64)
+        if not clear and len(values_arr):
+            lo, hi = int(values_arr.min()), int(values_arr.max())
             if lo < bsig.min:
                 raise BSIGroupValueTooLowError()
             if hi > bsig.max:
@@ -520,14 +593,60 @@ class Field:
                 bsig.bit_depth = required
                 self.options.bit_depth = required
         view = self.create_view_if_not_exists(view_bsi_name(self.name))
-        by_shard: dict[int, tuple[list, list]] = {}
-        for cid, val in zip(column_ids, values):
-            c, v_ = by_shard.setdefault(int(cid) // SHARD_WIDTH, ([], []))
-            c.append(int(cid))
-            v_.append(int(val) - bsig.base)
-        for shard, (cids, vals) in by_shard.items():
-            frag = view.create_fragment_if_not_exists(shard)
-            frag.import_values(cids, vals, bsig.bit_depth, clear=clear)
+        cols = np.asarray(column_ids, dtype=np.int64)
+        if len(cols) == 0:
+            return
+        vals = values_arr - bsig.base
+        if (not clear and len(cols) >= 65536
+                and self._scatter_import_values(view, cols, vals, bsig)):
+            return
+        exp = SHARD_WIDTH.bit_length() - 1
+        shards = (cols >> exp).astype(np.int32)
+        order = np.argsort(shards, kind="stable")  # radix on int32
+        cols, vals, shards = cols[order], vals[order], shards[order]
+        cut = np.flatnonzero(shards[1:] != shards[:-1]) + 1
+        bounds = np.concatenate(([0], cut, [len(shards)]))
+        for i in range(len(bounds) - 1):
+            lo, hi = int(bounds[i]), int(bounds[i + 1])
+            frag = view.create_fragment_if_not_exists(int(shards[lo]))
+            frag.import_values(cols[lo:hi], vals[lo:hi], bsig.bit_depth,
+                               clear=clear)
+
+    def _scatter_import_values(self, view, cols: np.ndarray,
+                               vals: np.ndarray, bsig) -> bool:
+        """Sort-free BSI bulk import: one native pass decomposes
+        (column, value) pairs into all bit-plane blocks at once. Only
+        applies to a FRESH view (no existing values anywhere), where
+        last-write-wins needs no plane clears — the bulk-load case. The
+        exact overwrite path below handles everything else."""
+        from pilosa_tpu import native
+        from pilosa_tpu.core.fragment import BSI_OFFSET_BIT, BSI_SIGN_BIT
+        if not native.available():
+            return False
+        if any(frag.rows for frag in view.fragments.values()):
+            return False
+        exp = SHARD_WIDTH.bit_length() - 1
+        n_shards = (int(cols.max()) >> exp) + 1
+        depth = bsig.bit_depth
+        if n_shards * (depth + 2) * WORDS_PER_SHARD * 4 > (1 << 30):
+            return False
+        # Last-write-wins for duplicated columns happens inside the
+        # native pass (the exists plane is the seen-set on a fresh view).
+        out = native.scatter_bsi_blocks(cols.astype(np.uint64), vals,
+                                        exp, depth, n_shards,
+                                        WORDS_PER_SHARD)
+        if out is None:
+            return False
+        blocks, touched = out
+        for shard in np.flatnonzero(touched).tolist():
+            frag = view.create_fragment_if_not_exists(int(shard))
+            for r in range(depth + 2):
+                # Per-shard plane order: exists, sign, magnitude planes
+                # (BSI row ids 0, 1, 2+i — fragment.go:87-93).
+                row_id = r if r < 2 else BSI_OFFSET_BIT + (r - 2)
+                assert BSI_SIGN_BIT == 1
+                frag.merge_row_words(row_id, blocks[shard][r].copy())
+        return True
 
     def import_roaring(self, shard: int, data: bytes, view: str = VIEW_STANDARD,
                        clear: bool = False) -> int:

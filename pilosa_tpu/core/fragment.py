@@ -69,7 +69,7 @@ class Fragment:
     def __init__(self, index: str, field: str, view: str, shard: int,
                  cache_type: str = "ranked", cache_size: int = DEFAULT_CACHE_SIZE,
                  stats=None, op_writer: Callable | None = None,
-                 mutex: bool = False):
+                 mutex: bool = False, epoch=None):
         self.index = index
         self.field = field
         self.view = view
@@ -82,6 +82,10 @@ class Fragment:
         #: Mutex semantics: at most one row bit per column (reference
         #: mutexVector fragment.go:3094; bool fields use rows 0/1).
         self.mutex = mutex
+        #: index-level Epoch (core.index): bumped on every mutation so
+        #: index-wide caches (planner leaf stacks, executor results)
+        #: validate in O(1) instead of per-fragment generation walks.
+        self.epoch = epoch
 
         self.rows: dict[int, HostRow] = {}
         self.generation = 0
@@ -113,6 +117,8 @@ class Fragment:
 
     def _invalidate(self):
         self.generation += 1
+        if self.epoch is not None:
+            self.epoch.bump()
         # Stale device blocks would never be re-hit (generation mismatch) but
         # would pin HBM forever; drop them eagerly.
         self._dev_rows.clear()
@@ -228,6 +234,80 @@ class Fragment:
                 if self.op_writer:
                     self.op_writer("removeBatch" if clear else "addBatch",
                                    row_ids.tolist(), column_ids.tolist())
+            return changed
+
+    def bulk_import_sorted_local(self, row_ids: np.ndarray,
+                                 local: np.ndarray, clear: bool = False) -> int:
+        """Bulk set/clear of shard-relative positions PRE-SORTED by
+        (row, pos) — the no-copy core of the import path (reference
+        importPositions fragment.go:2053). Boundary-scans row groups,
+        dedupes each group's sorted positions with one diff pass, and
+        hands them to HostRow without any further sort."""
+        with self._lock:
+            n = len(row_ids)
+            if n == 0:
+                return 0
+            row_ids = np.asarray(row_ids, dtype=np.int64)
+            local = np.asarray(local, dtype=np.uint32)
+            cut = np.flatnonzero(row_ids[1:] != row_ids[:-1]) + 1
+            bounds = np.concatenate(([0], cut, [n]))
+            changed = 0
+            for i in range(len(bounds) - 1):
+                lo, hi = int(bounds[i]), int(bounds[i + 1])
+                rid = int(row_ids[lo])
+                seg = local[lo:hi]
+                if hi - lo > 1:  # drop duplicate positions (sorted input)
+                    keep = np.empty(hi - lo, dtype=bool)
+                    keep[0] = True
+                    np.not_equal(seg[1:], seg[:-1], out=keep[1:])
+                    if not keep.all():
+                        seg = seg[keep]
+                hr = self.rows.get(rid)
+                if hr is None:
+                    if clear:
+                        continue
+                    hr = self.rows[rid] = HostRow()
+                if clear:
+                    changed += hr.remove_many_sorted_unique(seg)
+                else:
+                    changed += hr.add_many_sorted_unique(seg)
+            if changed:
+                self._col_row = None
+                self._invalidate()
+                if self.op_writer:
+                    base = np.uint64(self.shard * SHARD_WIDTH)
+                    self.op_writer("removeBatch" if clear else "addBatch",
+                                   row_ids.astype(np.uint64),
+                                   local.astype(np.uint64) + base)
+            return changed
+
+    def merge_row_words(self, row_id: int, words: np.ndarray,
+                        bit_count: int | None = None) -> int:
+        """Merge a freshly-scattered dense word block into one row — the
+        landing half of the native bulk-import scatter (reference
+        importRoaringBits' container merge, roaring.go:1511). ``words``
+        ownership transfers to the fragment; returns bits added."""
+        from pilosa_tpu import native
+        with self._lock:
+            if bit_count is None:
+                bit_count = native.popcount_words(words)
+            if bit_count == 0:
+                return 0
+            hr = self.rows.get(row_id)
+            if hr is None or hr.n == 0:
+                self.rows[row_id] = HostRow.adopt_words(words, bit_count)
+                changed = bit_count
+            else:
+                changed = hr.merge_words(words)
+            if changed:
+                self._col_row = None
+                self._invalidate()
+                if self.op_writer:
+                    pos = native.words_to_positions(words)
+                    base = np.uint64(self.shard * SHARD_WIDTH)
+                    self.op_writer("addBatch",
+                                   np.full(len(pos), row_id, dtype=np.uint64),
+                                   pos + base)
             return changed
 
     def bulk_import_mutex(self, row_ids, column_ids) -> int:
@@ -546,18 +626,23 @@ class Fragment:
         """Batched BSI write (reference importValue fragment.go:2205),
         vectorized by bit plane: the batch becomes ONE bulk clear + ONE
         bulk set across the exists/sign/magnitude rows instead of
-        per-column per-bit writes (which made 10k-value imports take
-        seconds). Last write per column wins, like sequential writes."""
-        cols = np.asarray(column_ids, dtype=np.uint64)
+        per-column per-bit writes. Plane batches are assembled as
+        (plane-row, local-pos) arrays, lexsorted once, and fed through
+        the pre-sorted bulk path. Last write per column wins, like
+        sequential writes."""
+        cols = np.asarray(column_ids, dtype=np.int64)
         if len(cols) == 0:
             return
+        local_all = (cols & (SHARD_WIDTH - 1)).astype(np.uint32)
         if clear:
-            self.bulk_import([BSI_EXISTS_BIT] * len(cols), cols.tolist(),
-                             clear=True)
+            o = np.argsort(local_all, kind="stable")
+            self.bulk_import_sorted_local(
+                np.full(len(cols), BSI_EXISTS_BIT, dtype=np.int64),
+                local_all[o], clear=True)
             return
         vals = np.asarray(values, dtype=np.int64)
         # Keep the LAST occurrence of each duplicated column.
-        cols_u, idx = np.unique(cols[::-1], return_index=True)
+        local_u, idx = np.unique(local_all[::-1], return_index=True)
         vals_u = vals[::-1][idx]
         neg = vals_u < 0
         mag = np.abs(vals_u).astype(np.uint64)
@@ -568,10 +653,10 @@ class Fragment:
         def _add(bucket_r, bucket_c, row_id, mask):
             n = int(mask.sum())
             if n:
-                bucket_r.append(np.full(n, row_id, dtype=np.uint64))
-                bucket_c.append(cols_u[mask])
+                bucket_r.append(np.full(n, row_id, dtype=np.int64))
+                bucket_c.append(local_u[mask])
 
-        all_mask = np.ones(len(cols_u), dtype=bool)
+        all_mask = np.ones(len(local_u), dtype=bool)
         _add(set_rows, set_cols, BSI_EXISTS_BIT, all_mask)
         _add(set_rows, set_cols, BSI_SIGN_BIT, neg)
         _add(clr_rows, clr_cols, BSI_SIGN_BIT, ~neg)
@@ -580,14 +665,19 @@ class Fragment:
             _add(set_rows, set_cols, BSI_OFFSET_BIT + i, on)
             _add(clr_rows, clr_cols, BSI_OFFSET_BIT + i, ~on)
 
+        def _run(rows_list, cols_list, clear_flag):
+            if not rows_list:
+                return
+            rows = np.concatenate(rows_list)
+            local = np.concatenate(cols_list)
+            # Plane buckets are emitted row-ascending with sorted
+            # positions inside each (local_u is sorted), so the pairs
+            # are already (row, pos)-sorted — no lexsort needed.
+            self.bulk_import_sorted_local(rows, local, clear=clear_flag)
+
         with self._lock:  # one atomic overwrite, clears before sets
-            if clr_rows:
-                self.bulk_import(np.concatenate(clr_rows).tolist(),
-                                 np.concatenate(clr_cols).tolist(),
-                                 clear=True)
-            if set_rows:
-                self.bulk_import(np.concatenate(set_rows).tolist(),
-                                 np.concatenate(set_cols).tolist())
+            _run(clr_rows, clr_cols, True)
+            _run(set_rows, set_cols, False)
 
     def _filter_seg(self, filter_row: Row | None) -> jax.Array:
         if filter_row is None:

@@ -125,6 +125,63 @@ class HostRow:
         self._maybe_densify()
         return changed
 
+    def add_many_sorted_unique(self, positions: np.ndarray) -> int:
+        """add_many for input already sorted and deduplicated (the bulk
+        import path): skips the O(n log n) re-unique, takes a direct
+        assignment when the row is empty, and counts changed bits from
+        touched words only instead of re-popcounting the whole block."""
+        self._flush()
+        n_new = len(positions)
+        if n_new == 0:
+            return 0
+        pos64 = positions.astype(np.uint64)
+        if self.dense is None:
+            if self.n == 0:
+                self.positions = pos64
+                self.n = n_new
+                self._maybe_densify()
+                return n_new
+            if n_new + len(self.positions) <= DENSE_CUTOFF:
+                merged = np.union1d(self.positions, pos64)
+                changed = len(merged) - len(self.positions)
+                self.positions = merged
+                self.n = len(merged)
+                return changed
+            self.dense = bitops.positions_to_words(self.positions)
+            self.positions = None
+        word_idx = (pos64 >> np.uint64(5)).astype(np.int64)
+        bit = np.left_shift(np.uint32(1),
+                            (pos64 & np.uint64(31)).astype(np.uint32))
+        touched = np.unique(word_idx)  # sorted input -> cheap
+        before = bitops.np_count(self.dense[touched])
+        np.bitwise_or.at(self.dense, word_idx, bit)
+        after = bitops.np_count(self.dense[touched])
+        self.n += after - before
+        return after - before
+
+    def remove_many_sorted_unique(self, positions: np.ndarray) -> int:
+        """remove_many for sorted-unique input; same savings as the add
+        twin."""
+        self._flush()
+        if len(positions) == 0:
+            return 0
+        pos64 = positions.astype(np.uint64)
+        if self.dense is not None:
+            word_idx = (pos64 >> np.uint64(5)).astype(np.int64)
+            bit = np.left_shift(np.uint32(1),
+                                (pos64 & np.uint64(31)).astype(np.uint32))
+            touched = np.unique(word_idx)
+            before = bitops.np_count(self.dense[touched])
+            np.bitwise_and.at(self.dense, word_idx, ~bit)
+            after = bitops.np_count(self.dense[touched])
+            self.n += after - before
+            return before - after
+        kept = np.setdiff1d(self.positions, pos64, assume_unique=True)
+        removed = len(self.positions) - len(kept)
+        self.positions = kept
+        self.n = len(kept)
+        return removed
+
     def remove_many(self, positions: np.ndarray) -> int:
         self._flush()
         positions = np.unique(np.asarray(positions, dtype=np.uint64))
@@ -189,6 +246,45 @@ class HostRow:
         else:
             r.positions = positions
         r.n = len(positions)
+        return r
+
+    def merge_words(self, words: np.ndarray) -> int:
+        """OR a dense word block into this row; returns bits added. The
+        scatter-import path's merge step (its blocks arrive unsorted and
+        whole, so position-level merging would just re-derive this)."""
+        from pilosa_tpu import native
+        self._flush()
+        base = self.dense if self.dense is not None \
+            else bitops.positions_to_words(self.positions)
+        merged = np.bitwise_or(base, words)
+        n = native.popcount_words(merged)
+        # The bulk paths keep moderately-sparse rows dense (half the
+        # usual cutoff): below DENSE_CUTOFF the position form saves
+        # little memory and the conversion walk dominates import time.
+        if n > DENSE_CUTOFF // 2:
+            self.dense = merged
+            self.positions = None
+        else:
+            self.positions = native.words_to_positions(merged)
+            self.dense = None
+        added = n - self.n
+        self.n = n
+        return added
+
+    @classmethod
+    def adopt_words(cls, words: np.ndarray, n: int | None = None) -> "HostRow":
+        """Build a row AROUND a freshly-scattered dense block (caller
+        relinquishes ownership — no copy for dense rows)."""
+        from pilosa_tpu import native
+        r = cls()
+        if n is None:
+            n = native.popcount_words(words)
+        if n > DENSE_CUTOFF // 2:  # see merge_words on the lower bar
+            r.dense = words
+            r.positions = None
+        else:
+            r.positions = native.words_to_positions(words)
+        r.n = n
         return r
 
     @classmethod

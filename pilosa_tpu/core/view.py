@@ -33,7 +33,7 @@ class View:
                  cache_type: str = "ranked", cache_size: int = DEFAULT_CACHE_SIZE,
                  mutex: bool = False, stats=None,
                  fragment_listener: Callable | None = None,
-                 op_writer_factory: Callable | None = None):
+                 op_writer_factory: Callable | None = None, epoch=None):
         self.index = index
         self.field = field
         self.name = name
@@ -41,6 +41,7 @@ class View:
         self.cache_size = cache_size
         self.mutex = mutex
         self.stats = stats
+        self.epoch = epoch
         #: called with (index, field, view, shard) when a fragment appears —
         #: the hook the reference uses to broadcast CreateShardMessage
         #: (view.go:263-304).
@@ -66,7 +67,7 @@ class View:
                                 cache_type=self.cache_type,
                                 cache_size=self.cache_size,
                                 stats=self.stats, op_writer=op_writer,
-                                mutex=self.mutex)
+                                mutex=self.mutex, epoch=self.epoch)
                 self.fragments[shard] = frag
                 if self.fragment_listener:
                     self.fragment_listener(self.index, self.field, self.name, shard)

@@ -20,6 +20,9 @@ TPU-first departures (same semantics, different math):
 
 from __future__ import annotations
 
+import threading
+from collections import OrderedDict
+from concurrent.futures import Future
 from dataclasses import dataclass, field as dc_field, replace
 from typing import Any, Callable, Iterable
 
@@ -60,6 +63,11 @@ DEFAULT_MIN_THRESHOLD = 1
 _BITMAP_CALLS = frozenset(
     {"Row", "Range", "Difference", "Intersect", "Union", "Xor", "Not", "Shift"})
 
+#: Calls that mutate state — queries containing any of these are never
+#: served from (or stored into) the result cache.
+_WRITE_CALLS = frozenset(
+    {"Set", "Clear", "ClearRow", "Store", "SetRowAttrs", "SetColumnAttrs"})
+
 
 @dataclass
 class ExecOptions:
@@ -75,8 +83,12 @@ class ExecOptions:
 class Executor:
     """Reference executor (executor.go:72)."""
 
+    #: bounded sizes for the per-executor caches.
+    PARSE_CACHE_SIZE = 512
+    RESULT_CACHE_SIZE = 256
+
     def __init__(self, holder: Holder, cluster=None, node_id: str | None = None,
-                 planner=None, stats=None):
+                 planner=None, stats=None, result_cache: bool = True):
         self.holder = holder
         #: cluster hooks (pilosa_tpu.cluster); None = standalone node.
         self.cluster = cluster
@@ -89,6 +101,20 @@ class Executor:
         self.translator = None
         from pilosa_tpu.obs import NopStats
         self.stats = stats or NopStats()
+        #: query-string -> parsed Query. Parsed trees are shared across
+        #: threads; every consumer clones before mutating
+        #: (_translate_call clones; Options copies opt).
+        self._parse_cache: "OrderedDict[str, Query]" = OrderedDict()
+        #: (index, query, shards, remote) -> (epoch, results). Validated
+        #: against the index mutation epoch, so any write anywhere in the
+        #: index invalidates every cached result for it. The reference's
+        #: analog is the per-fragment rowCache (fragment.go:623); caching
+        #: whole read-only results is the system answer to a device link
+        #: whose per-sync latency dwarfs compute.
+        self._result_cache: "OrderedDict[tuple, tuple[int, list]]" = \
+            OrderedDict()
+        self.result_cache_enabled = result_cache
+        self._cache_lock = threading.Lock()
 
     def _planner_for(self, c: Call, opt: "ExecOptions"):
         if self.planner is None:
@@ -101,10 +127,16 @@ class Executor:
 
     def execute(self, index_name: str, query: Query | str,
                 shards: Iterable[int] | None = None,
-                opt: ExecOptions | None = None) -> list[Any]:
-        """Reference executor.Execute (executor.go:113)."""
-        if isinstance(query, str):
-            query = parse(query)
+                opt: ExecOptions | None = None,
+                cache: bool = True) -> list[Any]:
+        """Reference executor.Execute (executor.go:113).
+
+        ``cache=False`` bypasses the result cache (reads and writes of
+        it) for this call — used by benchmarks to measure the cold path.
+        """
+        raw = query if isinstance(query, str) else None
+        if raw is not None:
+            query = self._parse_cached(raw)
         opt = opt or ExecOptions()
         idx = self.holder.index(index_name)
         if idx is None:
@@ -116,18 +148,139 @@ class Executor:
             shards = sorted(idx.available_shards())
         shards = list(shards) if shards is not None else []
 
+        cacheable = (cache and self.result_cache_enabled and raw is not None
+                     and not any(c.name in _WRITE_CALLS for c in query.calls))
+        if cacheable:
+            key = self._cache_key(idx, raw, shards, opt)
+            epoch = idx.epoch.value
+            hit = self._cache_get(key, epoch)
+            if hit is not None:
+                return hit
+
         # Key translation happens on the coordinator only; forwarded
         # (remote) queries already carry ids and must return raw internal
         # results so the coordinator can merge them (executor.go:113-160).
         results = []
         for call in query.calls:
             if not opt.remote:
-                call = self._translate_call(idx, call)
+                call = self._translate_call(idx, call)  # clones
+            else:
+                # The parse cache shares trees across queries/threads and
+                # some handlers annotate args in place; never hand them
+                # the shared copy.
+                call = call.clone()
             results.append(self._execute_call(idx, call, shards, opt))
-        if opt.remote:
-            return results
-        return [self._translate_result(idx, c, r)
-                for c, r in zip(query.calls, results)]
+        if not opt.remote:
+            results = [self._translate_result(idx, c, r)
+                       for c, r in zip(query.calls, results)]
+        if cacheable:
+            self._cache_store(key, epoch, results)
+        return results
+
+    def _cache_key(self, idx: Index, raw: str, shards: list[int],
+                   opt: ExecOptions) -> tuple:
+        return (idx.name, idx.instance_id, raw, tuple(shards), opt.remote,
+                opt.exclude_row_attrs, opt.exclude_columns, opt.column_attrs)
+
+    def _cache_get(self, key: tuple, epoch: int) -> list | None:
+        with self._cache_lock:
+            hit = self._result_cache.get(key)
+            if hit is not None and hit[0] == epoch:
+                self._result_cache.move_to_end(key)
+                return list(hit[1])
+        return None
+
+    def _cache_store(self, key: tuple, epoch: int, results: list) -> None:
+        # Stamp with the epoch read BEFORE execution: if a write landed
+        # mid-query the stamp is stale and the entry dies on its first
+        # lookup (never serves post-write state as fresh; may
+        # conservatively recompute).
+        with self._cache_lock:
+            self._result_cache[key] = (epoch, list(results))
+            self._result_cache.move_to_end(key)
+            while len(self._result_cache) > self.RESULT_CACHE_SIZE:
+                self._result_cache.popitem(last=False)
+
+    def execute_async(self, index_name: str, query: Query | str,
+                      shards: Iterable[int] | None = None,
+                      opt: ExecOptions | None = None,
+                      cache: bool = True) -> "Future[list[Any]]":
+        """Non-blocking submission; resolves to ``execute(...)``'s list.
+
+        Single plannable ``Count(...)`` queries on a standalone node
+        dispatch their device program immediately and resolve when their
+        TransferBatcher wave lands — so ONE submitting thread can keep
+        hundreds of queries in flight over the device link. Anything else
+        (writes, cluster fan-out, host-side calls) executes synchronously
+        before the future resolves, which keeps the API uniform.
+        """
+        fut: Future = Future()
+        opt = opt or ExecOptions()
+        raw = query if isinstance(query, str) else None
+        fast = None
+        if (self.cluster is None and self.planner is not None
+                and not opt.remote):
+            q = self._parse_cached(raw) if raw is not None else query
+            if (len(q.calls) == 1 and q.calls[0].name == "Count"
+                    and len(q.calls[0].children) == 1):
+                idx = self.holder.index(index_name)
+                if idx is not None and self.planner.supports(
+                        q.calls[0].children[0]):
+                    fast = (q, idx)
+        if fast is None:
+            try:
+                fut.set_result(self.execute(index_name, query, shards, opt,
+                                            cache=cache))
+            except Exception as e:
+                fut.set_exception(e)
+            return fut
+
+        q, idx = fast
+        try:
+            shards = (sorted(idx.available_shards()) if shards is None
+                      else list(shards))
+            cacheable = (cache and self.result_cache_enabled
+                         and raw is not None)
+            key = epoch = None
+            if cacheable:
+                key = self._cache_key(idx, raw, shards, opt)
+                epoch = idx.epoch.value
+                hit = self._cache_get(key, epoch)
+                if hit is not None:
+                    fut.set_result(hit)
+                    return fut
+            call = self._translate_call(idx, q.calls[0])
+            inner = self.planner.execute_count_async(
+                idx, call.children[0], shards)
+        except Exception as e:
+            fut.set_exception(e)
+            return fut
+
+        def _done(f):
+            try:
+                results = [f.result()]
+            except Exception as e:
+                fut.set_exception(e)
+                return
+            if cacheable:
+                self._cache_store(key, epoch, results)
+            fut.set_result(results)
+
+        inner.add_done_callback(_done)
+        return fut
+
+    def _parse_cached(self, raw: str) -> Query:
+        with self._cache_lock:
+            q = self._parse_cache.get(raw)
+            if q is not None:
+                self._parse_cache.move_to_end(raw)
+                return q
+        q = parse(raw)
+        with self._cache_lock:
+            self._parse_cache[raw] = q
+            while len(self._parse_cache) > self.PARSE_CACHE_SIZE:
+                self._parse_cache.popitem(last=False)
+        return q
 
     # ------------------------------------------------------------------
     # dispatch (reference executor.go:293-338)

@@ -71,6 +71,21 @@ def _load() -> ctypes.CDLL | None:
         lib.intersection_count_words.argtypes = [
             np.ctypeslib.ndpointer(np.uint32, flags="C"),
             np.ctypeslib.ndpointer(np.uint32, flags="C"), ctypes.c_int64]
+        lib.scatter_row_blocks.restype = None
+        lib.scatter_row_blocks.argtypes = [
+            np.ctypeslib.ndpointer(np.uint64, flags="C"), ctypes.c_int64,
+            ctypes.c_int,
+            np.ctypeslib.ndpointer(np.uint32, flags="C"), ctypes.c_int64,
+            ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.uint8, flags="C")]
+        lib.scatter_bsi_blocks.restype = None
+        lib.scatter_bsi_blocks.argtypes = [
+            np.ctypeslib.ndpointer(np.uint64, flags="C"),
+            np.ctypeslib.ndpointer(np.int64, flags="C"), ctypes.c_int64,
+            ctypes.c_int, ctypes.c_int,
+            np.ctypeslib.ndpointer(np.uint32, flags="C"), ctypes.c_int64,
+            ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.uint8, flags="C")]
         _lib = lib
         return _lib
 
@@ -157,3 +172,41 @@ def intersection_count_words(a: np.ndarray, b: np.ndarray) -> int:
         from pilosa_tpu.ops import bitops
         return bitops.np_count(a & b)
     return int(lib.intersection_count_words(a, b, len(a)))
+
+
+def scatter_row_blocks(cols: np.ndarray, exp: int,
+                       n_shards: int, words_per_shard: int):
+    """Scatter one row's absolute column ids into dense per-shard word
+    blocks in a single unsorted pass. Returns (blocks[n_shards, W],
+    touched[n_shards] bool) or None when the native library is missing
+    (callers fall back to the sorted import path)."""
+    lib = _load()
+    if lib is None:
+        return None
+    cols = np.ascontiguousarray(cols, dtype=np.uint64)
+    blocks = np.zeros((n_shards, words_per_shard), dtype=np.uint32)
+    touched = np.zeros(n_shards, dtype=np.uint8)
+    lib.scatter_row_blocks(cols, len(cols), exp,
+                           blocks.reshape(-1), n_shards, words_per_shard,
+                           touched)
+    return blocks, touched.astype(bool)
+
+
+def scatter_bsi_blocks(cols: np.ndarray, vals: np.ndarray, exp: int,
+                       depth: int, n_shards: int, words_per_shard: int):
+    """Scatter (column, value) pairs into dense BSI bit-plane blocks
+    ([n_shards, depth+2, W]; per-shard rows: exists, sign, planes) in one
+    native pass. Columns must be unique. Returns (blocks, touched) or
+    None when the native library is missing."""
+    lib = _load()
+    if lib is None:
+        return None
+    cols = np.ascontiguousarray(cols, dtype=np.uint64)
+    vals = np.ascontiguousarray(vals, dtype=np.int64)
+    blocks = np.zeros((n_shards, depth + 2, words_per_shard),
+                      dtype=np.uint32)
+    touched = np.zeros(n_shards, dtype=np.uint8)
+    lib.scatter_bsi_blocks(cols, vals, len(cols), exp, depth,
+                           blocks.reshape(-1), n_shards, words_per_shard,
+                           touched)
+    return blocks, touched.astype(bool)

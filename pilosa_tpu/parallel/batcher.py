@@ -1,0 +1,92 @@
+"""TransferBatcher — pipelined device→host result delivery.
+
+Why this exists: on a tunneled TPU (the deployment this framework
+targets: chips reached through a relay/proxy link) a synchronous
+device→host pull costs ~100 ms of link latency no matter how small the
+array, while the device itself can run thousands of query kernels per
+second. The reference never faces this — its kernels run in-process
+(executor.go:2561's worker pool) — so this component has no Go analog;
+it is the TPU-native answer to the same problem the reference solves
+with goroutine pools: keep the compute resource saturated instead of
+stalling on round-trips.
+
+Mechanism: a query submits its (tiny) result array instead of pulling
+it. The submitting thread starts the device→host copy asynchronously
+right away; a resolver thread reads completed copies in FIFO order and
+resolves each query's future. Any number of copies pipeline inside one
+link-latency window, so N concurrent queries cost ~one round-trip of
+latency total instead of N.
+
+Measured on this rig (one v5e behind the relay): a synchronous pull is
+~100-230 ms; hundreds of async-copied results land within ~1-2 round
+trips. Merging results into one stacked array before transfer was tried
+and performs the same — the async copies already coalesce in the link —
+while costing a large XLA compile per wave shape, so this simpler design
+won.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from concurrent.futures import Future
+from typing import Any, Callable
+
+import numpy as np
+
+
+class TransferBatcher:
+    """Pipelines many small device→host pulls behind one resolver."""
+
+    def __init__(self):
+        self._queue: deque = deque()
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._thread: threading.Thread | None = None
+        self._closed = False
+
+    # -- public --------------------------------------------------------
+
+    def submit(self, arr, postproc: Callable[[np.ndarray], Any]) -> "Future[Any]":
+        """Start ``arr``'s async copy and return a future resolving to
+        ``postproc(host_array)``."""
+        fut: Future = Future()
+        try:
+            arr.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass  # non-jax array / backend without async copies
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("TransferBatcher is closed")
+            self._queue.append((arr, fut, postproc))
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, name="transfer-batcher", daemon=True)
+                self._thread.start()
+            self._cv.notify()
+        return fut
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify()
+
+    # -- resolver --------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and not self._closed:
+                    self._cv.wait()
+                if not self._queue and self._closed:
+                    return
+                arr, fut, post = self._queue.popleft()
+            try:
+                host = np.asarray(arr)
+                result = post(host)
+            except Exception as e:
+                if not fut.done():
+                    fut.set_exception(e)
+                continue
+            if not fut.done():
+                fut.set_result(result)

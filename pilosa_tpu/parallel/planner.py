@@ -22,6 +22,7 @@ repeated queries re-upload nothing.
 from __future__ import annotations
 
 import functools
+import threading
 from collections import OrderedDict
 from typing import Any, Callable
 
@@ -40,6 +41,7 @@ from pilosa_tpu.errors import (
     QueryError,
 )
 from pilosa_tpu.ops import bitops, bsi as bsi_ops
+from pilosa_tpu.parallel.batcher import TransferBatcher
 from pilosa_tpu.parallel.mesh import (
     SHARD_AXIS,
     make_mesh,
@@ -65,13 +67,24 @@ class MeshPlanner:
         self.mesh = mesh if mesh is not None else make_mesh()
         self.n_devices = int(np.prod(self.mesh.devices.shape))
         #: LRU of (index, field, view, row_id, shards) ->
-        #: (gens, [S, W] device array); bounded by max_cache_bytes.
-        self._stack_cache: "OrderedDict[tuple, tuple[tuple, jax.Array]]" = \
+        #: (epoch, gens, [S, W] device array); bounded by max_cache_bytes.
+        #: Epoch-stamped: a hit is ONE integer compare against the index's
+        #: mutation epoch; only an epoch change triggers the per-fragment
+        #: generation walk (and only for the touched leaf). This replaces
+        #: r2's per-query walk of every fragment per leaf.
+        self._stack_cache: "OrderedDict[tuple, tuple[int, tuple, jax.Array]]" = \
             OrderedDict()
         self._cache_bytes = 0
         self.max_cache_bytes = max_cache_bytes
+        #: guards _stack_cache/_cache_bytes — one planner serves every
+        #: thread of the HTTP server.
+        self._cache_lock = threading.Lock()
         #: structural signature -> jitted tree evaluator
         self._fn_cache: dict[tuple, Callable] = {}
+        #: cross-query transfer coalescing (parallel.batcher): every
+        #: Count pull goes through it, so concurrent queries share one
+        #: stacked device->host transfer per wave.
+        self.batcher = TransferBatcher()
 
     # ------------------------------------------------------------------
     # public API
@@ -91,21 +104,33 @@ class MeshPlanner:
         return all(self.supports(ch) for ch in c.children)
 
     def execute_count(self, idx: Index, c: Call, shards: list[int]) -> int:
-        """Count(tree) as one device program with ICI all-reduce."""
+        """Count(tree) as one device program with ICI all-reduce; the
+        result transfer rides the shared batcher wave."""
+        return self.execute_count_async(idx, c, shards).result()
+
+    def execute_count_async(self, idx: Index, c: Call, shards: list[int]):
+        """Dispatch Count(tree) and return a Future[int]. The device
+        program is enqueued immediately; the per-shard popcounts are
+        pulled through the TransferBatcher, so any number of concurrent
+        counts share one stacked device->host transfer per wave (the
+        tunnel's per-pull latency is ~100 ms — see parallel.batcher)."""
+        from concurrent.futures import Future
         if not shards:
-            return 0
-        self._index_name = idx.name
+            fut: Future = Future()
+            fut.set_result(0)
+            return fut
         leaves: list[tuple] = []
         sig = self._signature(idx, c, leaves)
         arrays = [self._fetch_leaf(idx, leaf, tuple(shards)) for leaf in leaves]
         fn = self._compiled(("count",) + sig, c, idx, reduce="per_shard")
+        out = fn(*arrays)
         # Per-shard int32 popcounts (≤2^20 each) summed in Python ints —
         # immune to int32 overflow past ~2k full shards.
-        return int(np.asarray(fn(*arrays), dtype=np.int64).sum())
+        return self.batcher.submit(
+            out, lambda host: int(host.astype(np.int64).sum()))
 
     def _tree_stack(self, idx: Index, c: Call, shards: list[int]) -> jax.Array:
         """Evaluate a bitmap tree to its stacked [S_pad, W] device array."""
-        self._index_name = idx.name
         leaves: list[tuple] = []
         sig = self._signature(idx, c, leaves)
         arrays = [self._fetch_leaf(idx, leaf, tuple(shards)) for leaf in leaves]
@@ -147,7 +172,6 @@ class MeshPlanner:
         field_name, _ = c.string_arg("field")
         f = idx.field(field_name)
         depth = f.bsi_group.bit_depth
-        self._index_name = idx.name
         exists, sign, bits = self._fetch_leaf(
             idx, ("bsi", field_name, depth), tuple(shards))
         if c.children:
@@ -166,6 +190,10 @@ class MeshPlanner:
             return 0, 0
         _, exists, sign, stack, filt, depth = self._bsi_inputs(idx, c, shards)
         cnt, pos, neg = bsi_ops.sum_counts(exists, sign, stack, filt, depth)
+        # Start all three device->host copies before reading any: the
+        # copies pipeline, so total latency is ~one transfer round-trip
+        # instead of three sequential ones (r2's 3x sum latency).
+        _copy_async(cnt, pos, neg)
         count = int(np.asarray(cnt, dtype=np.int64).sum())
         pos = np.asarray(pos, dtype=np.int64).sum(axis=-1)
         neg = np.asarray(neg, dtype=np.int64).sum(axis=-1)
@@ -183,6 +211,9 @@ class MeshPlanner:
         _, exists, sign, stack, filt, depth = self._bsi_inputs(idx, c, shards)
         cons_cnt, alt_cnt, a, b = _agg_min_max(exists, sign, stack, filt,
                                                depth, is_min)
+        # One pipelined transfer wave for all eight outputs (r2 paid ~8
+        # sequential round-trips here: Min was 2.5x slower than Sum).
+        _copy_async(cons_cnt, alt_cnt, *a, *b)
         cons_cnt = np.asarray(cons_cnt)
         alt_cnt = np.asarray(alt_cnt)
         # lo/hi stay scalar when no magnitude bit reached their half
@@ -252,8 +283,9 @@ class MeshPlanner:
         return out
 
     def invalidate(self) -> None:
-        self._stack_cache.clear()
-        self._cache_bytes = 0
+        with self._cache_lock:
+            self._stack_cache.clear()
+            self._cache_bytes = 0
 
     # ------------------------------------------------------------------
     # tree → structural signature + leaf list
@@ -383,44 +415,67 @@ class MeshPlanner:
     def _pad(self, s: int) -> int:
         return pad_to_multiple(s, self.n_devices)
 
-    def _gens(self, field_name: str, view: str, shards: tuple) -> tuple:
+    def _gens(self, index_name: str, field_name: str, view: str,
+              shards: tuple) -> tuple:
         out = []
         for shard in shards:
-            frag = self.holder.fragment(self._index_name, field_name, view, shard)
+            frag = self.holder.fragment(index_name, field_name, view, shard)
             out.append(-1 if frag is None else frag.generation)
         return tuple(out)
 
-    def _stack_rows(self, field_name: str, view: str, row_id: int,
+    def _stack_rows(self, idx: Index, field_name: str, view: str, row_id: int,
                     shards: tuple) -> jax.Array:
         """[S_pad, W] stack of one row across shards, device-put with the
-        shard sharding; cached until any involved fragment mutates."""
-        key = (self._index_name, field_name, view, row_id, shards)
-        gens = self._gens(field_name, view, shards)
-        hit = self._stack_cache.get(key)
-        if hit is not None and hit[0] == gens:
-            self._stack_cache.move_to_end(key)
-            return hit[1]
+        shard sharding; cached until any involved fragment mutates.
+
+        Validation is two-tier: an O(1) index-epoch compare on the hot
+        path, falling back to the per-fragment generation walk only when
+        the epoch moved (a write anywhere in the index) — if the walk
+        shows this leaf's fragments unchanged, the entry is re-stamped
+        instead of re-uploaded."""
+        # instance_id: a deleted-and-recreated index restarts its epoch,
+        # so name alone could serve the old index's stacks as fresh.
+        key = (idx.name, idx.instance_id, field_name, view, row_id, shards)
+        epoch = idx.epoch.value
+        with self._cache_lock:
+            hit = self._stack_cache.get(key)
+            if hit is not None:
+                if hit[0] == epoch:
+                    self._stack_cache.move_to_end(key)
+                    return hit[2]
+                gens = self._gens(idx.name, field_name, view, shards)
+                if gens == hit[1]:
+                    self._stack_cache[key] = (epoch, gens, hit[2])
+                    self._stack_cache.move_to_end(key)
+                    return hit[2]
+            else:
+                gens = None
+        # Build outside the lock: row materialization + device_put can be
+        # slow, and fragments have their own locks. Two threads may race
+        # to build the same stack; the second insert simply wins.
+        if gens is None:
+            gens = self._gens(idx.name, field_name, view, shards)
         s_pad = self._pad(len(shards))
         mat = np.zeros((s_pad, WORDS_PER_SHARD), dtype=np.uint32)
         for i, shard in enumerate(shards):
-            frag = self.holder.fragment(self._index_name, field_name, view, shard)
+            frag = self.holder.fragment(idx.name, field_name, view, shard)
             if frag is not None:
                 mat[i] = frag.row_words(row_id)
         arr = jax.device_put(mat, shard_spec(self.mesh))
         nbytes = mat.nbytes
-        if hit is not None:
-            self._cache_bytes -= hit[1].nbytes
-            del self._stack_cache[key]
-        while (self._stack_cache
-               and self._cache_bytes + nbytes > self.max_cache_bytes):
-            _, (g, old) = self._stack_cache.popitem(last=False)
-            self._cache_bytes -= old.nbytes
-        self._stack_cache[key] = (gens, arr)
-        self._cache_bytes += nbytes
+        with self._cache_lock:
+            old = self._stack_cache.pop(key, None)
+            if old is not None:
+                self._cache_bytes -= old[2].nbytes
+            while (self._stack_cache
+                   and self._cache_bytes + nbytes > self.max_cache_bytes):
+                _, (_, _, dropped) = self._stack_cache.popitem(last=False)
+                self._cache_bytes -= dropped.nbytes
+            self._stack_cache[key] = (epoch, gens, arr)
+            self._cache_bytes += nbytes
         return arr
 
     def _fetch_leaf(self, idx: Index, leaf: tuple, shards: tuple):
-        self._index_name = idx.name
         kind = leaf[0]
         if kind == "zero":
             s_pad = self._pad(len(shards))
@@ -432,7 +487,7 @@ class MeshPlanner:
             return (np.uint32(lo), np.uint32(hi))
         if kind == "row":
             _, field_name, view, row_id = leaf
-            return self._stack_rows(field_name, view, row_id, shards)
+            return self._stack_rows(idx, field_name, view, row_id, shards)
         if kind == "row_time":
             _, field_name, row_id, from_time, to_time, q = leaf
             f = idx.field(field_name)
@@ -449,7 +504,8 @@ class MeshPlanner:
                                                     to_time, q):
                 if f.view(view_name) is None:
                     continue
-                stack = self._stack_rows(field_name, view_name, row_id, shards)
+                stack = self._stack_rows(idx, field_name, view_name, row_id,
+                                         shards)
                 acc = stack if acc is None else _jit_or(acc, stack)
             if acc is None:
                 return self._fetch_leaf(idx, ("zero",), shards)
@@ -460,9 +516,12 @@ class MeshPlanner:
             from pilosa_tpu.core.fragment import (
                 BSI_EXISTS_BIT, BSI_OFFSET_BIT, BSI_SIGN_BIT,
             )
-            exists = self._stack_rows(field_name, view, BSI_EXISTS_BIT, shards)
-            sign = self._stack_rows(field_name, view, BSI_SIGN_BIT, shards)
-            bits = [self._stack_rows(field_name, view, BSI_OFFSET_BIT + i, shards)
+            exists = self._stack_rows(idx, field_name, view, BSI_EXISTS_BIT,
+                                      shards)
+            sign = self._stack_rows(idx, field_name, view, BSI_SIGN_BIT,
+                                    shards)
+            bits = [self._stack_rows(idx, field_name, view,
+                                     BSI_OFFSET_BIT + i, shards)
                     for i in range(depth)]
             return (exists, sign, bits)
         raise QueryError(f"unknown leaf kind {kind!r}")
@@ -586,6 +645,19 @@ def _eval_node(sig: tuple, args) -> jax.Array:
             stack, exists & sign, llo, lhi, depth, True)
         return bitops.b_or(pos, neg)
     raise ValueError(f"unknown signature node {kind!r}")
+
+
+def _copy_async(*arrays) -> None:
+    """Kick off device->host copies for every output at once, so the
+    subsequent np.asarray reads pay ~one transfer round-trip total.
+    Over a tunneled TPU (this rig: ~110 ms per synchronous pull) the
+    difference between N sequential pulls and one pipelined wave is the
+    whole latency budget."""
+    for a in arrays:
+        try:
+            a.copy_to_host_async()
+        except (AttributeError, RuntimeError):  # non-jax array / backend
+            pass
 
 
 @jax.jit

@@ -383,3 +383,84 @@ def test_intersection_counts_trailing_empty_sparse_rows():
     frag.clear_bit(9, 10)
     counts = frag.intersection_counts([1, 5, 9], frag.row_words(1))
     assert counts.tolist() == [2, 0, 0]
+
+
+def test_scatter_import_equivalence(rng):
+    """The sort-free native bulk import (>=65536 bits, few rows) must
+    produce exactly the state the sorted path produces."""
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.config import SHARD_WIDTH
+    import numpy as np
+
+    n_bits = 70_000
+    cols = rng.integers(0, 5 * SHARD_WIDTH, n_bits, dtype=np.uint64)
+    rows = rng.integers(0, 3, n_bits).astype(np.uint64)  # 3 distinct rows
+
+    h1 = Holder()
+    f1 = h1.create_index("a").create_field("f")
+    f1.import_bits(rows, cols)          # scatter path (native)
+
+    import os
+    h2 = Holder()
+    f2 = h2.create_index("a").create_field("f")
+    # Force the sorted path by importing in chunks below the threshold.
+    for lo in range(0, n_bits, 30_000):
+        f2.import_bits(rows[lo:lo + 30_000], cols[lo:lo + 30_000])
+
+    assert f1.available_shards() == f2.available_shards()
+    for s in sorted(f1.available_shards()):
+        fr1 = h1.fragment("a", "f", "standard", s)
+        fr2 = h2.fragment("a", "f", "standard", s)
+        for r in (0, 1, 2):
+            np.testing.assert_array_equal(fr1.row_words(r), fr2.row_words(r))
+            assert fr1.rows[r].n == fr2.rows[r].n
+
+
+def test_scatter_import_values_equivalence(rng):
+    """Native BSI scatter vs the exact per-shard path, including
+    duplicate columns (last write wins) and negatives."""
+    from pilosa_tpu.core import Holder, FieldOptions
+    from pilosa_tpu.core.field import FIELD_TYPE_INT
+    import numpy as np
+
+    n_vals = 70_000
+    cols = rng.integers(0, 3 * 2**20, n_vals, dtype=np.uint64)  # dups likely
+    vals = rng.integers(-5000, 5000, n_vals)
+
+    opts = FieldOptions(type=FIELD_TYPE_INT, min=-5000, max=5000)
+    h1 = Holder()
+    v1 = h1.create_index("a").create_field("v", opts)
+    v1.import_values(cols, vals)        # scatter path
+
+    h2 = Holder()
+    v2 = h2.create_index("a").create_field("v",
+                                           FieldOptions(type=FIELD_TYPE_INT,
+                                                        min=-5000, max=5000))
+    for lo in range(0, n_vals, 30_000):  # stays below scatter threshold
+        v2.import_values(cols[lo:lo + 30_000], vals[lo:lo + 30_000])
+
+    depth = v1.bsi_group.bit_depth
+    assert depth == v2.bsi_group.bit_depth
+    for s in sorted(v1.available_shards()):
+        from pilosa_tpu.core.view import view_bsi_name
+        fr1 = h1.fragment("a", "v", view_bsi_name("v"), s)
+        fr2 = h2.fragment("a", "v", view_bsi_name("v"), s)
+        for r in range(depth + 2):
+            np.testing.assert_array_equal(
+                fr1.row_words(r), fr2.row_words(r),
+                err_msg=f"shard {s} bsi row {r}")
+
+
+def test_scatter_import_merges_into_existing(rng):
+    """Second large import into the same rows must OR, not replace."""
+    from pilosa_tpu.core import Holder
+    import numpy as np
+
+    h = Holder()
+    f = h.create_index("a").create_field("f")
+    a = rng.choice(2**20, 70_000, replace=False).astype(np.uint64)
+    b = rng.choice(2**20, 70_000, replace=False).astype(np.uint64)
+    f.import_bits(np.ones(len(a), dtype=np.uint64), a)
+    f.import_bits(np.ones(len(b), dtype=np.uint64), b)
+    frag = h.fragment("a", "f", "standard", 0)
+    assert frag.rows[1].n == len(np.union1d(a, b))

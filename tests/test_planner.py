@@ -121,7 +121,7 @@ def test_planner_sharding_layout(env):
     call = parse("Row(f=1)").calls[0]
     shards = sorted(idx.available_shards())
     assert fast.execute("i", "Count(Row(f=1))") == [16]
-    stack = planner._stack_rows("f", "standard", 1, tuple(shards))
+    stack = planner._stack_rows(idx, "f", "standard", 1, tuple(shards))
     assert stack.shape[0] == 16
     # 16 shards over 8 devices -> 2 shard-rows per device
     assert len(stack.sharding.device_set) == 8
