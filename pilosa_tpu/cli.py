@@ -29,7 +29,9 @@ _DEFAULTS = {
     "data_dir": "",
     "peers": "",
     "replica_n": 1,
-    "anti_entropy_interval": 0.0,
+    "anti_entropy_interval": 10.0,
+    "check_nodes_interval": 5.0,
+    "join": "",
     "planner": True,
 }
 
@@ -68,6 +70,8 @@ def cmd_server(args) -> int:
         cfg["replica_n"] = args.replica_n
     if args.no_planner:
         cfg["planner"] = False
+    if args.join:
+        cfg["join"] = args.join
 
     from pilosa_tpu.server.node import ServerNode
     node = ServerNode(
@@ -76,6 +80,8 @@ def cmd_server(args) -> int:
         replica_n=int(cfg["replica_n"]),
         use_planner=bool(cfg["planner"]),
         anti_entropy_interval=float(cfg["anti_entropy_interval"]),
+        check_nodes_interval=float(cfg["check_nodes_interval"]),
+        join=str(cfg["join"]) or None,
         data_dir=cfg["data_dir"] or None,
     )
     node.open()
@@ -208,7 +214,8 @@ def cmd_generate_config(args) -> int:
           'data-dir = ""\n'
           'peers = ""\n'
           'replica-n = 1\n'
-          'anti-entropy-interval = 0.0\n'
+          'anti-entropy-interval = 10.0\n'
+          'check-nodes-interval = 5.0\n'
           'planner = true')
     return 0
 
@@ -223,6 +230,8 @@ def main(argv: list[str] | None = None) -> int:
     s.add_argument("--peers", default="", help="comma-separated host:port")
     s.add_argument("--replica-n", type=int, default=0)
     s.add_argument("--no-planner", action="store_true")
+    s.add_argument("--join", default="",
+                   help="host:port of a running member to join")
     s.add_argument("--config", default=None)
     s.set_defaults(fn=cmd_server)
 

@@ -147,3 +147,12 @@ class LocalClient:
     def translate_entries(self, node, index, field, after_id):
         return self._peer(node).handle_translate_entries(index, field,
                                                          after_id)
+
+    def schema(self, node) -> list[dict]:
+        return self._peer(node).handle_schema()
+
+    def attr_blocks(self, node, index, field):
+        return self._peer(node).handle_attr_blocks(index, field)
+
+    def attr_block_data(self, node, index, field, block):
+        return self._peer(node).handle_attr_block_data(index, field, block)

@@ -61,8 +61,10 @@ class Cluster:
         return None
 
     def node_join(self, node: Node) -> None:
-        """Reference nodeJoin (cluster.go:1796) minus resize: membership
-        changes while holding data trigger a resize job (future work)."""
+        """Membership-only join (reference nodeJoin cluster.go:1796).
+        Data movement is the coordinator's job: ServerNode.handle_join
+        runs a ResizeJob (stream fragments, per-target ACKs, topology
+        broadcast) before peers adopt the new ring."""
         with self._lock:
             if self.node_by_id(node.id) is None:
                 self.nodes = sorted(self.nodes + [node], key=lambda n: n.id)

@@ -162,6 +162,23 @@ class ClusterNode:
         from pilosa_tpu.cluster.translate_sync import translate_entries
         return translate_entries(self.holder, index, field, after_id)
 
+    def _attr_store(self, index, field):
+        idx = self.holder.index(index)
+        if idx is None:
+            raise LookupError(f"index not found: {index!r}")
+        if field is None:
+            return idx.column_attr_store
+        f = idx.field(field)
+        if f is None:
+            raise LookupError(f"field not found: {index}/{field}")
+        return f.row_attr_store
+
+    def handle_attr_blocks(self, index, field):
+        return self._attr_store(index, field).blocks()
+
+    def handle_attr_block_data(self, index, field, block):
+        return self._attr_store(index, field).block_data(block)
+
 
 class LocalCluster:
     """N in-process nodes sharing a LocalClient transport."""
@@ -198,10 +215,12 @@ class LocalCluster:
             idx = cn.holder.index(index)
             idx.create_field_if_not_exists(name, options)
 
-    def query(self, index: str, query: str, node: int = 0) -> list[Any]:
+    def query(self, index: str, query: str, node: int = 0,
+              cache: bool = True) -> list[Any]:
         """Run through one node as coordinator (Cluster.Query analog,
-        test/pilosa.go:247)."""
-        return self.nodes[node].executor.execute(index, query)
+        test/pilosa.go:247). ``cache=False`` bypasses the coordinator's
+        result cache (benchmarking the cold path)."""
+        return self.nodes[node].executor.execute(index, query, cache=cache)
 
     def sync_translation(self) -> int:
         """Run the replica entry-stream pull on every node (the

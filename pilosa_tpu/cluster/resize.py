@@ -28,13 +28,19 @@ from pilosa_tpu.cluster.node import URI, Node
 
 @dataclass
 class ResizeSource:
-    """One fragment a node must fetch (reference ResizeSource)."""
+    """One fragment a node must fetch (reference ResizeSource).
+
+    Carries the source's address (host/port) so a JOINING node — which
+    has no topology yet — can fetch without resolving ids against a
+    cluster it hasn't learned."""
 
     source_node: str
     index: str
     field: str
     view: str
     shard: int
+    source_host: str = ""
+    source_port: int = 0
 
 
 def fragment_sources(old: Cluster, new: Cluster, schema_fragments) -> dict[str, list[ResizeSource]]:
@@ -44,31 +50,45 @@ def fragment_sources(old: Cluster, new: Cluster, schema_fragments) -> dict[str, 
     first old owner (reference fragSources cluster.go:784-868)."""
     out: dict[str, list[ResizeSource]] = {}
     for index, field, view, shard in schema_fragments:
-        old_owners = [n.id for n in old.shard_nodes(index, shard)]
+        old_owners = old.shard_nodes(index, shard)
+        old_ids = [n.id for n in old_owners]
         new_owners = [n.id for n in new.shard_nodes(index, shard)]
         for target in new_owners:
-            if target in old_owners or not old_owners:
+            if target in old_ids or not old_owners:
                 continue
+            src = old_owners[0]
             out.setdefault(target, []).append(ResizeSource(
-                source_node=old_owners[0], index=index, field=field,
-                view=view, shard=shard))
+                source_node=src.id, index=index, field=field,
+                view=view, shard=shard,
+                source_host=src.uri.host, source_port=src.uri.port))
     return out
 
 
 def apply_resize_instruction(holder, client, cluster: Cluster,
-                             sources: list[dict]) -> None:
-    """followResizeInstruction (cluster.go:1297): fetch each fragment
-    from its source node and merge it locally."""
+                             sources: list[dict],
+                             schema: list[dict] | None = None) -> None:
+    """followResizeInstruction (cluster.go:1297): adopt the sender's
+    schema (a joiner starts empty), then fetch each fragment from its
+    source node and merge it locally. Any fetch failure RAISES so the
+    coordinator's completion tracking sees this target as failed
+    (reference ResizeInstructionComplete, cluster.go:1315)."""
+    if schema:
+        holder.apply_schema(schema)
     for s in sources:
         src = ResizeSource(**s)
         node = cluster.node_by_id(src.source_node)
+        if node is None and src.source_host:
+            node = Node(id=src.source_node,
+                        uri=URI(host=src.source_host, port=src.source_port))
         if node is None:
-            continue
+            raise ConnectionError(
+                f"resize source {src.source_node!r} unknown")
         data = client.fetch_fragment(node, src.index, src.field, src.view,
                                      src.shard)
         f = holder.field(src.index, src.field)
         if f is None:
-            continue
+            raise LookupError(
+                f"resize target field missing: {src.index}/{src.field}")
         f.import_roaring(src.shard, data, view=src.view)
 
 
@@ -144,21 +164,50 @@ class ResizeJob:
                            replica_n=self.cluster.replica_n,
                            partition_n=self.cluster.partition_n)
         self.cluster.set_state(STATE_RESIZING)
+        #: per-target completion tracking (reference
+        #: ResizeInstructionComplete + per-node map, cluster.go:1315,
+        #: :1413-1438): the new topology is committed ONLY after every
+        #: target acknowledged its instruction; any failure leaves the
+        #: old topology fully intact.
+        self.completed: list[str] = []
+        self.failed: list[str] = []
         try:
+            schema = self.holder.schema()
             instructions = fragment_sources(old_view, new_view,
                                             self._schema_fragments())
+            # Every ADDED node gets an instruction even with nothing to
+            # fetch: the message carries the schema, which a fresh
+            # joiner doesn't have yet.
+            old_ids = {n.id for n in old_view.nodes}
+            for n in new_view.nodes:
+                if n.id not in old_ids:
+                    instructions.setdefault(n.id, [])
             for target_id, sources in sorted(instructions.items()):
                 if self.state == "ABORTED":
                     return self.state
                 payload = [asdict(s) for s in sources]
-                if target_id == self.cluster.local_id:
-                    apply_resize_instruction(self.holder, self.client,
-                                             old_view, payload)
-                else:
-                    node = new_view.node_by_id(target_id)
-                    self.client.send_message(
-                        node, {"type": "resize-instruction",
-                               "sources": payload})
+                try:
+                    if target_id == self.cluster.local_id:
+                        apply_resize_instruction(self.holder, self.client,
+                                                 old_view, payload)
+                    else:
+                        node = new_view.node_by_id(target_id)
+                        # send_message is synchronous: a 2xx response IS
+                        # the target's completion ACK (it applies the
+                        # instruction inside the request).
+                        self.client.send_message(
+                            node, {"type": "resize-instruction",
+                                   "schema": schema,
+                                   "sources": payload})
+                    self.completed.append(target_id)
+                except (ConnectionError, LookupError, RuntimeError):
+                    self.failed.append(target_id)
+            if self.failed:
+                # A target never confirmed its fragments: committing the
+                # new topology would route reads to holes. Old topology
+                # stays live; operator (or the next join attempt) retries.
+                self.state = "FAILED"
+                return self.state
             # Commit: broadcast the new topology + shard availability,
             # adopt it locally.
             status = {"type": "cluster-status",

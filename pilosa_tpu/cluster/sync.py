@@ -78,11 +78,18 @@ class HolderSyncer:
         self.client = client
 
     def sync_holder(self) -> int:
-        """Returns the number of fragments repaired."""
+        """Returns the number of fragments + attr stores repaired."""
         repaired = 0
         for index_name in self.holder.index_names():
             idx = self.holder.index(index_name)
+            # Attr stores first, like the reference's syncIndex/syncField
+            # order (holder.go:975-1067): column attrs, then per-field
+            # row attrs — attrs replicate everywhere, not per shard.
+            if self._sync_attrs(index_name, None, idx.column_attr_store):
+                repaired += 1
             for field_name, f in sorted(idx.fields.items()):
+                if self._sync_attrs(index_name, field_name, f.row_attr_store):
+                    repaired += 1
                 for view_name, v in sorted(f.views.items()):
                     for shard in sorted(v.fragments):
                         if not self.cluster.owns_shard(
@@ -92,6 +99,35 @@ class HolderSyncer:
                                                view_name, shard):
                             repaired += 1
         return repaired
+
+    def _sync_attrs(self, index_name: str, field_name: str | None,
+                    store) -> bool:
+        """Pull-repair one attr store against every live peer: blocks
+        whose checksums differ are fetched and merged locally (reference
+        syncIndex -> AttrStore.Blocks -> ColumnAttrDiff -> SetBulkAttrs,
+        holder.go:975-1067). Each node repairs itself; mutual convergence
+        comes from every node running its own syncer."""
+        changed = False
+        mine = store.blocks()
+        for node in self.cluster.nodes:
+            if node.id == self.cluster.local_id or node.state == "DOWN":
+                continue
+            try:
+                theirs = self.client.attr_blocks(node, index_name, field_name)
+            except (ConnectionError, LookupError):
+                continue
+            for b in store.diff_blocks(mine, theirs):
+                try:
+                    data = self.client.attr_block_data(node, index_name,
+                                                       field_name, b)
+                except (ConnectionError, LookupError):
+                    continue
+                if data:
+                    store.set_bulk_attrs(data)
+                    changed = True
+            if changed:
+                mine = store.blocks()
+        return changed
 
     def _replicas(self, index_name: str, shard: int):
         return [n for n in self.cluster.shard_nodes(index_name, shard)

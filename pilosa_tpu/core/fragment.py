@@ -462,11 +462,27 @@ class Fragment:
         dispatches, so the counts vector reflects one atomic fragment
         state — writers stall for the sweep, exactly like the reference's
         fragment.top holding f.mu for its full walk (fragment.go:1570)."""
+        out, parts = self.intersection_counts_async(row_ids, seg, reuse)
+        for slots, dev in parts:
+            out[slots] = np.asarray(dev, dtype=np.int64)[:len(slots)]
+        return out
+
+    def intersection_counts_async(self, row_ids, seg, reuse: bool = False,
+                                  seg_host: np.ndarray | None = None):
+        """Non-blocking intersection_counts: returns (counts, parts)
+        where ``counts`` already holds the host-tier (sparse) results and
+        ``parts`` is [(slot_indices, device_count_array), ...] — device
+        programs DISPATCHED but not synced. Callers sweeping many
+        fragments resolve every part in one transfer wave instead of one
+        sync per fragment (the r2 filtered-TopN latency). Pass
+        ``seg_host`` when the filter already exists host-side so the
+        sparse tier never pulls it off the device."""
         ids = [int(r) for r in row_ids]
         if not ids:
-            return np.empty(0, dtype=np.int64)
+            return np.empty(0, dtype=np.int64), []
         seg = seg if isinstance(seg, jax.Array) else jnp.asarray(seg)
         out = np.zeros(len(ids), dtype=np.int64)
+        parts: list[tuple[np.ndarray, jax.Array]] = []
         with self._lock:
             sparse_pos: list[np.ndarray] = []
             sparse_slots: list[int] = []
@@ -486,7 +502,8 @@ class Fragment:
                         sparse_slots.append(i)
 
             if sparse_pos:
-                seg_host = np.asarray(seg, dtype=np.uint32)
+                if seg_host is None:
+                    seg_host = np.asarray(seg, dtype=np.uint32)
                 lens = np.fromiter((len(p) for p in sparse_pos),
                                    dtype=np.int64, count=len(sparse_pos))
                 pos = np.concatenate(sparse_pos)
@@ -502,9 +519,9 @@ class Fragment:
             if dense_ids:
                 if len(dense_ids) <= STACK_CACHE_MAX_ROWS:
                     stack = self.device_stack(tuple(dense_ids))
-                    out[dense_slots] = np.asarray(
-                        pallas_kernels.pair_count(stack, seg, "and"),
-                        dtype=np.int64)
+                    parts.append((np.asarray(dense_slots, dtype=np.int64),
+                                  pallas_kernels.pair_count(stack, seg,
+                                                            "and")))
                 else:
                     n_tiles = (len(dense_ids) + ROW_TILE - 1) // ROW_TILE
                     cache_tiles = reuse and n_tiles <= MAX_RESIDENT_TILES
@@ -513,8 +530,6 @@ class Fragment:
                     # NOT id-set-keyed, so a fragment never pins more
                     # than MAX_RESIDENT_TILES tiles: a different id set
                     # replaces them (device_stack verifies stored ids).
-                    mat = None if cache_tiles else np.zeros(
-                        (ROW_TILE, WORDS_PER_SHARD), dtype=np.uint32)
                     dense_slots_a = np.asarray(dense_slots, dtype=np.int64)
                     for lo in range(0, len(dense_ids), ROW_TILE):
                         chunk = dense_ids[lo:lo + ROW_TILE]
@@ -522,17 +537,19 @@ class Fragment:
                             arr = self.device_stack(tuple(chunk),
                                                     key=("ic_tile", lo))
                         else:
+                            # Fresh buffer per tile: uploads are async
+                            # (and zero-copy on the CPU backend), so a
+                            # reused buffer would be overwritten while
+                            # the deferred kernel still reads it.
+                            mat = np.zeros((ROW_TILE, WORDS_PER_SHARD),
+                                           dtype=np.uint32)
                             for i, r in enumerate(chunk):
                                 mat[i] = self.row_words(r)
-                            if len(chunk) < ROW_TILE:
-                                mat[len(chunk):] = 0
                             arr = jnp.asarray(mat)
-                        counts = np.asarray(
-                            pallas_kernels.pair_count(arr, seg, "and"),
-                            dtype=np.int64)
-                        out[dense_slots_a[lo:lo + len(chunk)]] = \
-                            counts[:len(chunk)]
-        return out
+                        parts.append(
+                            (dense_slots_a[lo:lo + len(chunk)],
+                             pallas_kernels.pair_count(arr, seg, "and")))
+        return out, parts
 
     def row_counts(self) -> tuple[np.ndarray, np.ndarray]:
         """(row_ids, counts), cached per generation — the exact

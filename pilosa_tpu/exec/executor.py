@@ -63,10 +63,6 @@ DEFAULT_MIN_THRESHOLD = 1
 _BITMAP_CALLS = frozenset(
     {"Row", "Range", "Difference", "Intersect", "Union", "Xor", "Not", "Shift"})
 
-#: Calls that mutate state — queries containing any of these are never
-#: served from (or stored into) the result cache.
-_WRITE_CALLS = frozenset(
-    {"Set", "Clear", "ClearRow", "Store", "SetRowAttrs", "SetColumnAttrs"})
 
 
 @dataclass
@@ -148,8 +144,15 @@ class Executor:
             shards = sorted(idx.available_shards())
         shards = list(shards) if shards is not None else []
 
+        # Cluster caveat: the epoch only tracks LOCAL mutations, so on a
+        # clustered node the cache is only safe for forwarded (remote)
+        # sub-queries — every write to an owned shard lands locally on
+        # its owner. Coordinator-side full queries span shards whose
+        # writes this node never sees; caching them would serve stale
+        # reads forever.
         cacheable = (cache and self.result_cache_enabled and raw is not None
-                     and not any(c.name in _WRITE_CALLS for c in query.calls))
+                     and (self.cluster is None or opt.remote)
+                     and not query.has_writes())
         if cacheable:
             key = self._cache_key(idx, raw, shards, opt)
             epoch = idx.epoch.value
@@ -950,7 +953,32 @@ class Executor:
         def reduce_fn(p, v):
             return merge_group_counts(p or [], v, limit)
 
-        results = self.map_reduce(idx, shards, c, opt, map_fn, reduce_fn) or []
+        local_batch = None
+        gb_fields = self._planner_group_by_fields(idx, c, filter_call,
+                                                  child_rows)
+        if gb_fields is not None:
+            def local_batch(shs):
+                p = self.planner
+                cands = [p.group_by_candidates(idx, fn, shs)
+                         for fn in gb_fields]
+                res = None
+                if all(cands):
+                    res = p.execute_group_by(idx, gb_fields, cands, shs,
+                                             filter_call)
+                elif shs:  # a level has no rows anywhere: empty result
+                    return []
+                if res is None:  # too many pairs: per-shard streaming
+                    acc = None
+                    for shard in shs:
+                        acc = reduce_fn(acc, map_fn(shard))
+                    return acc or []
+                return [GroupCount(
+                    group=[FieldRow(field=gb_fields[i], row_id=rid)
+                           for i, rid in enumerate(grp)],
+                    count=cnt) for grp, cnt in res]
+
+        results = self.map_reduce(idx, shards, c, opt, map_fn, reduce_fn,
+                                  local_batch_fn=local_batch) or []
 
         offset, has_off = c.uint_arg("offset")
         if has_off and offset < len(results):
@@ -958,6 +986,33 @@ class Executor:
         if has_limit and limit < len(results):
             results = results[:limit]
         return results
+
+    def _planner_group_by_fields(self, idx: Index, c: Call,
+                                 filter_call: Call | None,
+                                 child_rows) -> list[str] | None:
+        """Field names when the planner's batched GroupBy applies: plain
+        Rows children (no cursors/column/limit/time windows) over
+        non-time fields, plannable filter. None = use the per-shard
+        path (which also handles the cursor/seek semantics)."""
+        if self.planner is None:
+            return None
+        if filter_call is not None and not self.planner.supports(filter_call):
+            return None
+        fields = []
+        for i, child in enumerate(c.children):
+            if child_rows[i] is not None:
+                return None
+            if any(a in child.args
+                   for a in ("previous", "column", "limit", "from", "to")):
+                return None
+            field_name = child.args.get("_field")
+            f = idx.field(field_name)
+            if f is None:
+                raise FieldNotFoundError(f"field not found: {field_name!r}")
+            if f.field_type == FIELD_TYPE_TIME or f.options.no_standard_view:
+                return None
+            fields.append(field_name)
+        return fields
 
     def _group_by_shard(self, idx: Index, c: Call, filter_call: Call | None,
                         shard: int, child_rows) -> list[GroupCount]:

@@ -19,7 +19,11 @@ GT = ">"
 GTE = ">="
 BETWEEN = "><"
 
+#: the reference's writeCallN set (ast.go) — kept for its exact parity.
 _WRITE_CALLS = frozenset({"Set", "Clear", "SetRowAttrs", "SetColumnAttrs"})
+#: every call that mutates state, for cacheability decisions.
+WRITE_CALLS = frozenset({"Set", "Clear", "ClearRow", "Store",
+                         "SetRowAttrs", "SetColumnAttrs"})
 
 
 def is_reserved_arg(name: str) -> bool:
@@ -140,6 +144,14 @@ class Query:
 
     def write_call_n(self) -> int:
         return sum(1 for c in self.calls if c.name in _WRITE_CALLS)
+
+    def has_writes(self) -> bool:
+        """True if ANY call anywhere in the tree mutates state (writes
+        can hide under wrappers like Options(...))."""
+        def walk(c: "Call") -> bool:
+            return c.name in WRITE_CALLS or any(walk(ch)
+                                                for ch in c.children)
+        return any(walk(c) for c in self.calls)
 
     def __str__(self) -> str:
         return "\n".join(str(c) for c in self.calls)

@@ -315,6 +315,24 @@ class API:
             raise FragmentNotFoundError()
         return frag.block_data(block)
 
+    def _attr_store(self, index: str, field: str | None):
+        """Column attrs (field=None) or a field's row attrs (reference
+        api.go:817-918 attr-diff surface)."""
+        idx = self.holder.index_or_raise(index)
+        if field is None:
+            return idx.column_attr_store
+        f = idx.field(field)
+        if f is None:
+            raise FieldNotFoundError()
+        return f.row_attr_store
+
+    def attr_blocks(self, index: str, field: str | None) -> list:
+        return self._attr_store(index, field).blocks()
+
+    def attr_block_data(self, index: str, field: str | None,
+                        block: int) -> dict:
+        return self._attr_store(index, field).block_data(block)
+
     def _broadcast(self, message: dict) -> None:
         if self.cluster is None:
             return

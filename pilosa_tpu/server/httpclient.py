@@ -136,3 +136,24 @@ class HTTPInternalClient:
             path += f"&field={field}"
         resp = self._request(node, "GET", path)
         return [(int(i), k) for i, k in resp["entries"]]
+
+    def schema(self, node) -> list[dict]:
+        """Peer schema pull (reference NodeStatus carries Schema;
+        server.go:640 handles it on receive)."""
+        resp = self._request(node, "GET", "/schema")
+        return resp["indexes"]
+
+    def attr_blocks(self, node, index, field):
+        path = f"/internal/attr/blocks?index={index}"
+        if field:
+            path += f"&field={field}"
+        resp = self._request(node, "GET", path)
+        return [(int(b["id"]), bytes.fromhex(b["checksum"]))
+                for b in resp["blocks"]]
+
+    def attr_block_data(self, node, index, field, block):
+        path = f"/internal/attr/data?index={index}&block={int(block)}"
+        if field:
+            path += f"&field={field}"
+        resp = self._request(node, "GET", path)
+        return {int(i): a for i, a in resp["attrs"].items()}

@@ -305,6 +305,16 @@ def _build_routes(api: API):
         return 200, {"rowIDs": [int(r) for r in rows],
                      "columnIDs": [int(c) for c in cols]}
 
+    def get_attr_blocks(pv, params, body):
+        blocks = api.attr_blocks(params["index"], params.get("field"))
+        return 200, {"blocks": [{"id": b, "checksum": cs.hex()}
+                                for b, cs in blocks]}
+
+    def get_attr_block_data(pv, params, body):
+        data = api.attr_block_data(params["index"], params.get("field"),
+                                   int(params["block"]))
+        return 200, {"attrs": {str(i): a for i, a in data.items()}}
+
     def post_internal_import(pv, params, body):
         req = jbody(body)
         server = getattr(api, "import_handler", None)
@@ -346,6 +356,8 @@ def _build_routes(api: API):
         (r"/cluster/resize/remove-node", {"POST": post_resize_remove_node}),
         (r"/cluster/resize/set-coordinator", {"POST": post_set_coordinator}),
         (r"/internal/fragment/block/data", {"GET": get_fragment_block_data}),
+        (r"/internal/attr/blocks", {"GET": get_attr_blocks}),
+        (r"/internal/attr/data", {"GET": get_attr_block_data}),
         (r"/internal/import", {"POST": post_internal_import}),
         (r"/internal/nodes", {"GET": get_nodes}),
     ]

@@ -213,3 +213,34 @@ def test_import_values_empty_batch():
                                            min=0, max=100))
     v.import_values([], [])                  # no-op, no crash
     v.import_values([], [], clear=True)      # regression: IndexError
+
+
+def test_options_wrapped_write_not_cached():
+    """Writes hidden under Options() must never be served from cache
+    (the cacheability check recurses the whole call tree)."""
+    h = Holder()
+    idx = h.create_index("i")
+    idx.create_field("f")
+    ex = Executor(h, planner=MeshPlanner(h, make_mesh()))
+    q = "Options(Set(1, f=1), shards=[0])"
+    assert ex.execute("i", q) == [True]
+    assert ex.execute("i", q) == [False]     # executed again, not cached
+    assert ex.execute("i", "Count(Row(f=1))") == [1]
+
+
+def test_cluster_coordinator_results_not_cached():
+    """On a clustered node only forwarded (remote) sub-queries are
+    cacheable: the coordinator's epoch never sees writes applied purely
+    on other owners, so full-query caching would serve stale reads."""
+    from pilosa_tpu.cluster.harness import LocalCluster
+    lc = LocalCluster(3, replica_n=1)
+    lc.create_index("i")
+    lc.create_field("i", "f")
+    lc.query("i", "Set(1, f=1)")
+    assert lc.query("i", "Count(Row(f=1))") == [1]
+    # Mutate an owner's fragment behind node 0's back (write through a
+    # different node / direct owner apply).
+    owner = lc[0].cluster.shard_nodes("i", 0)[0]
+    lc.client.peers[owner.id].holder.fragment(
+        "i", "f", "standard", 0).set_bit(1, 7)
+    assert lc.query("i", "Count(Row(f=1))") == [2]  # no stale cache

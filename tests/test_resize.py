@@ -157,3 +157,169 @@ def test_http_resize_remove_node():
                 n.close()
             except Exception:
                 pass
+
+
+def _free_ports(n):
+    import socket
+    ports = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        s.close()
+    return ports
+
+
+def test_http_dynamic_join():
+    """A fresh node joins a RUNNING 2-node cluster over HTTP with no
+    peer restarts: the coordinator resizes it in (schema + fragments
+    stream over) and broadcasts the ring; queries then fan out to it
+    (VERDICT r2 missing #1 / next #6)."""
+    import json
+    import time
+    import urllib.request
+    from pilosa_tpu.server.node import ServerNode
+
+    ports = _free_ports(3)
+    addrs = [f"127.0.0.1:{p}" for p in ports]
+    nodes = [ServerNode(bind=a, peers=[x for x in addrs[:2] if x != a],
+                        replica_n=1, use_planner=False,
+                        anti_entropy_interval=0.0, check_nodes_interval=0.0)
+             for a in addrs[:2]]
+    for n in nodes:
+        n.open()
+    joiner = None
+    try:
+        base = nodes[0].address
+
+        def post(path, body):
+            r = urllib.request.Request(base + path, data=body.encode(),
+                                       method="POST")
+            return json.loads(urllib.request.urlopen(r, timeout=10).read()
+                              or b"{}")
+
+        post("/index/i", "{}")
+        post("/index/i/field/f", "{}")
+        cols = [s * SHARD_WIDTH for s in range(8)]
+        for c in cols:
+            post("/index/i/query", f"Set({c}, f=1)")
+
+        # Boot the third node pointing at a RUNNING member (not even the
+        # coordinator — the join forwards).
+        joiner = ServerNode(bind=addrs[2], join=addrs[1],
+                            use_planner=False, anti_entropy_interval=0.0,
+                            check_nodes_interval=0.0)
+        joiner.open()
+        for _ in range(100):
+            if len(joiner.cluster.nodes) == 3:
+                break
+            time.sleep(0.1)
+        assert len(joiner.cluster.nodes) == 3
+        st = json.loads(urllib.request.urlopen(base + "/status",
+                                               timeout=10).read())
+        assert len(st["nodes"]) == 3
+        # The ring now includes the joiner; data still complete.
+        assert post("/index/i/query", "Count(Row(f=1))") == \
+            {"results": [len(cols)]}
+        # And queries through the JOINER see the whole index too.
+        r = urllib.request.Request(joiner.address + "/index/i/query",
+                                   data=b"Count(Row(f=1))", method="POST")
+        assert json.loads(urllib.request.urlopen(r, timeout=10).read()) == \
+            {"results": [len(cols)]}
+    finally:
+        for n in nodes + ([joiner] if joiner else []):
+            try:
+                n.close()
+            except Exception:
+                pass
+
+
+def test_resize_failure_keeps_old_topology():
+    """A target failing mid-resize must leave the OLD topology live
+    (per-target completion ACKs before commit; reference
+    ResizeInstructionComplete cluster.go:1315)."""
+    lc = LocalCluster(2)
+    seed(lc)
+    old_nodes = list(lc[0].cluster.nodes)
+    # The new node is unreachable: its resize instruction must fail.
+    new = old_nodes + [Node(id="nodeX", uri=URI(port=10199))]
+    lc.client.down.add("nodeX")
+    job = ResizeJob(lc[0].cluster, lc[0].holder, lc.client)
+    state = job.run([Node(id=n.id, uri=n.uri, is_coordinator=n.is_coordinator)
+                     for n in new])
+    assert state == "FAILED"
+    assert job.failed == ["nodeX"]
+    assert [n.id for n in lc[0].cluster.nodes] == [n.id for n in old_nodes]
+    assert lc[0].cluster.state == "NORMAL"
+    # Data still fully queryable through the old ring.
+    assert lc.query("i", "Count(Row(f=1))") == [6]
+
+
+def test_autonomous_recovery_after_restart():
+    """VERDICT r2 #10: with default tickers ON, a node that dies and
+    comes back converges with NO operator action — the failure detector
+    marks it DOWN then READY, and anti-entropy repairs the writes it
+    missed."""
+    import json
+    import time
+    import urllib.request
+    from pilosa_tpu.server.node import ServerNode
+
+    ports = _free_ports(2)
+    addrs = [f"127.0.0.1:{p}" for p in ports]
+    nodes = [ServerNode(bind=a, peers=[x for x in addrs if x != a],
+                        replica_n=2, use_planner=False,
+                        anti_entropy_interval=0.5,
+                        check_nodes_interval=0.3)
+             for a in addrs]
+    for n in nodes:
+        n.open()
+    try:
+        base = nodes[0].address
+
+        def post(path, body):
+            r = urllib.request.Request(base + path, data=body.encode(),
+                                       method="POST")
+            return json.loads(urllib.request.urlopen(r, timeout=10).read()
+                              or b"{}")
+
+        post("/index/i", "{}")
+        post("/index/i/field/f", "{}")
+        post("/index/i/query", "Set(1, f=1)")
+
+        # Kill node 1; the detector must mark it DOWN (replica_n=2 ->
+        # DEGRADED) without any operator call.
+        nodes[1].close()
+        for _ in range(100):
+            if nodes[0].cluster.state == "DEGRADED":
+                break
+            time.sleep(0.1)
+        assert nodes[0].cluster.state == "DEGRADED"
+
+        # Writes while the replica is down.
+        post("/index/i/query", "Set(2, f=1) Set(3, f=1)")
+
+        # Restart it on the same address (fresh process state).
+        nodes[1] = ServerNode(bind=addrs[1], peers=[addrs[0]], replica_n=2,
+                              use_planner=False,
+                              anti_entropy_interval=0.5,
+                              check_nodes_interval=0.3)
+        nodes[1].open()
+        # Autonomous: DOWN -> READY via check_nodes, missed bits via
+        # anti-entropy — no /cluster or /sync calls issued here.
+        deadline = time.time() + 20
+        frag = None
+        while time.time() < deadline:
+            frag = nodes[1].holder.fragment("i", "f", "standard", 0)
+            if (nodes[0].cluster.state == "NORMAL" and frag is not None
+                    and frag.contains(1, 2) and frag.contains(1, 3)):
+                break
+            time.sleep(0.2)
+        assert nodes[0].cluster.state == "NORMAL"
+        assert frag is not None and frag.contains(1, 2) and frag.contains(1, 3)
+    finally:
+        for n in nodes:
+            try:
+                n.close()
+            except Exception:
+                pass
