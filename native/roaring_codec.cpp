@@ -22,9 +22,16 @@
 namespace {
 
 constexpr uint32_t kMagic = 12348;
+// Official RoaringFormatSpec cookies (32-bit roaring; the constants are
+// the public interchange format, reference roaring.go:5310-5313).
+constexpr uint32_t kOfficialNoRuns = 12346;
+constexpr uint32_t kOfficialRuns = 12347;
 constexpr int kTypeArray = 1;
 constexpr int kTypeBitmap = 2;
 constexpr int kTypeRun = 3;
+//: internal: official-spec run container — runs are (start, LENGTH)
+//: pairs, unlike the pilosa variant's (start, last).
+constexpr int kTypeRunOfficial = 4;
 constexpr int kArrayMax = 4096;
 constexpr int kRunMax = 2048;
 constexpr int kBitmapWords64 = (1 << 16) / 64;
@@ -61,15 +68,96 @@ struct Meta {
   uint32_t off;
 };
 
-// Parse header + metas; returns container count or -1.
+// Official RoaringFormatSpec header+metas (readOfficialHeader behavior,
+// roaring.go:5316-5374): u16 keys, cardinality-based container typing,
+// run bitmap with cookie 12347, offset header present unless
+// (runs && size < 4) — then containers are laid out sequentially.
+int parse_official(const uint8_t* buf, int64_t len,
+                   std::vector<Meta>* metas) {
+  if (len < 8) return -1;
+  uint32_t cookie = rd32(buf);
+  uint32_t size;
+  int64_t pos = 4;
+  const uint8_t* run_bitmap = nullptr;
+  bool have_runs = false;
+  if (cookie == kOfficialNoRuns) {
+    size = rd32(buf + 4);
+    pos = 8;
+  } else if ((cookie & 0xFFFF) == kOfficialRuns) {
+    have_runs = true;
+    size = (cookie >> 16) + 1;
+    int64_t rb = (static_cast<int64_t>(size) + 7) / 8;
+    if (pos + rb > len) return -1;
+    run_bitmap = buf + pos;
+    pos += rb;
+  } else {
+    return -1;
+  }
+  if (size > (1u << 16)) return -1;
+  int64_t hdr = pos;
+  if (pos + 4LL * size > len) return -1;
+  pos += 4LL * size;
+  bool have_offsets = !have_runs || size >= 4;
+  const uint8_t* offsets = nullptr;
+  if (have_offsets) {
+    if (pos + 4LL * size > len) return -1;
+    offsets = buf + pos;
+    pos += 4LL * size;
+    // Containers are sequential and non-overlapping; aliased or
+    // decreasing offsets let a tiny buffer emit unbounded data.
+    uint32_t prev = 0;
+    for (uint32_t i = 0; i < size; i++) {
+      uint32_t o = rd32(offsets + 4LL * i);
+      if (o < pos || (i > 0 && o <= prev)) return -1;
+      prev = o;
+    }
+  }
+  int64_t data_off = pos;
+  metas->resize(size);
+  for (uint32_t i = 0; i < size; i++) {
+    Meta& m = (*metas)[i];
+    m.key = rd16(buf + hdr + 4LL * i);
+    m.n = rd16(buf + hdr + 4LL * i + 2) + 1;
+    bool is_run = run_bitmap && ((run_bitmap[i / 8] >> (i % 8)) & 1);
+    // <=: official writers keep arrays up to EXACTLY 4096 values (the
+    // reference's `card < ArrayMaxSize` typer misreads those; 4096 u16s
+    // happen to be one bitmap's 8192 bytes, so nothing bounds-checks).
+    m.typ = is_run ? kTypeRunOfficial
+                   : (m.n <= kArrayMax ? kTypeArray : kTypeBitmap);
+    if (offsets) {
+      m.off = rd32(offsets + 4LL * i);
+    } else {
+      if (data_off > len || data_off > UINT32_MAX) return -1;
+      m.off = static_cast<uint32_t>(data_off);
+      switch (m.typ) {  // sequential layout: advance past this container
+        case kTypeArray:
+          data_off += 2LL * m.n;
+          break;
+        case kTypeBitmap:
+          data_off += 8LL * kBitmapWords64;
+          break;
+        case kTypeRunOfficial: {
+          if (data_off + 2 > len) return -1;
+          int rc = rd16(buf + data_off);
+          data_off += 2 + 4LL * rc;
+          break;
+        }
+      }
+    }
+  }
+  return static_cast<int>(size);
+}
+
+// Parse header + metas; returns container count or -1. Dispatches on
+// the cookie: pilosa variant (12348) or official spec (12346/12347).
 int parse_metas(const uint8_t* buf, int64_t len, std::vector<Meta>* metas) {
   if (len < 8) return -1;
   uint32_t cookie = rd32(buf);
-  if ((cookie & 0xFFFF) != kMagic) return -1;
+  if ((cookie & 0xFFFF) != kMagic) return parse_official(buf, len, metas);
   int count = static_cast<int>(rd32(buf + 4));
   int64_t meta_off = 8;
   int64_t offs_off = meta_off + 12LL * count;
-  if (offs_off + 4LL * count > len) return -1;
+  if (count < 0 || offs_off + 4LL * count > len) return -1;
   metas->resize(count);
   for (int i = 0; i < count; i++) {
     const uint8_t* m = buf + meta_off + 12LL * i;
@@ -90,6 +178,10 @@ int64_t roaring_decode_count(const uint8_t* buf, int64_t len) {
   if (parse_metas(buf, len, &metas) < 0) return -1;
   int64_t total = 0;
   for (const Meta& m : metas) total += m.n;
+  // Allocation-DoS guard: a 4-byte run can legitimately encode 65536
+  // values, so len*16384 bounds any honest buffer; claims beyond it are
+  // adversarial (the caller allocates `total` uint64s).
+  if (total > len * 16384 + 65536) return -1;
   return total;
 }
 
@@ -101,10 +193,14 @@ int64_t roaring_decode(const uint8_t* buf, int64_t len, uint64_t* out,
   for (const Meta& m : metas) {
     uint64_t base = m.key << 16;
     const uint8_t* data = buf + m.off;
-    if (n_out + m.n > cap) return -1;
+    // cap guards below use the ACTUAL content (popcounts, run
+    // lengths), never the claimed N: an adversarial buffer can claim
+    // N=1 while a run/bitmap emits 65536 values — trusting N was a
+    // heap overflow (caller allocates from roaring_decode_count).
     switch (m.typ) {
       case kTypeArray: {
         if (m.off + 2LL * m.n > len) return -1;
+        if (n_out + m.n > cap) return -1;
         for (int i = 0; i < m.n; i++) out[n_out++] = base + rd16(data + 2 * i);
         break;
       }
@@ -112,6 +208,7 @@ int64_t roaring_decode(const uint8_t* buf, int64_t len, uint64_t* out,
         if (m.off + 8LL * kBitmapWords64 > len) return -1;
         for (int w = 0; w < kBitmapWords64; w++) {
           uint64_t word = rd64(data + 8 * w);
+          if (word && n_out + __builtin_popcountll(word) > cap) return -1;
           while (word) {
             int b = __builtin_ctzll(word);
             out[n_out++] = base + (static_cast<uint64_t>(w) << 6) + b;
@@ -120,13 +217,23 @@ int64_t roaring_decode(const uint8_t* buf, int64_t len, uint64_t* out,
         }
         break;
       }
-      case kTypeRun: {
+      case kTypeRun:
+      case kTypeRunOfficial: {
         if (m.off + 2 > len) return -1;
         int run_n = rd16(data);
         if (m.off + 2 + 4LL * run_n > len) return -1;
         for (int r = 0; r < run_n; r++) {
           uint16_t start = rd16(data + 2 + 4 * r);
-          uint16_t last = rd16(data + 2 + 4 * r + 2);
+          uint32_t last = rd16(data + 2 + 4 * r + 2);
+          if (m.typ == kTypeRunOfficial) {
+            // Official spec stores (start, length): last = start + len
+            // (officialRoaringIterator conversion, roaring.go:1404).
+            last += start;
+            if (last > 0xFFFF) return -1;
+          }
+          if (last >= start &&
+              n_out + (static_cast<int64_t>(last) - start + 1) > cap)
+            return -1;
           for (uint32_t v = start; v <= last; v++) out[n_out++] = base + v;
         }
         break;

@@ -130,6 +130,17 @@ class LocalClient:
         (client.go:71 RetrieveShardFromURI)."""
         return self._peer(node).handle_fragment_data(index, field, view, shard)
 
+    def fetch_fragment_chunks(self, node, index, field, view, shard):
+        """Streamed variant: bounded roaring blobs via the row cursor."""
+        after = 0
+        while True:
+            blob, next_row = self._peer(node).handle_fragment_data_range(
+                index, field, view, shard, after)
+            yield blob
+            if next_row is None:
+                return
+            after = next_row
+
     def probe(self, node) -> None:
         """Liveness probe (the /version check of confirmNodeDown)."""
         self._peer(node)

@@ -147,6 +147,13 @@ class ClusterNode:
             raise LookupError(f"fragment not found: {index}/{field}/{view}/{shard}")
         return frag.to_roaring()
 
+    def handle_fragment_data_range(self, index, field, view, shard,
+                                   after: int):
+        frag = self.holder.fragment(index, field, view, shard)
+        if frag is None:
+            raise LookupError(f"fragment not found: {index}/{field}/{view}/{shard}")
+        return frag.to_roaring_range(after)
+
     def handle_schema(self):
         return self.holder.schema()
 

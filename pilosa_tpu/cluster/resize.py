@@ -83,21 +83,30 @@ def apply_resize_instruction(holder, client, cluster: Cluster,
         if node is None:
             raise ConnectionError(
                 f"resize source {src.source_node!r} unknown")
-        data = client.fetch_fragment(node, src.index, src.field, src.view,
-                                     src.shard)
         f = holder.field(src.index, src.field)
         if f is None:
             raise LookupError(
                 f"resize target field missing: {src.index}/{src.field}")
-        f.import_roaring(src.shard, data, view=src.view)
+        # Streamed: bounded chunks merge one by one, so a multi-GB
+        # fragment never lives whole in either process's memory.
+        for chunk in client.fetch_fragment_chunks(node, src.index, src.field,
+                                                  src.view, src.shard):
+            f.import_roaring(src.shard, chunk, view=src.view)
 
 
 def apply_cluster_status(cluster: Cluster, nodes_json: list[dict],
-                         holder=None, availability: dict | None = None) -> None:
+                         holder=None, availability: dict | None = None,
+                         replica_n: int | None = None,
+                         partition_n: int | None = None) -> None:
     """mergeClusterStatus (cluster.go:1943): adopt a broadcast topology
     and, like the reference's NodeStatus, the sender's per-field shard
     availability so new members can route queries for shards they don't
-    hold locally."""
+    hold locally. replica_n/partition_n ride along so a joiner booted
+    with mismatched settings can't silently compute a different ring."""
+    if replica_n:
+        cluster.replica_n = int(replica_n)
+    if partition_n:
+        cluster.partition_n = int(partition_n)
     cluster.nodes = sorted(
         (Node(id=n["id"],
               uri=URI(scheme=n["uri"].get("scheme", "http"),
@@ -212,6 +221,8 @@ class ResizeJob:
             # adopt it locally.
             status = {"type": "cluster-status",
                       "nodes": [n.to_json() for n in new_nodes],
+                      "replicaN": self.cluster.replica_n,
+                      "partitionN": self.cluster.partition_n,
                       "availability": holder_availability(self.holder)}
             for node in new_nodes:
                 if node.id != self.cluster.local_id:

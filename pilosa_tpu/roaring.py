@@ -24,6 +24,9 @@ import struct
 import numpy as np
 
 MAGIC = 12348
+#: official RoaringFormatSpec cookies (reference roaring.go:5310-5313).
+OFFICIAL_NO_RUNS = 12346
+OFFICIAL_RUNS = 12347
 HEADER = struct.Struct("<II")
 META = struct.Struct("<QHH")
 
@@ -37,12 +40,14 @@ CONTAINER_BITS = 1 << 16
 
 
 def decode(buf: bytes) -> np.ndarray:
-    """Serialized roaring bitmap -> sorted uint64 positions."""
+    """Serialized roaring bitmap -> sorted uint64 positions. Accepts the
+    pilosa variant (cookie 12348) and the official RoaringFormatSpec
+    (12346/12347 — standard 32-bit roaring files)."""
     if len(buf) < HEADER.size:
         raise ValueError("roaring: buffer too small")
     cookie, count = HEADER.unpack_from(buf, 0)
     if cookie & 0xFFFF != MAGIC:
-        raise ValueError(f"roaring: bad cookie {cookie & 0xFFFF}")
+        return decode_official(buf)
     metas = []
     off = HEADER.size
     for _ in range(count):
@@ -70,6 +75,112 @@ def decode(buf: bytes) -> np.ndarray:
                 out.append(base + np.arange(start, last + 1, dtype=np.uint64))
         else:
             raise ValueError(f"roaring: unknown container type {typ}")
+    if not out:
+        return np.empty(0, dtype=np.uint64)
+    return np.concatenate(out)
+
+
+def decode_official(buf: bytes) -> np.ndarray:
+    """Official RoaringFormatSpec (32-bit roaring) -> uint64 positions.
+
+    Layout (readOfficialHeader behavior, roaring.go:5316-5374): cookie
+    12346 = [u32 cookie][u32 size], 12347 = [u16 cookie | (size-1)<<16]
+    [run bitmap]; then size x (u16 key, u16 card-1); an offset header
+    unless (runs and size < 4) — without it containers are sequential.
+    Containers are typed by cardinality (array < 4096 else bitmap) plus
+    the run bitmap; official runs are (start, LENGTH) pairs.
+    """
+    (cookie,) = struct.unpack_from("<I", buf, 0)
+    pos = 4
+    run_bitmap = None
+    if cookie == OFFICIAL_NO_RUNS:
+        if len(buf) < 8:
+            raise ValueError("roaring: buffer too small")
+        (size,) = struct.unpack_from("<I", buf, 4)
+        pos = 8
+    elif cookie & 0xFFFF == OFFICIAL_RUNS:
+        size = (cookie >> 16) + 1
+        rb = (size + 7) // 8
+        if pos + rb > len(buf):
+            raise ValueError("roaring: run bitmap overruns buffer")
+        run_bitmap = np.unpackbits(
+            np.frombuffer(buf, dtype=np.uint8, count=rb, offset=pos),
+            bitorder="little")
+        pos += rb
+    else:
+        raise ValueError(f"roaring: bad cookie {cookie & 0xFFFF}")
+    if size > (1 << 16):
+        raise ValueError("roaring: impossible container count")
+    hdr = pos
+    if pos + 4 * size > len(buf):
+        raise ValueError("roaring: descriptive header overruns buffer")
+    pos += 4 * size
+    offsets = None
+    if run_bitmap is None or size >= 4:
+        if pos + 4 * size > len(buf):
+            raise ValueError("roaring: offset header overruns buffer")
+        offsets = np.frombuffer(buf, dtype="<u4", count=size, offset=pos)
+        pos += 4 * size
+        # Containers are sequential and non-overlapping in the official
+        # layout; aliased/decreasing offsets are adversarial (they let a
+        # tiny buffer emit unbounded data).
+        if len(offsets) and (int(offsets[0]) < pos
+                             or (np.diff(offsets.astype(np.int64)) <= 0).any()):
+            raise ValueError("roaring: offsets not strictly increasing")
+    data_off = pos
+    out = []
+    emitted = 0
+    # Allocation-DoS bound (mirrors the native decoder's): offsets can
+    # all alias one payload, so the emitted total — not the buffer size —
+    # must be capped before arrays materialize.
+    max_emit = len(buf) * 16384 + 65536
+    for i in range(size):
+        key, n1 = struct.unpack_from("<HH", buf, hdr + 4 * i)
+        n = n1 + 1
+        base = np.uint64(key) << np.uint64(16)
+        is_run = run_bitmap is not None and bool(run_bitmap[i])
+        off = int(offsets[i]) if offsets is not None else data_off
+        if is_run:
+            if off + 2 > len(buf):
+                raise ValueError("roaring: run header overruns buffer")
+            (run_n,) = struct.unpack_from("<H", buf, off)
+            if off + 2 + 4 * run_n > len(buf):
+                raise ValueError("roaring: runs overrun buffer")
+            runs = np.frombuffer(buf, dtype="<u2", count=run_n * 2,
+                                 offset=off + 2).reshape(-1, 2)
+            for start, length in runs.tolist():
+                if start + length > 0xFFFF:
+                    raise ValueError("roaring: run exceeds container")
+                emitted += length + 1
+                if emitted > max_emit:
+                    raise ValueError("roaring: emitted count exceeds bound")
+                out.append(base + np.arange(start, start + length + 1,
+                                            dtype=np.uint64))
+            data_off = off + 2 + 4 * run_n
+        elif n <= ARRAY_MAX:
+            # <=: official writers keep arrays up to EXACTLY 4096 values
+            # (one would decode as 8192 bytes — a bitmap's size — so an
+            # off-by-one here misreads valid files silently).
+            if off + 2 * n > len(buf):
+                raise ValueError("roaring: array overruns buffer")
+            vals = np.frombuffer(buf, dtype="<u2", count=n, offset=off)
+            emitted += n
+            if emitted > max_emit:
+                raise ValueError("roaring: emitted count exceeds bound")
+            out.append(base + vals.astype(np.uint64))
+            data_off = off + 2 * n
+        else:
+            if off + 8 * (CONTAINER_BITS // 64) > len(buf):
+                raise ValueError("roaring: bitmap overruns buffer")
+            words = np.frombuffer(buf, dtype="<u8",
+                                  count=CONTAINER_BITS // 64, offset=off)
+            bits = np.unpackbits(words.view(np.uint8), bitorder="little")
+            vals = np.nonzero(bits)[0].astype(np.uint64)
+            emitted += len(vals)
+            if emitted > max_emit:
+                raise ValueError("roaring: emitted count exceeds bound")
+            out.append(base + vals)
+            data_off = off + 8 * (CONTAINER_BITS // 64)
     if not out:
         return np.empty(0, dtype=np.uint64)
     return np.concatenate(out)

@@ -117,11 +117,49 @@ class HTTPInternalClient:
         except (urllib.error.URLError, OSError) as e:
             raise ConnectionError(f"node {node.id} unreachable: {e}") from e
 
+    def fetch_fragment_chunks(self, node, index, field, view, shard):
+        """Streamed fragment transfer: yields bounded roaring blobs via
+        the after-row cursor, so neither side ever materializes a whole
+        multi-GB fragment (reference WriteTo/ReadFrom tar stream,
+        fragment.go:2436-2557)."""
+        after = 0
+        while True:
+            req = urllib.request.Request(self._url(
+                node, f"/internal/fragment/data?index={index}"
+                      f"&field={field}&view={view}&shard={shard}"
+                      f"&after={after}"))
+            try:
+                with urllib.request.urlopen(req,
+                                            timeout=self.timeout) as resp:
+                    data = resp.read()
+                    next_row = resp.headers.get("X-Pilosa-Next-Row", "")
+            except urllib.error.HTTPError as e:
+                raise LookupError(
+                    f"{node.id}: {e.read().decode(errors='replace')}")
+            except (urllib.error.URLError, OSError) as e:
+                raise ConnectionError(
+                    f"node {node.id} unreachable: {e}") from e
+            yield data
+            if not next_row:
+                return
+            after = int(next_row)
+
+    #: liveness probes use their own short timeout — the general 30s
+    #: request timeout would make a blackholed peer stall every
+    #: failure-detector sweep for minutes (memberlist probes are
+    #: sub-second; confirmNodeDown cluster.go:1724 retries fast).
+    PROBE_TIMEOUT = 2.0
+
     def probe(self, node) -> None:
+        url = self._url(node, "/version")
         try:
-            self._request(node, "GET", "/version")
-        except (RuntimeError, LookupError):
+            with urllib.request.urlopen(
+                    url, timeout=min(self.PROBE_TIMEOUT, self.timeout)):
+                pass
+        except urllib.error.HTTPError:
             pass  # alive but unhappy still counts as alive
+        except (urllib.error.URLError, OSError) as e:
+            raise ConnectionError(f"node {node.id} unreachable: {e}") from e
 
     def translate_keys(self, node, index, field, keys):
         body = json.dumps({"index": index, "field": field,

@@ -82,8 +82,13 @@ def _make_handler(api: API):
                 fn = methods.get(method)
                 if fn is None:
                     continue
+                headers = None
                 try:
-                    status, payload = fn(m.groupdict(), params, body)
+                    out = fn(m.groupdict(), params, body)
+                    if len(out) == 3:  # optional extra response headers
+                        status, payload, headers = out
+                    else:
+                        status, payload = out
                 except _CONFLICTS as e:
                     status, payload = 409, {"error": str(e)}
                 except _NOT_FOUND as e:
@@ -92,10 +97,10 @@ def _make_handler(api: API):
                     status, payload = 400, {"error": str(e)}
                 except Exception as e:  # pragma: no cover
                     status, payload = 500, {"error": f"internal: {e}"}
-                return self._reply(status, payload)
+                return self._reply(status, payload, headers)
             self._reply(404, {"error": "not found"})
 
-        def _reply(self, status: int, payload):
+        def _reply(self, status: int, payload, headers=None):
             if isinstance(payload, (dict, list)):
                 data = (json.dumps(payload) + "\n").encode()
                 ctype = "application/json"
@@ -108,6 +113,8 @@ def _make_handler(api: API):
             self.send_response(status)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, str(v))
             self.end_headers()
             self.wfile.write(data)
 
@@ -269,6 +276,10 @@ def _build_routes(api: API):
                                    params["view"], int(params["shard"]))
         if frag is None:
             raise FragmentNotFoundError()
+        if "after" in params:  # streaming cursor (one bounded chunk)
+            blob, next_row = frag.to_roaring_range(int(params["after"]))
+            return 200, blob, {"X-Pilosa-Next-Row": ""
+                               if next_row is None else next_row}
         return 200, frag.to_roaring()
 
     def post_resize_abort(pv, params, body):

@@ -95,6 +95,8 @@ class ServerNode:
         self._sync_timer: threading.Timer | None = None
         self._check_timer: threading.Timer | None = None
         self._closed = False
+        #: one resize job at a time (reference cluster.go:1447).
+        self._resize_gate = threading.Lock()
         self._anti_entropy_interval = (
             self.DEFAULT_ANTI_ENTROPY_INTERVAL
             if anti_entropy_interval is None else anti_entropy_interval)
@@ -148,15 +150,23 @@ class ServerNode:
             for _ in range(self.JOIN_RETRIES):
                 if self._closed:
                     return
+                # Success = this node appears in the ring (the topology
+                # broadcast landed), NOT merely a delivered announce —
+                # the coordinator's resize runs asynchronously and can
+                # fail after accepting.
+                if len(self.cluster.nodes) > 1:
+                    return
                 try:
                     self.cluster.client.send_message(
                         seed, {"type": "node-join", "addr": self.id})
-                    return
                 except (ConnectionError, RuntimeError):
-                    time.sleep(self.JOIN_RETRY_DELAY)
+                    pass
+                time.sleep(self.JOIN_RETRY_DELAY)
+            if len(self.cluster.nodes) > 1:
+                return
             import sys
-            print(f"join: could not reach seed {self.join_addr} after "
-                  f"{self.JOIN_RETRIES} attempts", file=sys.stderr)
+            print(f"join: cluster at {self.join_addr} did not admit us "
+                  f"after {self.JOIN_RETRIES} attempts", file=sys.stderr)
 
         t = threading.Thread(target=announce, name="join-announce",
                              daemon=True)
@@ -259,7 +269,9 @@ class ServerNode:
             from pilosa_tpu.cluster.resize import apply_cluster_status
             apply_cluster_status(self.cluster, message["nodes"],
                                  holder=self.holder,
-                                 availability=message.get("availability"))
+                                 availability=message.get("availability"),
+                                 replica_n=message.get("replicaN"),
+                                 partition_n=message.get("partitionN"))
         elif t == "node-join" and self.cluster is not None:
             self.handle_join(message["addr"])
         else:
@@ -280,12 +292,29 @@ class ServerNode:
             return "FORWARDED"
         if self.cluster.node_by_id(addr) is not None:
             return "ALREADY_MEMBER"
-        return self.resize("add", addr=addr)
+        # Run the (possibly long) data-moving resize OFF the request
+        # thread: the joiner's announce would otherwise time out on big
+        # transfers and its retry would race a second job. The gate
+        # makes duplicate/overlapping announces no-ops.
+        if self._resize_gate.locked():
+            return "RESIZING"
+
+        def run():
+            try:
+                self.resize("add", addr=addr)
+            except (RuntimeError, ConnectionError, ValueError):
+                pass  # joiner keeps announcing; next attempt retries
+
+        threading.Thread(target=run, name="join-resize",
+                         daemon=True).start()
+        return "STARTED"
 
     def resize(self, action: str, node_id: str | None = None,
                addr: str | None = None) -> str:
         """Coordinator-driven membership change (api.go RemoveNode :1220;
-        node addition = reference's join-triggered resize)."""
+        node addition = reference's join-triggered resize). ONE job at a
+        time (the reference's single-job state machine,
+        cluster.go:1447): a second request while one runs is rejected."""
         if self.cluster is None:
             raise RuntimeError("standalone node cannot resize")
         from pilosa_tpu.cluster.node import URI, Node
@@ -299,9 +328,14 @@ class ServerNode:
             new_nodes.append(Node(id=addr, uri=URI(host=h, port=int(p))))
         else:
             raise ValueError(f"unknown resize action {action!r}")
-        job = ResizeJob(self.cluster, self.holder, self.cluster.client)
-        self.api.resize_job = job
-        return job.run(new_nodes)
+        if not self._resize_gate.acquire(blocking=False):
+            raise RuntimeError("resize already in progress")
+        try:
+            job = ResizeJob(self.cluster, self.holder, self.cluster.client)
+            self.api.resize_job = job
+            return job.run(new_nodes)
+        finally:
+            self._resize_gate.release()
 
     def handle_internal_import(self, req: dict) -> None:
         """JSON /internal/import payloads: fragment-level (anti-entropy

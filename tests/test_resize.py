@@ -323,3 +323,53 @@ def test_autonomous_recovery_after_restart():
                 n.close()
             except Exception:
                 pass
+
+
+def test_streaming_fragment_transfer_constant_memory(monkeypatch, rng):
+    """Resize streams fragments in bounded chunks: a fragment larger
+    than the chunk budget arrives whole, and no single transfer blob
+    ever exceeds the budget (VERDICT r2 missing #5)."""
+    import numpy as np
+    from pilosa_tpu.core.fragment import Fragment
+
+    monkeypatch.setattr(Fragment, "TRANSFER_CHUNK_BITS", 2048)
+    lc = LocalCluster(2)
+    lc.create_index("i")
+    lc.create_field("i", "f")
+    # ~20k bits over 40 rows in shard 0 >> 2048-bit chunks.
+    rows = rng.integers(0, 40, 20_000).astype(np.uint64)
+    cols = rng.integers(0, SHARD_WIDTH, 20_000).astype(np.uint64)
+    owner = lc[0].cluster.shard_nodes("i", 0)[0]
+    src_node = lc.client.peers[owner.id]
+    src_node.handle_import_request("i", "f", rows=rows, cols=cols)
+    frag = src_node.holder.fragment("i", "f", "standard", 0)
+    total_bits = frag.bit_count()
+    assert total_bits > 8 * 2048
+
+    # Spy on the chunk sizes the cursor yields.
+    sizes = []
+    orig = Fragment.to_roaring_range
+
+    def spy(self, start_row=0, max_bits=None):
+        blob, nxt = orig(self, start_row, max_bits)
+        from pilosa_tpu import native
+        sizes.append(len(native.decode_roaring(blob)))
+        return blob, nxt
+
+    monkeypatch.setattr(Fragment, "to_roaring_range", spy)
+
+    other = [cn for cn in lc.nodes if cn.id != owner.id][0]
+    from pilosa_tpu.cluster.resize import ResizeSource, apply_resize_instruction
+    from dataclasses import asdict
+    src = ResizeSource(source_node=owner.id, index="i", field="f",
+                       view="standard", shard=0)
+    apply_resize_instruction(other.holder, lc.client, other.cluster,
+                             [asdict(src)])
+    got = other.holder.fragment("i", "f", "standard", 0)
+    assert got is not None and got.bit_count() == total_bits
+    for r in range(40):
+        np.testing.assert_array_equal(got.row_words(r), frag.row_words(r))
+    assert len(sizes) > 4                      # really chunked
+    # Each chunk bounded: budget + at most one whole row's overshoot.
+    assert max(sizes) <= 2048 + SHARD_WIDTH
+    assert sum(sizes) == total_bits            # no loss, no duplication

@@ -178,3 +178,30 @@ def test_two_node_http_cluster():
     finally:
         a.close()
         b.close()
+
+
+def test_fragment_data_streaming_cursor(node):
+    """/internal/fragment/data with after= returns bounded chunks plus
+    the X-Pilosa-Next-Row cursor header."""
+    from pilosa_tpu.core.fragment import Fragment
+    from pilosa_tpu import native
+    b = node.address
+    req(b, "POST", "/index/i", "{}")
+    req(b, "POST", "/index/i/field/f", "{}")
+    body = json.dumps({"rowIDs": [0] * 600 + [1] * 600 + [2] * 600,
+                       "columnIDs": (list(range(600)) * 3)})
+    req(b, "POST", "/index/i/field/f/import", body)
+    old = Fragment.TRANSFER_CHUNK_BITS
+    Fragment.TRANSFER_CHUNK_BITS = 512
+    try:
+        from pilosa_tpu.server.httpclient import HTTPInternalClient
+        from pilosa_tpu.cluster.node import Node as CNode, URI
+        client = HTTPInternalClient()
+        peer = CNode(id=node.id, uri=URI(host=node.host, port=node.port))
+        chunks = list(client.fetch_fragment_chunks(peer, "i", "f",
+                                                   "standard", 0))
+        assert len(chunks) == 3            # one row per 512-bit chunk
+        total = sum(len(native.decode_roaring(c)) for c in chunks)
+        assert total == 1800
+    finally:
+        Fragment.TRANSFER_CHUNK_BITS = old
