@@ -143,19 +143,34 @@ class Cluster:
         result = None
         pending = list(shards)
         pql = str(c)  # serialize the node-boundary query once
+        # The fan-out pool's threads don't inherit contextvars; carry
+        # the active trace id into them so remote sub-queries join it.
+        from pilosa_tpu.obs import tracing
+        tid = tracing.current_trace_id()
+
+        def _with_trace(fn):
+            if tid is None:
+                return fn()
+            token = tracing.set_current_trace(tid)
+            try:
+                return fn()
+            finally:
+                tracing.reset_current_trace(token)
 
         def run_local(node_shards: list[int]):
-            if local_batch_fn is not None:
-                return local_batch_fn(node_shards)
-            acc = None
-            for shard in node_shards:
-                acc = reduce_fn(acc, map_fn(shard))
-            return acc
+            def go():
+                if local_batch_fn is not None:
+                    return local_batch_fn(node_shards)
+                acc = None
+                for shard in node_shards:
+                    acc = reduce_fn(acc, map_fn(shard))
+                return acc
+            return _with_trace(go)
 
         def run_remote(node_id: str, node_shards: list[int]):
             node = self.node_by_id(node_id)
-            return self.client.query_node(node, idx.name, pql, node_shards,
-                                          remote=True)[0]
+            return _with_trace(lambda: self.client.query_node(
+                node, idx.name, pql, node_shards, remote=True)[0])
 
         while pending:
             groups = self.shards_by_node(nodes, idx.name, pending)

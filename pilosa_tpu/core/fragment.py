@@ -100,6 +100,9 @@ class Fragment:
         #: generation-stamped (gen, ids, counts) sorted by count desc —
         #: see top_counts().
         self._top_cache: tuple | None = None
+        #: generation-stamped concatenated sparse-row index — see
+        #: _sparse_index().
+        self._sparse_cache: tuple | None = None
         self._lock = threading.RLock()
         # device caches: row_id -> (gen, jax.Array[W]); stack key -> (gen, ids, jax.Array[n, W])
         self._dev_rows: dict[int, tuple[int, jax.Array]] = {}
@@ -515,37 +518,54 @@ class Fragment:
         seg = seg if isinstance(seg, jax.Array) else jnp.asarray(seg)
         out = np.zeros(len(ids), dtype=np.int64)
         parts: list[tuple[np.ndarray, jax.Array]] = []
+        ids_arr = np.asarray(ids, dtype=np.int64)
         with self._lock:
-            sparse_pos: list[np.ndarray] = []
-            sparse_slots: list[int] = []
+            s_ids, concat, starts, lens = self._sparse_index()
             dense_ids: list[int] = []
             dense_slots: list[int] = []
-            for i, r in enumerate(ids):
-                hr = self.rows.get(r)
-                if hr is None:
-                    continue  # count stays 0
-                if hr.is_dense:
-                    dense_ids.append(r)
+            if len(s_ids):
+                at = np.searchsorted(s_ids, ids_arr)
+                at_c = np.minimum(at, len(s_ids) - 1)
+                is_sparse = s_ids[at_c] == ids_arr
+            else:
+                at_c = np.zeros(len(ids_arr), dtype=np.int64)
+                is_sparse = np.zeros(len(ids_arr), dtype=bool)
+            for i in np.flatnonzero(~is_sparse).tolist():
+                hr = self.rows.get(ids[i])
+                if hr is not None and hr.is_dense:
+                    dense_ids.append(ids[i])
                     dense_slots.append(i)
-                else:
-                    p = hr.to_positions()
-                    if len(p):  # empty rows (post clear/steal) count 0
-                        sparse_pos.append(p)
-                        sparse_slots.append(i)
+                # else: absent/empty row, count stays 0
 
-            if sparse_pos:
+            sparse_slots = np.flatnonzero(is_sparse)
+            if len(sparse_slots):
                 if seg_host is None:
                     seg_host = np.asarray(seg, dtype=np.uint32)
-                lens = np.fromiter((len(p) for p in sparse_pos),
-                                   dtype=np.int64, count=len(sparse_pos))
-                pos = np.concatenate(sparse_pos)
+                sel = at_c[sparse_slots]
+                if len(sel) == len(s_ids) and np.array_equal(
+                        sel, np.arange(len(s_ids))):
+                    pos = concat            # whole-index sweep: no gather
+                    offsets = starts
+                else:
+                    l_sel = lens[sel]
+                    s_sel = starts[sel]
+                    total = int(l_sel.sum())
+                    # Ragged gather without a per-row loop: ones with
+                    # jumps at group heads, cumsum = flat indices.
+                    step = np.ones(total, dtype=np.int64)
+                    head = np.zeros(len(l_sel), dtype=np.int64)
+                    np.cumsum(l_sel[:-1], out=head[1:])
+                    step[head[0]] = s_sel[0]
+                    if len(l_sel) > 1:
+                        step[head[1:]] = (s_sel[1:] - s_sel[:-1]
+                                          - l_sel[:-1] + 1)
+                    pos = concat[np.cumsum(step)]
+                    offsets = head
                 word = (pos >> np.uint64(5)).astype(np.int64)
                 bit = np.left_shift(
                     np.uint32(1), (pos & np.uint64(31)).astype(np.uint32))
                 hits = ((seg_host[word] & bit) != 0).astype(np.int64)
                 # All lens > 0, so every reduceat offset is < len(hits).
-                offsets = np.zeros(len(lens), dtype=np.int64)
-                np.cumsum(lens[:-1], out=offsets[1:])
                 out[sparse_slots] = np.add.reduceat(hits, offsets)
 
             if dense_ids:
@@ -582,6 +602,35 @@ class Fragment:
                             (dense_slots_a[lo:lo + len(chunk)],
                              pallas_kernels.pair_count(arr, seg, "and")))
         return out, parts
+
+    def _sparse_index(self):
+        """(row_ids, concat_positions, starts, lens) over every non-empty
+        SPARSE row, cached per generation — the batched count paths'
+        replacement for per-row position materialization (one build per
+        mutation, then every TopN/GroupBy sweep is pure vectorized
+        numpy). Caller must hold the fragment lock."""
+        if self._sparse_cache is not None and \
+                self._sparse_cache[0] == self.generation:
+            return self._sparse_cache[1:]
+        ids: list[int] = []
+        bufs: list[np.ndarray] = []
+        for rid in sorted(self.rows):
+            hr = self.rows[rid]
+            if hr.is_dense or hr.n == 0:
+                continue
+            hr._flush()
+            ids.append(rid)
+            bufs.append(hr.positions)  # no copy: generation guards reuse
+        ids_a = np.asarray(ids, dtype=np.int64)
+        lens = np.fromiter((len(b) for b in bufs), dtype=np.int64,
+                           count=len(bufs))
+        concat = (np.concatenate(bufs) if bufs
+                  else np.empty(0, dtype=np.uint64))
+        starts = np.zeros(len(lens), dtype=np.int64)
+        if len(lens) > 1:
+            np.cumsum(lens[:-1], out=starts[1:])
+        self._sparse_cache = (self.generation, ids_a, concat, starts, lens)
+        return ids_a, concat, starts, lens
 
     def row_counts(self) -> tuple[np.ndarray, np.ndarray]:
         """(row_ids, counts), cached per generation — the exact

@@ -8,10 +8,12 @@ intentionally NOT implemented (always off).
 """
 
 from pilosa_tpu.obs.logger import Logger, NopLogger, StandardLogger
+from pilosa_tpu.obs.runtime import RuntimeMonitor, collect_runtime_gauges
 from pilosa_tpu.obs.stats import (
     MemoryStats,
     NopStats,
     StatsClient,
+    StatsdStats,
     prometheus_text,
 )
 from pilosa_tpu.obs.tracing import (
@@ -19,6 +21,7 @@ from pilosa_tpu.obs.tracing import (
     SimpleTracer,
     Span,
     Tracer,
+    current_trace_id,
     get_tracer,
     set_tracer,
     start_span,
@@ -26,7 +29,9 @@ from pilosa_tpu.obs.tracing import (
 
 __all__ = [
     "Logger", "NopLogger", "StandardLogger",
-    "MemoryStats", "NopStats", "StatsClient", "prometheus_text",
+    "MemoryStats", "NopStats", "StatsClient", "StatsdStats",
+    "prometheus_text",
+    "RuntimeMonitor", "collect_runtime_gauges",
     "NopTracer", "SimpleTracer", "Span", "Tracer",
-    "get_tracer", "set_tracer", "start_span",
+    "current_trace_id", "get_tracer", "set_tracer", "start_span",
 ]

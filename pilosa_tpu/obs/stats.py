@@ -74,6 +74,48 @@ class MemoryStats:
         return self.counters.get((name, tuple(sorted(tags))), 0)
 
 
+class StatsdStats:
+    """Fire-and-forget UDP statsd backend (reference statsd/statsd.go;
+    tags use the datadog-style ``|#k:v`` suffix). Wraps every send in a
+    broad except — metrics must never take the node down — and shares
+    one socket across tag children."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8125,
+                 prefix: str = "pilosa.", tags: tuple[str, ...] = (),
+                 _parent=None):
+        self.tags = tags
+        if _parent is None:
+            import socket
+            self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            self._addr = (host, int(port))
+            self.prefix = prefix
+        else:
+            self._sock = _parent._sock
+            self._addr = _parent._addr
+            self.prefix = _parent.prefix
+
+    def with_tags(self, *tags: str) -> "StatsdStats":
+        return StatsdStats(tags=tuple(sorted(set(self.tags) | set(tags))),
+                           _parent=self)
+
+    def _send(self, payload: str) -> None:
+        try:
+            if self.tags:
+                payload += "|#" + ",".join(self.tags)
+            self._sock.sendto(payload.encode(), self._addr)
+        except OSError:
+            pass
+
+    def count(self, name: str, value: int = 1, rate: float = 1.0) -> None:
+        self._send(f"{self.prefix}{name}:{value}|c")
+
+    def gauge(self, name: str, value: float) -> None:
+        self._send(f"{self.prefix}{name}:{value}|g")
+
+    def timing(self, name: str, seconds: float) -> None:
+        self._send(f"{self.prefix}{name}:{seconds * 1e3:.3f}|ms")
+
+
 def _fmt_labels(tags: tuple[str, ...]) -> str:
     if not tags:
         return ""

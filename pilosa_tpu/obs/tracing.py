@@ -9,12 +9,49 @@ OTLP exporter would implement the same two-method interface.
 from __future__ import annotations
 
 import contextlib
+import contextvars
+import itertools
+import os
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Protocol
 
 TRACE_HEADER = "X-Pilosa-Trace-Id"
+
+#: the active trace correlation id, carried across node boundaries via
+#: TRACE_HEADER (reference InjectHTTPHeaders/ExtractHTTPHeaders,
+#: tracing.go:37-49 + the http client's span injection).
+_current_trace: contextvars.ContextVar[str | None] = \
+    contextvars.ContextVar("pilosa_trace", default=None)
+_trace_seq = itertools.count(1)
+_trace_prefix = f"{os.getpid():x}"
+
+
+def current_trace_id() -> str | None:
+    return _current_trace.get()
+
+
+def set_current_trace(trace_id: str | None):
+    """Returns a token for contextvars reset."""
+    return _current_trace.set(trace_id)
+
+
+def reset_current_trace(token) -> None:
+    _current_trace.reset(token)
+
+
+def inject_http_headers(headers: dict) -> dict:
+    """Attach the active trace id to outgoing node-to-node requests."""
+    tid = _current_trace.get()
+    if tid:
+        headers[TRACE_HEADER] = tid
+    return headers
+
+
+def extract_http_headers(headers) -> str | None:
+    """Read a propagated trace id from incoming request headers."""
+    return headers.get(TRACE_HEADER)
 
 
 class Span(Protocol):
@@ -96,9 +133,20 @@ def get_tracer() -> Tracer:
 @contextlib.contextmanager
 def start_span(operation: str, parent_id: str | None = None):
     """with start_span("executor.Execute"): ... — the
-    StartSpanFromContext analog used at executor/API boundaries."""
+    StartSpanFromContext analog used at executor/API boundaries. Spans
+    join the active cross-node trace (starting one if absent) and tag
+    themselves with its id, so a query's spans correlate across every
+    node it touched."""
+    tid = _current_trace.get()
+    token = None
+    if tid is None:
+        tid = f"{_trace_prefix}-{next(_trace_seq)}"
+        token = _current_trace.set(tid)
     span = _global.start_span(operation, parent_id)
+    span.set_tag("trace.id", tid)
     try:
         yield span
     finally:
         span.finish()
+        if token is not None:
+            _current_trace.reset(token)

@@ -85,6 +85,9 @@ class MeshPlanner:
         #: Count pull goes through it, so concurrent queries share one
         #: stacked device->host transfer per wave.
         self.batcher = TransferBatcher()
+        #: tiny host-side filter cache for TopN's two passes (keyed by
+        #: call text + shards + epoch; each pull is a link round-trip).
+        self._filter_host_cache: dict[tuple, np.ndarray] = {}
 
     # ------------------------------------------------------------------
     # public API
@@ -261,9 +264,22 @@ class MeshPlanner:
         if filter_call is not None:
             filt = self._tree_stack(idx, filter_call, shards)  # [S_pad, W]
             # ONE pull of the filter for every shard's sparse host tier
-            # (per-shard pulls each cost a link round-trip).
-            filt.copy_to_host_async()
-            filt_host = np.asarray(filt, dtype=np.uint32)
+            # (per-shard pulls each cost a link round-trip), cached
+            # across TopN's two passes (same filter, same epoch).
+            fkey = (idx.name, idx.instance_id, str(filter_call),
+                    tuple(shards), idx.epoch.value)
+            with self._cache_lock:
+                hit = self._filter_host_cache.get(fkey)
+            if hit is not None:
+                filt_host = hit
+            else:
+                filt.copy_to_host_async()
+                filt_host = np.asarray(filt, dtype=np.uint32)
+                with self._cache_lock:
+                    self._filter_host_cache[fkey] = filt_host
+                    while len(self._filter_host_cache) > 4:
+                        self._filter_host_cache.pop(
+                            next(iter(self._filter_host_cache)))
         pending: list[tuple[int, np.ndarray, np.ndarray, list]] = []
         for si, shard in enumerate(shards):
             frag = self.holder.fragment(idx.name, field_name, view, shard)
@@ -376,7 +392,15 @@ class MeshPlanner:
     def invalidate(self) -> None:
         with self._cache_lock:
             self._stack_cache.clear()
+            self._filter_host_cache.clear()
             self._cache_bytes = 0
+
+    def cache_stats(self) -> dict:
+        """Locked snapshot of HBM-cache occupancy for monitoring."""
+        with self._cache_lock:
+            return {"bytes": self._cache_bytes,
+                    "budget_bytes": self.max_cache_bytes,
+                    "entries": len(self._stack_cache)}
 
     # ------------------------------------------------------------------
     # tree → structural signature + leaf list

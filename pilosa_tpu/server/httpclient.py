@@ -32,6 +32,9 @@ class HTTPInternalClient:
                                      method=method)
         if body is not None:
             req.add_header("Content-Type", "application/json")
+        from pilosa_tpu.obs.tracing import inject_http_headers
+        for k, v in inject_http_headers({}).items():
+            req.add_header(k, v)
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 data = resp.read()

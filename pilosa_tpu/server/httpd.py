@@ -83,6 +83,10 @@ def _make_handler(api: API):
                 if fn is None:
                     continue
                 headers = None
+                # Join a propagated cross-node trace, if any.
+                from pilosa_tpu.obs import tracing as _tr
+                tid = _tr.extract_http_headers(self.headers)
+                token = _tr.set_current_trace(tid) if tid else None
                 try:
                     out = fn(m.groupdict(), params, body)
                     if len(out) == 3:  # optional extra response headers
@@ -97,6 +101,9 @@ def _make_handler(api: API):
                     status, payload = 400, {"error": str(e)}
                 except Exception as e:  # pragma: no cover
                     status, payload = 500, {"error": f"internal: {e}"}
+                finally:
+                    if token is not None:
+                        _tr.reset_current_trace(token)
                 return self._reply(status, payload, headers)
             self._reply(404, {"error": "not found"})
 

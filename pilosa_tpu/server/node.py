@@ -131,6 +131,10 @@ class ServerNode:
             self._schedule_sync()
         if self.cluster is not None and self._check_nodes_interval > 0:
             self._schedule_check_nodes()
+        from pilosa_tpu.obs.runtime import RuntimeMonitor
+        self.runtime_monitor = RuntimeMonitor(self.stats,
+                                              self.executor.planner)
+        self.runtime_monitor.start()
 
     #: join announcement retry schedule (seconds between attempts).
     JOIN_RETRY_DELAY = 1.0
@@ -235,6 +239,8 @@ class ServerNode:
             self._sync_timer.cancel()
         if self._check_timer is not None:
             self._check_timer.cancel()
+        if getattr(self, "runtime_monitor", None) is not None:
+            self.runtime_monitor.close()
         if self.store is not None:
             self.store.close()
         self.http.close()

@@ -69,7 +69,8 @@ def test_start_span_contextmanager():
         with start_span("custom.op") as span:
             span.set_tag("k", "v")
         assert t.spans[0].operation == "custom.op"
-        assert t.spans[0].tags == {"k": "v"}
+        assert t.spans[0].tags["k"] == "v"
+        assert "trace.id" in t.spans[0].tags  # spans join a trace
     finally:
         set_tracer(NopTracer())
 
@@ -103,3 +104,97 @@ def test_metrics_endpoint():
         assert 'pilosa_Set{index="i"} 1' in text
     finally:
         n.close()
+
+
+def test_statsd_wire_format():
+    import socket
+    rx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    rx.bind(("127.0.0.1", 0))
+    rx.settimeout(5)
+    port = rx.getsockname()[1]
+    from pilosa_tpu.obs import StatsdStats
+    st = StatsdStats(host="127.0.0.1", port=port)
+    st.count("queries", 3)
+    st.gauge("heap", 12.5)
+    st.with_tags("index:i").timing("exec", 0.25)
+    got = sorted(rx.recv(512).decode() for _ in range(3))
+    assert got[0] == "pilosa.exec:250.000|ms|#index:i"
+    assert got[1] == "pilosa.heap:12.5|g"
+    assert got[2] == "pilosa.queries:3|c"
+    rx.close()
+
+
+def test_runtime_gauges():
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.obs import MemoryStats, collect_runtime_gauges
+    from pilosa_tpu.parallel import MeshPlanner, make_mesh
+    h = Holder()
+    idx = h.create_index("i")
+    f = idx.create_field("f")
+    f.import_bits([1] * 5, [0, 1, 2, 3, 4])
+    planner = MeshPlanner(h, make_mesh())
+    from pilosa_tpu.exec import Executor
+    Executor(h, planner=planner).execute("i", "Count(Row(f=1))")
+    stats = MemoryStats()
+    out = collect_runtime_gauges(stats, planner)
+    assert out["threads"] >= 1
+    assert out.get("rssBytes", 1) > 0
+    assert out["plannerCacheEntries"] >= 1
+    assert out["plannerCacheBytes"] > 0
+    assert stats.gauges[("runtime.plannerCacheBudgetBytes", ())] == \
+        planner.max_cache_bytes
+
+
+def test_trace_propagates_across_nodes():
+    """A remote sub-query's spans carry the coordinator's trace id
+    (reference InjectHTTPHeaders/ExtractHTTPHeaders, tracing.go:37)."""
+    import json
+    import urllib.request
+    from pilosa_tpu.obs import SimpleTracer, set_tracer, NopTracer
+    from pilosa_tpu.server.node import ServerNode
+    import socket
+
+    ports = []
+    for _ in range(2):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        s.close()
+    addrs = [f"127.0.0.1:{p}" for p in ports]
+    tracer = SimpleTracer()
+    set_tracer(tracer)
+    nodes = [ServerNode(bind=a, peers=[x for x in addrs if x != a],
+                        use_planner=False, anti_entropy_interval=0.0,
+                        check_nodes_interval=0.0) for a in addrs]
+    for n in nodes:
+        n.open()
+    try:
+        base = nodes[0].address
+
+        def post(path, body=""):
+            r = urllib.request.Request(base + path, data=body.encode(),
+                                       method="POST")
+            return json.loads(urllib.request.urlopen(r, timeout=10).read()
+                              or b"{}")
+
+        post("/index/t")
+        post("/index/t/field/f")
+        # Bits across enough shards that BOTH nodes own some.
+        from pilosa_tpu.config import SHARD_WIDTH
+        for s in range(16):
+            post("/index/t/query", f"Set({s * SHARD_WIDTH}, f=1)")
+        tracer.spans.clear()
+        assert post("/index/t/query", "Count(Row(f=1))") == \
+            {"results": [16]}
+        exec_spans = [s for s in tracer.spans
+                      if s.operation.startswith("Executor.execute")]
+        ids = {s.tags.get("trace.id") for s in exec_spans}
+        assert len(exec_spans) >= 2     # coordinator + remote node
+        assert len(ids) == 1 and None not in ids
+    finally:
+        set_tracer(NopTracer())
+        for n in nodes:
+            try:
+                n.close()
+            except Exception:
+                pass
