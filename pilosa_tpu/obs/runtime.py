@@ -15,7 +15,8 @@ import threading
 _PAGE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
 
 
-def collect_runtime_gauges(stats, planner=None) -> dict:
+def collect_runtime_gauges(stats, planner=None,
+                           probe_device: bool = True) -> dict:
     """One sweep of gauges into ``stats``; returns them for callers that
     surface the snapshot directly (the /info route, tests)."""
     out: dict[str, float] = {}
@@ -41,17 +42,21 @@ def collect_runtime_gauges(stats, planner=None) -> dict:
         out["plannerCacheBudgetBytes"] = float(snap["budget_bytes"])
         out["plannerCacheEntries"] = float(snap["entries"])
 
-    try:
-        import jax
-        dev = jax.local_devices()[0]
-        mem = getattr(dev, "memory_stats", lambda: None)()
-        if mem:
-            for key in ("bytes_in_use", "peak_bytes_in_use",
-                        "bytes_limit"):
-                if key in mem:
-                    out[f"device_{key}"] = float(mem[key])
-    except Exception:
-        pass  # platform without memory stats / no device
+    if planner is not None and probe_device:
+        # Only device-using nodes probe device memory: jax.local_devices
+        # would otherwise force backend init (seconds over the tunnel)
+        # on planner-less nodes for gauges they can't use.
+        try:
+            import jax
+            dev = jax.local_devices()[0]
+            mem = getattr(dev, "memory_stats", lambda: None)()
+            if mem:
+                for key in ("bytes_in_use", "peak_bytes_in_use",
+                            "bytes_limit"):
+                    if key in mem:
+                        out[f"device_{key}"] = float(mem[key])
+        except Exception:
+            pass  # platform without memory stats / no device
 
     for name, value in out.items():
         stats.gauge(f"runtime.{name}", value)
@@ -76,7 +81,11 @@ class RuntimeMonitor:
     def start(self) -> None:
         if self.interval <= 0:
             return
-        collect_runtime_gauges(self.stats, self.planner)
+        # Host-side sweep inline (cheap, includes planner cache stats);
+        # the device-memory probe waits for the first background tick so
+        # ServerNode.open() never blocks on backend init.
+        collect_runtime_gauges(self.stats, self.planner,
+                               probe_device=False)
         self._schedule()
 
     def _schedule(self) -> None:

@@ -88,6 +88,16 @@ class MeshPlanner:
         #: tiny host-side filter cache for TopN's two passes (keyed by
         #: call text + shards + epoch; each pull is a link round-trip).
         self._filter_host_cache: dict[tuple, np.ndarray] = {}
+        #: prepared plans: (index identity, call text, shards) ->
+        #: (leaf descriptors, jitted fn). A repeated query shape skips
+        #: the signature walk; leaves re-resolve through _fetch_leaf
+        #: every query (an O(1) epoch-validated stack-cache hit), so
+        #: plans pin NO device arrays, never go stale, and all HBM
+        #: accounting stays in the one budgeted stack cache. The device
+        #: still runs the full program every time (prepared-statement
+        #: caching, not result caching).
+        self._plan_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self.PLAN_CACHE_SIZE = 128
 
     # ------------------------------------------------------------------
     # public API
@@ -122,10 +132,25 @@ class MeshPlanner:
             fut: Future = Future()
             fut.set_result(0)
             return fut
-        leaves: list[tuple] = []
-        sig = self._signature(idx, c, leaves)
-        arrays = [self._fetch_leaf(idx, leaf, tuple(shards)) for leaf in leaves]
-        fn = self._compiled(("count",) + sig, c, idx, reduce="per_shard")
+        plan_key = (idx.name, idx.instance_id, str(c), tuple(shards))
+        with self._cache_lock:
+            hit = self._plan_cache.get(plan_key)
+            if hit is not None:
+                self._plan_cache.move_to_end(plan_key)
+        if hit is not None:
+            leaves, fn = hit
+        else:
+            leaves = []
+            sig = self._signature(idx, c, leaves)
+            fn = self._compiled(("count",) + sig, c, idx,
+                                reduce="per_shard")
+            with self._cache_lock:
+                self._plan_cache[plan_key] = (leaves, fn)
+                self._plan_cache.move_to_end(plan_key)
+                while len(self._plan_cache) > self.PLAN_CACHE_SIZE:
+                    self._plan_cache.popitem(last=False)
+        arrays = [self._fetch_leaf(idx, leaf, tuple(shards))
+                  for leaf in leaves]
         out = fn(*arrays)
         # Per-shard int32 popcounts (≤2^20 each) summed in Python ints —
         # immune to int32 overflow past ~2k full shards.
@@ -393,6 +418,7 @@ class MeshPlanner:
         with self._cache_lock:
             self._stack_cache.clear()
             self._filter_host_cache.clear()
+            self._plan_cache.clear()
             self._cache_bytes = 0
 
     def cache_stats(self) -> dict:

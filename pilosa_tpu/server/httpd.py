@@ -66,6 +66,10 @@ def _make_handler(api: API):
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+        # Nagle + delayed-ACK costs ~40ms per small response (status
+        # line, headers, and body are separate writes); node-to-node
+        # RPC and every latency-sensitive client pays it otherwise.
+        disable_nagle_algorithm = True
 
         def log_message(self, fmt, *args):  # quiet by default
             pass

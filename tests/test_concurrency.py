@@ -244,3 +244,22 @@ def test_cluster_coordinator_results_not_cached():
     lc.client.peers[owner.id].holder.fragment(
         "i", "f", "standard", 0).set_bit(1, 7)
     assert lc.query("i", "Count(Row(f=1))") == [2]  # no stale cache
+
+
+def test_plan_cache_invalidated_by_write():
+    """Prepared plans (fn + leaf arrays) must die on writes: the leaf
+    arrays embed data, so serving them past a mutation would be a stale
+    read even though the device re-executes."""
+    h = Holder()
+    idx = h.create_index("i")
+    f = idx.create_field("f")
+    g = idx.create_field("g")
+    f.import_bits([1, 1], [0, 5])
+    g.import_bits([2, 2], [0, 9])
+    planner = MeshPlanner(h, make_mesh())
+    ex = Executor(h, planner=planner)
+    q = "Count(Intersect(Row(f=1), Row(g=2)))"
+    assert ex.execute("i", q, cache=False) == [1]
+    assert ex.execute("i", q, cache=False) == [1]   # plan-cache hit
+    g.set_bit(2, 5)
+    assert ex.execute("i", q, cache=False) == [2]   # plan rebuilt
