@@ -41,6 +41,8 @@ class Cluster:
         self.client = client or NopClient()
         self.state = STATE_STARTING
         self._lock = threading.RLock()
+        #: NodeEvent consumers (cluster/event.py).
+        self._listeners: list[Callable] = []
 
     # -- membership --------------------------------------------------------
 
@@ -68,6 +70,7 @@ class Cluster:
         with self._lock:
             if self.node_by_id(node.id) is None:
                 self.nodes = sorted(self.nodes + [node], key=lambda n: n.id)
+                self._emit("node-join", node.id, node.state)
             self._update_state()
 
     def node_leave(self, node_id: str) -> None:
@@ -75,7 +78,22 @@ class Cluster:
             n = self.node_by_id(node_id)
             if n is not None:
                 n.state = "DOWN"
+                self._emit("node-leave", node_id, "DOWN")
             self._update_state()
+
+    def subscribe(self, listener: Callable) -> None:
+        """Register a NodeEvent consumer (reference ReceiveEvent's
+        inverse: we push instead of queue-poll; event.go:18-31)."""
+        self._listeners.append(listener)
+
+    def _emit(self, type_: str, node_id: str, state: str) -> None:
+        from pilosa_tpu.cluster.event import NodeEvent
+        ev = NodeEvent(type=type_, node_id=node_id, state=state)
+        for fn in self._listeners:
+            try:
+                fn(ev)
+            except Exception:
+                pass  # observers must never break membership handling
 
     def _update_state(self) -> None:
         """cluster.go:571-582: tolerate < replicaN losses (DEGRADED);

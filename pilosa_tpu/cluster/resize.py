@@ -257,9 +257,11 @@ def check_nodes(cluster: Cluster, client, retries: int = 2) -> list[str]:
         if alive and node.state == "DOWN":
             node.state = "READY"
             changed.append(node.id)
+            cluster._emit("node-update", node.id, "READY")
         elif not alive and node.state != "DOWN":
             node.state = "DOWN"
             changed.append(node.id)
+            cluster._emit("node-update", node.id, "DOWN")
     if changed:
         cluster._update_state()
     return changed

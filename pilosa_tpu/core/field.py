@@ -178,7 +178,8 @@ class Field:
     def __init__(self, index: str, name: str, options: FieldOptions | None = None,
                  stats=None, row_attr_store: AttrStore | None = None,
                  translate_store: TranslateStore | None = None,
-                 fragment_listener=None, op_writer_factory=None, epoch=None):
+                 fragment_listener=None, op_writer_factory=None, epoch=None,
+                 schema_epoch=None):
         # The internal existence field is the one reserved name allowed to
         # bypass validation (reference index.go:336 createFieldIfNotExists).
         if name != EXISTENCE_FIELD_NAME:
@@ -191,6 +192,9 @@ class Field:
         #: index-level mutation epoch (core.index.Epoch), threaded down to
         #: fragments so any mutation invalidates epoch-stamped caches.
         self.epoch = epoch
+        #: index-level STRUCTURE epoch: bumped when baked query-plan
+        #: inputs change (here: BSI bit-depth growth).
+        self.schema_epoch = schema_epoch
         self.row_attr_store = row_attr_store or AttrStore(epoch=epoch)
         self.translate_store = translate_store or TranslateStore()
         self.fragment_listener = fragment_listener
@@ -592,6 +596,8 @@ class Field:
             if required > bsig.bit_depth:
                 bsig.bit_depth = required
                 self.options.bit_depth = required
+                if self.schema_epoch is not None:
+                    self.schema_epoch.bump()
         view = self.create_view_if_not_exists(view_bsi_name(self.name))
         cols = np.asarray(column_ids, dtype=np.int64)
         if len(cols) == 0:

@@ -84,6 +84,11 @@ class Index:
         self.fragment_listener = fragment_listener
         self.op_writer_factory = op_writer_factory
         self.epoch = Epoch()
+        #: bumped on STRUCTURAL changes (field create/delete, BSI
+        #: bit-depth growth) — prepared query plans bake field structure
+        #: (e.g. how many bit planes a comparator reads), so they key on
+        #: this, separately from the data epoch.
+        self.schema_epoch = Epoch()
         self.fields: dict[str, Field] = {}
         self.column_attr_store = AttrStore(epoch=self.epoch)
         self.translate_store = TranslateStore()
@@ -118,8 +123,9 @@ class Index:
             f = Field(self.name, name, options, stats=self.stats,
                       fragment_listener=self.fragment_listener,
                       op_writer_factory=self.op_writer_factory,
-                      epoch=self.epoch)
+                      epoch=self.epoch, schema_epoch=self.schema_epoch)
             self.fields[name] = f
+            self.schema_epoch.bump()
             return f
 
     def create_field_if_not_exists(self, name: str,
@@ -133,6 +139,7 @@ class Index:
                 raise FieldNotFoundError()
             del self.fields[name]
             self.epoch.bump()
+            self.schema_epoch.bump()
 
     # -- existence ---------------------------------------------------------
 

@@ -132,7 +132,11 @@ class MeshPlanner:
             fut: Future = Future()
             fut.set_result(0)
             return fut
-        plan_key = (idx.name, idx.instance_id, str(c), tuple(shards))
+        # schema_epoch: plans bake field STRUCTURE (a BSI comparator's
+        # bit-depth, sign-class branches, base folds), so any schema
+        # change — field create/delete, bit-depth growth — must miss.
+        plan_key = (idx.name, idx.instance_id, idx.schema_epoch.value,
+                    str(c), tuple(shards))
         with self._cache_lock:
             hit = self._plan_cache.get(plan_key)
             if hit is not None:
@@ -146,7 +150,6 @@ class MeshPlanner:
                                 reduce="per_shard")
             with self._cache_lock:
                 self._plan_cache[plan_key] = (leaves, fn)
-                self._plan_cache.move_to_end(plan_key)
                 while len(self._plan_cache) > self.PLAN_CACHE_SIZE:
                     self._plan_cache.popitem(last=False)
         arrays = [self._fetch_leaf(idx, leaf, tuple(shards))

@@ -97,6 +97,8 @@ class ServerNode:
         self._closed = False
         #: one resize job at a time (reference cluster.go:1447).
         self._resize_gate = threading.Lock()
+        if self.cluster is not None:
+            self.cluster.subscribe(self._on_node_event)
         self._anti_entropy_interval = (
             self.DEFAULT_ANTI_ENTROPY_INTERVAL
             if anti_entropy_interval is None else anti_entropy_interval)
@@ -179,6 +181,22 @@ class ServerNode:
     def _jitter(self, interval: float) -> float:
         import random
         return interval * random.uniform(0.8, 1.2)
+
+    def _on_node_event(self, ev) -> None:
+        """NodeEvent consumer (reference ReceiveEvent, cluster.go:1754):
+        count the stream, and when a peer comes BACK, kick an immediate
+        repair pass instead of waiting out the anti-entropy ticker."""
+        self.stats.with_tags(f"event:{ev.type}").count("nodeEvents")
+        if (ev.type == "node-update" and ev.state == "READY"
+                and self.syncer is not None and not self._closed):
+            def repair():
+                try:
+                    self._sync_schema()
+                    self.syncer.sync_holder()
+                except Exception:
+                    pass  # ticker retries
+            threading.Thread(target=repair, name="event-repair",
+                             daemon=True).start()
 
     def _sync_schema(self) -> None:
         """Adopt any peer schema this node is missing (a restarted

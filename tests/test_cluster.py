@@ -234,3 +234,26 @@ def test_holder_syncer_repairs_attrs():
     assert node2.holder.index("i").fields["f"].row_attr_store.attrs(1) == \
         {"name": "alpha"}
     assert node2.holder.index("i").column_attr_store.attrs(5) == {"city": "x"}
+
+
+def test_node_event_pipeline():
+    """NodeEvents flow from membership changes and the failure detector
+    to subscribers (reference event.go:18-31 + ReceiveEvent)."""
+    from pilosa_tpu.cluster.resize import check_nodes
+    lc = LocalCluster(3, replica_n=2)
+    c0 = lc[0].cluster
+    events = []
+    c0.subscribe(events.append)
+    lc.client.down.add("node1")
+    check_nodes(c0, lc.client)
+    lc.client.down.discard("node1")
+    check_nodes(c0, lc.client)
+    assert [(e.type, e.node_id, e.state) for e in events] == [
+        ("node-update", "node1", "DOWN"),
+        ("node-update", "node1", "READY"),
+    ]
+    from pilosa_tpu.cluster.node import Node, URI
+    c0.node_join(Node(id="nodeX", uri=URI(port=10999)))
+    assert events[-1].type == "node-join" and events[-1].node_id == "nodeX"
+    c0.node_leave("nodeX")
+    assert events[-1].type == "node-leave"

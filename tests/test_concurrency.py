@@ -263,3 +263,28 @@ def test_plan_cache_invalidated_by_write():
     assert ex.execute("i", q, cache=False) == [1]   # plan-cache hit
     g.set_bit(2, 5)
     assert ex.execute("i", q, cache=False) == [2]   # plan rebuilt
+
+
+def test_plan_cache_invalidated_by_schema_change():
+    """Prepared plans bake BSI structure (bit depth, base folds): field
+    recreate AND in-place bit-depth growth must both miss the cache."""
+    from pilosa_tpu.core import FieldOptions
+    from pilosa_tpu.core.field import FIELD_TYPE_INT
+    h = Holder()
+    idx = h.create_index("i")
+    opts = FieldOptions(type=FIELD_TYPE_INT, min=0, max=7)
+    v = idx.create_field("v", opts)
+    v.import_values([1, 2], [5, 6])
+    planner = MeshPlanner(h, make_mesh())
+    ex = Executor(h, planner=planner)
+    q = "Count(Row(v > 4))"
+    assert ex.execute("i", q, cache=False) == [2]
+    # Recreate with a much wider range (deeper BSI).
+    idx.delete_field("v")
+    v2 = idx.create_field("v", FieldOptions(type=FIELD_TYPE_INT,
+                                            min=0, max=1000))
+    v2.import_values([1, 2], [5, 500])
+    assert ex.execute("i", q, cache=False) == [2]   # 5 and 500, new depth
+    # In-place bit-depth growth (field.py grows on import) also misses.
+    v2.import_values([3], [900])
+    assert ex.execute("i", "Count(Row(v > 800))", cache=False) == [1]
