@@ -70,7 +70,8 @@ class Cluster:
         with self._lock:
             if self.node_by_id(node.id) is None:
                 self.nodes = sorted(self.nodes + [node], key=lambda n: n.id)
-                self._emit("node-join", node.id, node.state)
+                from pilosa_tpu.cluster.event import EVENT_JOIN
+                self._emit(EVENT_JOIN, node.id, node.state)
             self._update_state()
 
     def node_leave(self, node_id: str) -> None:
@@ -78,7 +79,8 @@ class Cluster:
             n = self.node_by_id(node_id)
             if n is not None:
                 n.state = "DOWN"
-                self._emit("node-leave", node_id, "DOWN")
+                from pilosa_tpu.cluster.event import EVENT_LEAVE
+                self._emit(EVENT_LEAVE, node_id, "DOWN")
             self._update_state()
 
     def subscribe(self, listener: Callable) -> None:

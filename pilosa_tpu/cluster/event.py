@@ -12,9 +12,11 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
-EVENT_JOIN = "node-join"
-EVENT_LEAVE = "node-leave"
-EVENT_UPDATE = "node-update"  # state change (DOWN <-> READY)
+# Distinct from the "node-join" CONTROL MESSAGE type (server.node's
+# /internal/cluster/message dispatch) — these name membership events.
+EVENT_JOIN = "join"
+EVENT_LEAVE = "leave"
+EVENT_UPDATE = "update"  # state change (DOWN <-> READY)
 
 
 @dataclass

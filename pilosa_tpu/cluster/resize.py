@@ -254,14 +254,15 @@ def check_nodes(cluster: Cluster, client, retries: int = 2) -> list[str]:
                 break
             except ConnectionError:
                 continue
+        from pilosa_tpu.cluster.event import EVENT_UPDATE
         if alive and node.state == "DOWN":
             node.state = "READY"
             changed.append(node.id)
-            cluster._emit("node-update", node.id, "READY")
+            cluster._emit(EVENT_UPDATE, node.id, "READY")
         elif not alive and node.state != "DOWN":
             node.state = "DOWN"
             changed.append(node.id)
-            cluster._emit("node-update", node.id, "DOWN")
+            cluster._emit(EVENT_UPDATE, node.id, "DOWN")
     if changed:
         cluster._update_state()
     return changed

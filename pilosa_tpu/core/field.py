@@ -381,6 +381,8 @@ class Field:
         if required > bsig.bit_depth:
             bsig.bit_depth = required
             self.options.bit_depth = required
+            if self.schema_epoch is not None:  # plans bake the depth
+                self.schema_epoch.bump()
         v = self.create_view_if_not_exists(view_bsi_name(self.name))
         return v.set_value(column_id, bsig.bit_depth, base_value)
 

@@ -424,6 +424,11 @@ class MeshPlanner:
             self._plan_cache.clear()
             self._cache_bytes = 0
 
+    def close(self) -> None:
+        """Release caches and stop the batcher's resolver thread."""
+        self.invalidate()
+        self.batcher.close()
+
     def cache_stats(self) -> dict:
         """Locked snapshot of HBM-cache occupancy for monitoring."""
         with self._cache_lock:

@@ -255,6 +255,34 @@ def _build_routes(api: API):
             return 200, prometheus_text(stats)
         return 200, "# no stats backend configured\n"
 
+    def get_debug_vars(pv, params, body):
+        """expvar analog (reference /debug/vars, http/handler.go:281):
+        raw counters/gauges as JSON."""
+        from pilosa_tpu.obs import MemoryStats
+        stats = getattr(api.executor, "stats", None)
+        if not isinstance(stats, MemoryStats):
+            return 200, {}
+        with stats._lock:
+            return 200, {
+                "counters": {f"{n}{list(t) or ''}": v
+                             for (n, t), v in sorted(stats.counters.items())},
+                "gauges": {f"{n}{list(t) or ''}": v
+                           for (n, t), v in sorted(stats.gauges.items())},
+            }
+
+    def get_debug_threads(pv, params, body):
+        """Thread stack dump — the pprof-goroutine analog for diagnosing
+        a stuck node (reference /debug/pprof, http/handler.go:281)."""
+        import sys
+        import traceback
+        frames = sys._current_frames()
+        names = {t.ident: t.name for t in threading.enumerate()}
+        out = []
+        for tid, frame in frames.items():
+            out.append(f"--- {names.get(tid, '?')} ({tid}) ---\n"
+                       + "".join(traceback.format_stack(frame)))
+        return 200, "\n".join(out)
+
     def post_recalculate(pv, params, body):
         api.recalculate_caches()
         return 200, {}
@@ -367,6 +395,8 @@ def _build_routes(api: API):
         (r"/info", {"GET": get_info}),
         (r"/version", {"GET": get_version}),
         (r"/metrics", {"GET": get_metrics}),
+        (r"/debug/vars", {"GET": get_debug_vars}),
+        (r"/debug/threads", {"GET": get_debug_threads}),
         (r"/recalculate-caches", {"POST": post_recalculate}),
         (r"/internal/shards/max", {"GET": get_shards_max}),
         (r"/internal/translate/keys", {"POST": post_translate_keys}),

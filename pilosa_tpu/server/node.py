@@ -186,8 +186,9 @@ class ServerNode:
         """NodeEvent consumer (reference ReceiveEvent, cluster.go:1754):
         count the stream, and when a peer comes BACK, kick an immediate
         repair pass instead of waiting out the anti-entropy ticker."""
+        from pilosa_tpu.cluster.event import EVENT_UPDATE
         self.stats.with_tags(f"event:{ev.type}").count("nodeEvents")
-        if (ev.type == "node-update" and ev.state == "READY"
+        if (ev.type == EVENT_UPDATE and ev.state == "READY"
                 and self.syncer is not None and not self._closed):
             def repair():
                 try:
@@ -253,15 +254,19 @@ class ServerNode:
 
     def close(self) -> None:
         self._closed = True
+        # Stop accepting work FIRST: queries racing shutdown would
+        # otherwise hit an already-closed batcher/store and 500.
+        self.http.close()
         if self._sync_timer is not None:
             self._sync_timer.cancel()
         if self._check_timer is not None:
             self._check_timer.cancel()
         if getattr(self, "runtime_monitor", None) is not None:
             self.runtime_monitor.close()
+        if self.executor.planner is not None:
+            self.executor.planner.close()
         if self.store is not None:
             self.store.close()
-        self.http.close()
 
     @property
     def address(self) -> str:

@@ -249,11 +249,11 @@ def test_node_event_pipeline():
     lc.client.down.discard("node1")
     check_nodes(c0, lc.client)
     assert [(e.type, e.node_id, e.state) for e in events] == [
-        ("node-update", "node1", "DOWN"),
-        ("node-update", "node1", "READY"),
+        ("update", "node1", "DOWN"),
+        ("update", "node1", "READY"),
     ]
     from pilosa_tpu.cluster.node import Node, URI
     c0.node_join(Node(id="nodeX", uri=URI(port=10999)))
-    assert events[-1].type == "node-join" and events[-1].node_id == "nodeX"
+    assert events[-1].type == "join" and events[-1].node_id == "nodeX"
     c0.node_leave("nodeX")
-    assert events[-1].type == "node-leave"
+    assert events[-1].type == "leave"

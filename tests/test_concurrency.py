@@ -288,3 +288,71 @@ def test_plan_cache_invalidated_by_schema_change():
     # In-place bit-depth growth (field.py grows on import) also misses.
     v2.import_values([3], [900])
     assert ex.execute("i", "Count(Row(v > 800))", cache=False) == [1]
+
+
+def test_concurrent_writers_and_readers_converge():
+    """4 writer + 4 reader threads through one executor: no crashes, no
+    impossible counts mid-flight, exact convergence at the end."""
+    h = Holder()
+    idx = h.create_index("i")
+    idx.create_field("f")
+    planner = MeshPlanner(h, make_mesh())
+    ex = Executor(h, planner=planner)
+    per_writer = 60
+    n_writers = 4
+    errors = []
+    barrier = threading.Barrier(n_writers + 4)
+
+    def writer(w):
+        barrier.wait()
+        for i in range(per_writer):
+            col = w * per_writer + i
+            try:
+                ex.execute("i", f"Set({col}, f=1)")
+            except Exception as e:  # pragma: no cover
+                errors.append(("w", w, repr(e)))
+                return
+
+    def reader():
+        barrier.wait()
+        last = 0
+        for _ in range(80):
+            try:
+                (n,) = ex.execute("i", "Count(Row(f=1))", cache=False)
+            except Exception as e:  # pragma: no cover
+                errors.append(("r", repr(e)))
+                return
+            if not (0 <= n <= n_writers * per_writer) or n < last:
+                # counts may lag but must be sane and monotone here
+                # (single field, set-only workload)
+                errors.append(("r", "non-monotone", last, n))
+                return
+            last = n
+
+    threads = ([threading.Thread(target=writer, args=(w,))
+                for w in range(n_writers)]
+               + [threading.Thread(target=reader) for _ in range(4)])
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors[:3]
+    assert ex.execute("i", "Count(Row(f=1))", cache=False) == \
+        [n_writers * per_writer]
+    planner.close()
+
+
+def test_plan_cache_invalidated_by_set_value_depth_growth():
+    """Single-value Set() grows BSI depth too — must also miss plans."""
+    from pilosa_tpu.core import FieldOptions
+    from pilosa_tpu.core.field import FIELD_TYPE_INT
+    h = Holder()
+    idx = h.create_index("i")
+    v = idx.create_field("v", FieldOptions(type=FIELD_TYPE_INT,
+                                           min=0, max=1000))
+    v.set_value(1, 5)
+    ex = Executor(h, planner=MeshPlanner(h, make_mesh()))
+    q = "Count(Row(v > 4))"
+    assert ex.execute("i", q, cache=False) == [1]   # plan cached, depth 3
+    v.set_value(2, 900)                             # grows depth in place
+    assert ex.execute("i", q, cache=False) == [2]

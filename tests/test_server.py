@@ -205,3 +205,14 @@ def test_fragment_data_streaming_cursor(node):
         assert total == 1800
     finally:
         Fragment.TRANSFER_CHUNK_BITS = old
+
+
+def test_debug_routes(node):
+    b = node.address
+    req(b, "POST", "/index/d", "{}")
+    req(b, "POST", "/index/d/query", "Set(1, f=1)")  # 400 (no field) counted
+    status, v = req(b, "GET", "/debug/vars")
+    assert status == 200 and "counters" in v
+    r = urllib.request.urlopen(b + "/debug/threads", timeout=10)
+    body = r.read().decode()
+    assert "---" in body and ("Thread" in body or "MainThread" in body)
