@@ -32,6 +32,8 @@ _DEFAULTS = {
     "anti_entropy_interval": 10.0,
     "check_nodes_interval": 5.0,
     "join": "",
+    "tls_cert": "",
+    "tls_key": "",
     "planner": True,
 }
 
@@ -72,6 +74,10 @@ def cmd_server(args) -> int:
         cfg["planner"] = False
     if args.join:
         cfg["join"] = args.join
+    if args.tls_cert:
+        cfg["tls_cert"] = args.tls_cert
+    if args.tls_key:
+        cfg["tls_key"] = args.tls_key
 
     from pilosa_tpu.server.node import ServerNode
     node = ServerNode(
@@ -83,11 +89,14 @@ def cmd_server(args) -> int:
         check_nodes_interval=float(cfg["check_nodes_interval"]),
         join=str(cfg["join"]) or None,
         data_dir=cfg["data_dir"] or None,
+        tls_cert=str(cfg["tls_cert"]) or None,
+        tls_key=str(cfg["tls_key"]) or None,
     )
-    node.open()
+    node.open()  # starts the (single) serve loop in the background
     print(f"pilosa-tpu serving at {node.address}", file=sys.stderr)
     try:
-        node.http.serve_forever()
+        import threading
+        threading.Event().wait()  # block until interrupted
     except KeyboardInterrupt:
         pass
     finally:
@@ -213,9 +222,12 @@ def cmd_generate_config(args) -> int:
     print('bind = "127.0.0.1:10101"\n'
           'data-dir = ""\n'
           'peers = ""\n'
+          'join = ""\n'
           'replica-n = 1\n'
           'anti-entropy-interval = 10.0\n'
           'check-nodes-interval = 5.0\n'
+          'tls-cert = ""\n'
+          'tls-key = ""\n'
           'planner = true')
     return 0
 
@@ -232,6 +244,8 @@ def main(argv: list[str] | None = None) -> int:
     s.add_argument("--no-planner", action="store_true")
     s.add_argument("--join", default="",
                    help="host:port of a running member to join")
+    s.add_argument("--tls-cert", default="")
+    s.add_argument("--tls-key", default="")
     s.add_argument("--config", default=None)
     s.set_defaults(fn=cmd_server)
 

@@ -14,6 +14,7 @@ from typing import Any, Callable
 
 from pilosa_tpu.config import DEFAULT_PARTITION_N
 from pilosa_tpu.cluster.client import InternalClient, NopClient
+from pilosa_tpu.cluster.event import EVENT_JOIN, EVENT_LEAVE, NodeEvent
 from pilosa_tpu.cluster.node import Node
 from pilosa_tpu.cluster.placement import jump_hash, partition
 from pilosa_tpu.errors import PilosaError
@@ -70,7 +71,6 @@ class Cluster:
         with self._lock:
             if self.node_by_id(node.id) is None:
                 self.nodes = sorted(self.nodes + [node], key=lambda n: n.id)
-                from pilosa_tpu.cluster.event import EVENT_JOIN
                 self._emit(EVENT_JOIN, node.id, node.state)
             self._update_state()
 
@@ -79,7 +79,6 @@ class Cluster:
             n = self.node_by_id(node_id)
             if n is not None:
                 n.state = "DOWN"
-                from pilosa_tpu.cluster.event import EVENT_LEAVE
                 self._emit(EVENT_LEAVE, node_id, "DOWN")
             self._update_state()
 
@@ -89,7 +88,6 @@ class Cluster:
         self._listeners.append(listener)
 
     def _emit(self, type_: str, node_id: str, state: str) -> None:
-        from pilosa_tpu.cluster.event import NodeEvent
         ev = NodeEvent(type=type_, node_id=node_id, state=state)
         for fn in self._listeners:
             try:

@@ -23,6 +23,7 @@ from pilosa_tpu.cluster.cluster import (
     STATE_RESIZING,
     Cluster,
 )
+from pilosa_tpu.cluster.event import EVENT_UPDATE
 from pilosa_tpu.cluster.node import URI, Node
 
 
@@ -41,6 +42,7 @@ class ResizeSource:
     shard: int
     source_host: str = ""
     source_port: int = 0
+    source_scheme: str = "http"
 
 
 def fragment_sources(old: Cluster, new: Cluster, schema_fragments) -> dict[str, list[ResizeSource]]:
@@ -60,7 +62,8 @@ def fragment_sources(old: Cluster, new: Cluster, schema_fragments) -> dict[str, 
             out.setdefault(target, []).append(ResizeSource(
                 source_node=src.id, index=index, field=field,
                 view=view, shard=shard,
-                source_host=src.uri.host, source_port=src.uri.port))
+                source_host=src.uri.host, source_port=src.uri.port,
+                source_scheme=src.uri.scheme))
     return out
 
 
@@ -79,7 +82,9 @@ def apply_resize_instruction(holder, client, cluster: Cluster,
         node = cluster.node_by_id(src.source_node)
         if node is None and src.source_host:
             node = Node(id=src.source_node,
-                        uri=URI(host=src.source_host, port=src.source_port))
+                        uri=URI(scheme=src.source_scheme or "http",
+                                host=src.source_host,
+                                port=src.source_port))
         if node is None:
             raise ConnectionError(
                 f"resize source {src.source_node!r} unknown")
@@ -254,7 +259,6 @@ def check_nodes(cluster: Cluster, client, retries: int = 2) -> list[str]:
                 break
             except ConnectionError:
                 continue
-        from pilosa_tpu.cluster.event import EVENT_UPDATE
         if alive and node.state == "DOWN":
             node.state = "READY"
             changed.append(node.id)

@@ -55,15 +55,27 @@ class TransferBatcher:
             arr.copy_to_host_async()
         except (AttributeError, RuntimeError):
             pass  # non-jax array / backend without async copies
+        closed = False
         with self._cv:
             if self._closed:
-                raise RuntimeError("TransferBatcher is closed")
-            self._queue.append((arr, fut, postproc))
-            if self._thread is None:
-                self._thread = threading.Thread(
-                    target=self._run, name="transfer-batcher", daemon=True)
-                self._thread.start()
-            self._cv.notify()
+                closed = True
+            else:
+                self._queue.append((arr, fut, postproc))
+                if self._thread is None:
+                    self._thread = threading.Thread(
+                        target=self._run, name="transfer-batcher",
+                        daemon=True)
+                    self._thread.start()
+                self._cv.notify()
+        if closed:
+            # Shutdown grace OUTSIDE the lock (the pull can take a full
+            # link round-trip): a query racing node close resolves
+            # synchronously instead of 500ing (handler threads can
+            # outlive the HTTP listener).
+            try:
+                fut.set_result(postproc(np.asarray(arr)))
+            except Exception as e:
+                fut.set_exception(e)
         return fut
 
     def close(self) -> None:

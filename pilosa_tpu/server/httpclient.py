@@ -21,10 +21,26 @@ class HTTPInternalClient:
     """Implements the InternalClient protocol against peer HTTP servers."""
 
     def __init__(self, timeout: float = 30.0):
+        self._ssl_ctx = None
         self.timeout = timeout
 
     def _url(self, node: Node, path: str) -> str:
         return f"{node.uri}{path}"
+
+    def _ctx(self, url: str):
+        """SSL context for https peers: internal RPC skips verification
+        (clusters use self-signed certs; the reference's
+        tls.skip-verify). Plain http gets None."""
+        if not url.startswith("https:"):
+            return None
+        ctx = self._ssl_ctx
+        if ctx is None:
+            import ssl
+            ctx = ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            self._ssl_ctx = ctx
+        return ctx
 
     def _request(self, node: Node, method: str, path: str,
                  body: bytes | None = None) -> Any:
@@ -36,7 +52,8 @@ class HTTPInternalClient:
         for k, v in inject_http_headers({}).items():
             req.add_header(k, v)
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            with urllib.request.urlopen(req, timeout=self.timeout,
+                                        context=self._ctx(req.full_url)) as resp:
                 data = resp.read()
         except urllib.error.HTTPError as e:
             # The peer is alive but rejected the request — application
@@ -113,7 +130,8 @@ class HTTPInternalClient:
             node, f"/internal/fragment/data?index={index}&field={field}"
                   f"&view={view}&shard={shard}"))
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            with urllib.request.urlopen(req, timeout=self.timeout,
+                                        context=self._ctx(req.full_url)) as resp:
                 return resp.read()
         except urllib.error.HTTPError as e:
             raise LookupError(f"{node.id}: {e.read().decode(errors='replace')}")
@@ -132,8 +150,9 @@ class HTTPInternalClient:
                       f"&field={field}&view={view}&shard={shard}"
                       f"&after={after}"))
             try:
-                with urllib.request.urlopen(req,
-                                            timeout=self.timeout) as resp:
+                with urllib.request.urlopen(
+                        req, timeout=self.timeout,
+                        context=self._ctx(req.full_url)) as resp:
                     data = resp.read()
                     next_row = resp.headers.get("X-Pilosa-Next-Row", "")
             except urllib.error.HTTPError as e:
@@ -157,7 +176,8 @@ class HTTPInternalClient:
         url = self._url(node, "/version")
         try:
             with urllib.request.urlopen(
-                    url, timeout=min(self.PROBE_TIMEOUT, self.timeout)):
+                    url, timeout=min(self.PROBE_TIMEOUT, self.timeout),
+                    context=self._ctx(url)):
                 pass
         except urllib.error.HTTPError:
             pass  # alive but unhappy still counts as alive

@@ -32,15 +32,47 @@ _CONFLICTS = (IndexExistsError, FieldExistsError)
 _NOT_FOUND = (IndexNotFoundError, FieldNotFoundError, FragmentNotFoundError)
 
 
-class HTTPServer:
-    """One node's HTTP front end (reference http/handler.go:46)."""
+class _Server(ThreadingHTTPServer):
+    """TLS wraps PER CONNECTION with a deferred handshake: wrapping the
+    listening socket would run every handshake inside the single accept
+    loop, letting one silent client block the whole server."""
 
-    def __init__(self, api: API, host: str = "127.0.0.1", port: int = 10101):
+    ssl_ctx = None
+
+    def get_request(self):
+        sock, addr = self.socket.accept()
+        if self.ssl_ctx is not None:
+            sock = self.ssl_ctx.wrap_socket(sock, server_side=True,
+                                            do_handshake_on_connect=False)
+        return sock, addr
+
+
+class HTTPServer:
+    """One node's HTTP front end (reference http/handler.go:46).
+
+    ``tls_cert``/``tls_key`` wrap the listener in TLS (the reference's
+    server/tlsconfig.go; `https://` scheme in .address)."""
+
+    def __init__(self, api: API, host: str = "127.0.0.1", port: int = 10101,
+                 tls_cert: str | None = None, tls_key: str | None = None):
         self.api = api
         self.host = host
         self.port = port
+        if bool(tls_cert) != bool(tls_key):
+            # A half-specified TLS config must never silently serve
+            # plaintext while the operator believes TLS is on.
+            raise ValueError("tls_cert and tls_key must be set together")
+        self.tls = bool(tls_cert)
+        # Load the cert BEFORE binding: a bad path must not leak a
+        # bound listening socket (retrying supervisors get EADDRINUSE).
+        ctx = None
+        if tls_cert:
+            import ssl
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(tls_cert, tls_key)
         handler = _make_handler(api)
-        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._httpd = _Server((host, port), handler)
+        self._httpd.ssl_ctx = ctx
         self.port = self._httpd.server_address[1]  # resolved if port=0
         self._thread: threading.Thread | None = None
 
@@ -58,7 +90,8 @@ class HTTPServer:
 
     @property
     def address(self) -> str:
-        return f"http://{self.host}:{self.port}"
+        scheme = "https" if self.tls else "http"
+        return f"{scheme}://{self.host}:{self.port}"
 
 
 def _make_handler(api: API):
@@ -70,6 +103,9 @@ def _make_handler(api: API):
         # line, headers, and body are separate writes); node-to-node
         # RPC and every latency-sensitive client pays it otherwise.
         disable_nagle_algorithm = True
+        # Bound how long a silent/stalled connection (incl. a deferred
+        # TLS handshake) can pin a handler thread.
+        timeout = 120
 
         def log_message(self, fmt, *args):  # quiet by default
             pass

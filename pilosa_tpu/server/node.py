@@ -12,6 +12,7 @@ from __future__ import annotations
 import threading
 
 from pilosa_tpu.cluster.cluster import STATE_NORMAL, Cluster
+from pilosa_tpu.cluster.event import EVENT_UPDATE
 from pilosa_tpu.cluster.harness import handle_cluster_message
 from pilosa_tpu.cluster.node import URI, Node
 from pilosa_tpu.cluster.sync import HolderSyncer
@@ -42,7 +43,9 @@ class ServerNode:
                  anti_entropy_interval: float | None = None,
                  check_nodes_interval: float | None = None,
                  join: str | None = None,
-                 data_dir: str | None = None):
+                 data_dir: str | None = None,
+                 tls_cert: str | None = None,
+                 tls_key: str | None = None):
         host, _, port = bind.partition(":")
         self.host, self.port = host or "127.0.0.1", int(port or 10101)
         # Node identity IS the address — member ids are built the same
@@ -56,12 +59,17 @@ class ServerNode:
 
         # Membership: boot peer list (each "host:port" becomes a Node);
         # joins/leaves after boot go through the coordinator's resize
-        # flow (handle_join / resize below).
+        # flow (handle_join / resize below). A TLS node assumes a
+        # uniformly-TLS cluster (the reference's model too): every peer
+        # URI gets the https scheme and internal RPC skips verification
+        # (operators deploying internal CAs can front their own certs).
+        scheme = "https" if tls_cert else "http"
         members = []
         all_addrs = sorted(set((peers or []) + [f"{self.host}:{self.port}"]))
         for i, addr in enumerate(all_addrs):
             h, _, p = addr.partition(":")
-            members.append(Node(id=addr, uri=URI(host=h, port=int(p)),
+            members.append(Node(id=addr,
+                                uri=URI(scheme=scheme, host=h, port=int(p)),
                                 is_coordinator=(i == 0 and join is None)))
         self.cluster = None
         if len(members) > 1 or join is not None:
@@ -69,6 +77,7 @@ class ServerNode:
                                    replica_n=replica_n,
                                    client=HTTPInternalClient())
             self.cluster.set_state(STATE_NORMAL)
+        self._scheme = scheme
 
         from pilosa_tpu.obs import MemoryStats
         self.stats = MemoryStats()
@@ -88,7 +97,8 @@ class ServerNode:
         self.api.message_handler = self.handle_message
         self.api.import_handler = self.handle_internal_import
         self.api.resize_handler = self.resize
-        self.http = HTTPServer(self.api, self.host, self.port)
+        self.http = HTTPServer(self.api, self.host, self.port,
+                               tls_cert=tls_cert, tls_key=tls_key)
         self.port = self.http.port
 
         self.syncer = None
@@ -149,7 +159,8 @@ class ServerNode:
         to the coordinator, which resizes us in and broadcasts the
         topology back (cluster.go:1796)."""
         h, _, p = self.join_addr.partition(":")
-        seed = Node(id=self.join_addr, uri=URI(host=h, port=int(p)))
+        seed = Node(id=self.join_addr,
+                    uri=URI(scheme=self._scheme, host=h, port=int(p)))
 
         def announce():
             import time
@@ -186,7 +197,6 @@ class ServerNode:
         """NodeEvent consumer (reference ReceiveEvent, cluster.go:1754):
         count the stream, and when a peer comes BACK, kick an immediate
         repair pass instead of waiting out the anti-entropy ticker."""
-        from pilosa_tpu.cluster.event import EVENT_UPDATE
         self.stats.with_tags(f"event:{ev.type}").count("nodeEvents")
         if (ev.type == EVENT_UPDATE and ev.state == "READY"
                 and self.syncer is not None and not self._closed):
@@ -254,8 +264,9 @@ class ServerNode:
 
     def close(self) -> None:
         self._closed = True
-        # Stop accepting work FIRST: queries racing shutdown would
-        # otherwise hit an already-closed batcher/store and 500.
+        # Stop accepting NEW connections first; handler threads are
+        # daemons and may outlive this (the batcher resolves
+        # synchronously after close for exactly that race).
         self.http.close()
         if self._sync_timer is not None:
             self._sync_timer.cancel()
@@ -354,7 +365,9 @@ class ServerNode:
             new_nodes = [n for n in new_nodes if n.id != node_id]
         elif action == "add":
             h, _, p = (addr or "").partition(":")
-            new_nodes.append(Node(id=addr, uri=URI(host=h, port=int(p))))
+            new_nodes.append(Node(id=addr,
+                                  uri=URI(scheme=self._scheme,
+                                          host=h, port=int(p))))
         else:
             raise ValueError(f"unknown resize action {action!r}")
         if not self._resize_gate.acquire(blocking=False):

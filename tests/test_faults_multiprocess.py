@@ -28,8 +28,8 @@ def _free_ports(n):
 def _spawn(addr, peers, data_dir, extra_env=None):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
-    env["PILOSA_TPU_ANTI_ENTROPY_INTERVAL"] = "0.5"
-    env["PILOSA_TPU_CHECK_NODES_INTERVAL"] = "0.3"
+    env["PILOSA_TPU_ANTI_ENTROPY_INTERVAL"] = "1.5"
+    env["PILOSA_TPU_CHECK_NODES_INTERVAL"] = "0.7"
     if extra_env:
         env.update(extra_env)
     return subprocess.Popen(
@@ -53,12 +53,12 @@ def _wait_up(addr, timeout=90):
 def _post(addr, path, body=""):
     r = urllib.request.Request(f"http://{addr}{path}",
                                data=body.encode(), method="POST")
-    return json.loads(urllib.request.urlopen(r, timeout=15).read() or b"{}")
+    return json.loads(urllib.request.urlopen(r, timeout=60).read() or b"{}")
 
 
 def _state(addr):
     return json.loads(urllib.request.urlopen(
-        f"http://{addr}/status", timeout=5).read())["state"]
+        f"http://{addr}/status", timeout=15).read())["state"]
 
 
 @pytest.mark.slow

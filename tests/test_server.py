@@ -216,3 +216,165 @@ def test_debug_routes(node):
     r = urllib.request.urlopen(b + "/debug/threads", timeout=10)
     body = r.read().decode()
     assert "---" in body and ("Thread" in body or "MainThread" in body)
+
+
+def test_tls_server(tmp_path):
+    """TLS listener (reference server/tlsconfig.go): self-signed cert,
+    https scheme, end-to-end query."""
+    import shutil
+    import ssl
+    import subprocess
+    if shutil.which("openssl") is None:
+        pytest.skip("no openssl")
+    cert = tmp_path / "cert.pem"
+    key = tmp_path / "key.pem"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(cert), "-days", "1",
+         "-subj", "/CN=localhost"],
+        check=True, capture_output=True, timeout=60)
+    n = ServerNode(bind="127.0.0.1:0", use_planner=False,
+                   tls_cert=str(cert), tls_key=str(key))
+    n.open()
+    try:
+        assert n.address.startswith("https://")
+        ctx = ssl.create_default_context()
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        r = urllib.request.Request(n.address + "/index/t", data=b"{}",
+                                   method="POST")
+        with urllib.request.urlopen(r, timeout=10, context=ctx) as resp:
+            assert resp.status == 200
+        r = urllib.request.Request(n.address + "/index/t/field/f",
+                                   data=b"{}", method="POST")
+        urllib.request.urlopen(r, timeout=10, context=ctx)
+        r = urllib.request.Request(n.address + "/index/t/query",
+                                   data=b"Set(1, f=1)", method="POST")
+        with urllib.request.urlopen(r, timeout=10, context=ctx) as resp:
+            assert json.loads(resp.read()) == {"results": [True]}
+    finally:
+        n.close()
+
+
+def test_tls_cluster_internal_rpc(tmp_path):
+    """A TLS cluster's INTERNAL RPC speaks https too: peer URIs carry
+    the scheme and the internal client skips self-signed verification
+    (reference tls.skip-verify)."""
+    import shutil
+    import socket as socketmod
+    import ssl
+    import subprocess
+    if shutil.which("openssl") is None:
+        pytest.skip("no openssl")
+    cert = tmp_path / "cert.pem"
+    key = tmp_path / "key.pem"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(cert), "-days", "1",
+         "-subj", "/CN=localhost"],
+        check=True, capture_output=True, timeout=60)
+    ports = []
+    for _ in range(2):
+        s = socketmod.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        s.close()
+    addrs = [f"127.0.0.1:{p}" for p in ports]
+    nodes = [ServerNode(bind=a, peers=[x for x in addrs if x != a],
+                        replica_n=2, use_planner=False,
+                        anti_entropy_interval=0.0, check_nodes_interval=0.0,
+                        tls_cert=str(cert), tls_key=str(key))
+             for a in addrs]
+    for n in nodes:
+        n.open()
+    try:
+        assert all(m.uri.scheme == "https"
+                   for m in nodes[0].cluster.nodes)
+        ctx = ssl.create_default_context()
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+
+        def post(path, body=""):
+            r = urllib.request.Request(nodes[0].address + path,
+                                       data=body.encode(), method="POST")
+            with urllib.request.urlopen(r, timeout=15, context=ctx) as resp:
+                return json.loads(resp.read() or b"{}")
+
+        post("/index/s")
+        post("/index/s/field/f")
+        # Replicated write fans out over https internal RPC.
+        assert post("/index/s/query", "Set(1, f=1)") == {"results": [True]}
+        assert post("/index/s/query", "Count(Row(f=1))") == {"results": [1]}
+        # Both replicas actually hold the bit (write went through TLS).
+        for n in nodes:
+            frag = n.holder.fragment("s", "f", "standard", 0)
+            assert frag is not None and frag.contains(1, 1)
+    finally:
+        for n in nodes:
+            try:
+                n.close()
+            except Exception:
+                pass
+
+
+def test_tls_dynamic_join(tmp_path):
+    """A new node can join a RUNNING TLS cluster: the resize add-path
+    and ResizeSource fallbacks carry the https scheme end-to-end."""
+    import shutil
+    import socket as socketmod
+    import ssl
+    import subprocess
+    import time
+    if shutil.which("openssl") is None:
+        pytest.skip("no openssl")
+    cert = tmp_path / "cert.pem"
+    key = tmp_path / "key.pem"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(cert), "-days", "1",
+         "-subj", "/CN=localhost"],
+        check=True, capture_output=True, timeout=60)
+    ports = []
+    for _ in range(3):
+        s = socketmod.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        s.close()
+    addrs = [f"127.0.0.1:{p}" for p in ports]
+    kw = dict(use_planner=False, anti_entropy_interval=0.0,
+              check_nodes_interval=0.0, tls_cert=str(cert),
+              tls_key=str(key))
+    nodes = [ServerNode(bind=a, peers=[x for x in addrs[:2] if x != a],
+                        **kw) for a in addrs[:2]]
+    for n in nodes:
+        n.open()
+    joiner = None
+    try:
+        ctx = ssl.create_default_context()
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+
+        def post(path, body=""):
+            r = urllib.request.Request(nodes[0].address + path,
+                                       data=body.encode(), method="POST")
+            with urllib.request.urlopen(r, timeout=15, context=ctx) as resp:
+                return json.loads(resp.read() or b"{}")
+
+        post("/index/j")
+        post("/index/j/field/f")
+        from pilosa_tpu.config import SHARD_WIDTH
+        for s in range(6):
+            post("/index/j/query", f"Set({s * SHARD_WIDTH}, f=1)")
+        joiner = ServerNode(bind=addrs[2], join=addrs[1], **kw)
+        joiner.open()
+        deadline = time.time() + 30
+        while time.time() < deadline and len(joiner.cluster.nodes) < 3:
+            time.sleep(0.2)
+        assert len(joiner.cluster.nodes) == 3
+        assert post("/index/j/query", "Count(Row(f=1))") == {"results": [6]}
+    finally:
+        for n in nodes + ([joiner] if joiner else []):
+            try:
+                n.close()
+            except Exception:
+                pass
