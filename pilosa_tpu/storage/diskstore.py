@@ -207,6 +207,12 @@ class DiskStore:
                 return
             try:
                 self.snapshot_fragment(key)
+            except Exception:
+                # A failed snapshot (ENOSPC, I/O error) must not kill
+                # the worker: the WAL still holds every op, the next
+                # trigger retries, and close() relies on live workers
+                # to drain the queue.
+                pass
             finally:
                 with self._lock:
                     self._snap_pending.discard(key)
@@ -296,11 +302,28 @@ class DiskStore:
                                                           "translate.jsonl")
 
     def close(self) -> None:
+        # Stop the snapshot workers and WAIT for them: a worker
+        # mid-snapshot would otherwise keep truncating WALs after the
+        # writers below are closed (and after the data dir is handed to
+        # a successor process). Workers catch their own exceptions, so
+        # sentinels land once the queue drains; the timeouts below are
+        # backstops, not the plan.
         for _ in self._workers:
             try:
-                self._snap_q.put_nowait(None)
+                self._snap_q.put(None, timeout=35)
             except queue.Full:
-                pass
+                break
+        for t in self._workers:
+            t.join(timeout=30)
+        if any(t.is_alive() for t in self._workers):
+            # A straggler is still snapshotting: leave the writers OPEN
+            # so its lock-held snapshot+truncate stays valid, and warn —
+            # closing them under it could lose acknowledged ops.
+            import sys
+            print("diskstore.close: snapshot worker still running; "
+                  "leaving WAL writers open", file=sys.stderr)
+            self.flush()
+            return
         self.flush()
         with self._lock:
             for w in self._writers.values():

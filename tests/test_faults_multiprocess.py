@@ -25,18 +25,19 @@ def _free_ports(n):
     return out
 
 
-def _spawn(addr, peers, data_dir, extra_env=None):
+def _spawn(addr, peers, data_dir, extra_env=None, log_path=None):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["PILOSA_TPU_ANTI_ENTROPY_INTERVAL"] = "1.5"
     env["PILOSA_TPU_CHECK_NODES_INTERVAL"] = "0.7"
     if extra_env:
         env.update(extra_env)
+    out = open(log_path, "ab") if log_path else subprocess.DEVNULL
     return subprocess.Popen(
         [sys.executable, "-m", "pilosa_tpu.cli", "server",
          "--bind", addr, "--peers", ",".join(peers),
          "--replica-n", "2", "--no-planner", "--data-dir", data_dir],
-        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        env=env, stdout=out, stderr=out)
 
 
 def _wait_up(addr, timeout=90):
@@ -66,8 +67,10 @@ def test_sigkill_degraded_then_autonomous_recovery(tmp_path):
     ports = _free_ports(2)
     addrs = [f"127.0.0.1:{p}" for p in ports]
     dirs = [str(tmp_path / f"n{i}") for i in range(2)]
+    logs = [str(tmp_path / f"n{i}.log") for i in range(2)]
     procs = [
-        _spawn(addrs[i], [addrs[1 - i]], dirs[i]) for i in range(2)
+        _spawn(addrs[i], [addrs[1 - i]], dirs[i], log_path=logs[i])
+        for i in range(2)
     ]
     try:
         for a in addrs:
@@ -87,13 +90,30 @@ def test_sigkill_degraded_then_autonomous_recovery(tmp_path):
         assert _state(addrs[0]) == "DEGRADED"
 
         # Write while the replica is dead; reads still served.
-        _post(addrs[0], "/index/i/query", "Set(3, f=1)")
+        try:
+            _post(addrs[0], "/index/i/query", "Set(3, f=1)")
+        except Exception:
+            # Diagnose a wedged survivor with its own thread dump.
+            try:
+                dump = urllib.request.urlopen(
+                    f"http://{addrs[0]}/debug/threads", timeout=10).read()
+                print("SURVIVOR THREAD DUMP:\n" + dump.decode())
+            except Exception as e2:
+                print("thread dump also failed:", e2)
+            for lp in logs:
+                try:
+                    print(f"--- {lp} ---")
+                    print(open(lp).read()[-3000:])
+                except OSError:
+                    pass
+            raise
         assert _post(addrs[0], "/index/i/query",
                      "Count(Row(f=1))") == {"results": [3]}
 
         # Restart the killed node in a FRESH data dir (total disk loss).
         procs[1] = _spawn(addrs[1], [addrs[0]],
-                          str(tmp_path / "n1-reborn"))
+                          str(tmp_path / "n1-reborn"),
+                          log_path=str(tmp_path / "n1-reborn.log"))
         _wait_up(addrs[1])
         deadline = time.time() + 60
         ok = False

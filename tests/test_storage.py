@@ -108,6 +108,9 @@ def test_snapshot_trigger_on_max_op_n(tmp_path):
         time.sleep(0.05)
     assert os.path.exists(snap)
     store.save_schema()
+    # Close (drains the snapshot queue) BEFORE reopening: reading a data
+    # dir still owned by a live store races its background truncations.
+    store.close()
     h2, _ = make_holder(d)
     assert h2.fragment("i", "f", "standard", 0).bit_count() == 25
 
