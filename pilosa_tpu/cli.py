@@ -34,6 +34,8 @@ _DEFAULTS = {
     "join": "",
     "tls_cert": "",
     "tls_key": "",
+    "tls_ca_cert": "",
+    "tls_skip_verify": "",
     "planner": True,
 }
 
@@ -78,6 +80,10 @@ def cmd_server(args) -> int:
         cfg["tls_cert"] = args.tls_cert
     if args.tls_key:
         cfg["tls_key"] = args.tls_key
+    if args.tls_ca_cert:
+        cfg["tls_ca_cert"] = args.tls_ca_cert
+    if args.tls_skip_verify:
+        cfg["tls_skip_verify"] = "true"
 
     from pilosa_tpu.server.node import ServerNode
     node = ServerNode(
@@ -91,6 +97,10 @@ def cmd_server(args) -> int:
         data_dir=cfg["data_dir"] or None,
         tls_cert=str(cfg["tls_cert"]) or None,
         tls_key=str(cfg["tls_key"]) or None,
+        tls_ca_cert=str(cfg["tls_ca_cert"]) or None,
+        tls_skip_verify=(str(cfg["tls_skip_verify"]).lower()
+                         in ("1", "true", "yes")
+                         if str(cfg["tls_skip_verify"]) else None),
     )
     node.open()  # starts the (single) serve loop in the background
     print(f"pilosa-tpu serving at {node.address}", file=sys.stderr)
@@ -228,6 +238,8 @@ def cmd_generate_config(args) -> int:
           'check-nodes-interval = 5.0\n'
           'tls-cert = ""\n'
           'tls-key = ""\n'
+          'tls-ca-cert = ""\n'
+          '# tls-skip-verify = false\n'
           'planner = true')
     return 0
 
@@ -246,6 +258,8 @@ def main(argv: list[str] | None = None) -> int:
                    help="host:port of a running member to join")
     s.add_argument("--tls-cert", default="")
     s.add_argument("--tls-key", default="")
+    s.add_argument("--tls-ca-cert", default="")
+    s.add_argument("--tls-skip-verify", action="store_true")
     s.add_argument("--config", default=None)
     s.set_defaults(fn=cmd_server)
 

@@ -87,6 +87,12 @@ class Cluster:
         inverse: we push instead of queue-poll; event.go:18-31)."""
         self._listeners.append(listener)
 
+    def unsubscribe(self, listener: Callable) -> None:
+        try:
+            self._listeners.remove(listener)
+        except ValueError:
+            pass
+
     def _emit(self, type_: str, node_id: str, state: str) -> None:
         ev = NodeEvent(type=type_, node_id=node_id, state=state)
         for fn in self._listeners:

@@ -78,9 +78,12 @@ class ClusterNode:
     def handle_message(self, message: dict) -> None:
         t = message.get("type")
         if t == "resize-instruction":
-            from pilosa_tpu.cluster.resize import apply_resize_instruction
-            apply_resize_instruction(self.holder, self.cluster.client,
-                                     self.cluster, message["sources"])
+            from pilosa_tpu.cluster.resize import handle_resize_instruction
+            handle_resize_instruction(self.holder, self.cluster.client,
+                                      self.cluster, message, self.id)
+        elif t == "resize-instruction-complete":
+            from pilosa_tpu.cluster.resize import deliver_completion
+            deliver_completion(message)
         elif t == "cluster-status":
             from pilosa_tpu.cluster.resize import apply_cluster_status
             apply_cluster_status(self.cluster, message["nodes"],

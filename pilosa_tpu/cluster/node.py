@@ -44,3 +44,12 @@ class Node:
                                        "host": self.uri.host,
                                        "port": self.uri.port},
                 "isCoordinator": self.is_coordinator}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "Node":
+        u = d.get("uri") or {}
+        return cls(id=d["id"],
+                   uri=URI(scheme=u.get("scheme", "http"),
+                           host=u.get("host", "localhost"),
+                           port=int(u.get("port", 10101))),
+                   is_coordinator=d.get("isCoordinator", False))

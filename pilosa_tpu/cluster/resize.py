@@ -16,6 +16,8 @@ fragment.go:2436).
 
 from __future__ import annotations
 
+import itertools
+import threading
 from dataclasses import asdict, dataclass
 
 from pilosa_tpu.cluster.cluster import (
@@ -25,6 +27,63 @@ from pilosa_tpu.cluster.cluster import (
 )
 from pilosa_tpu.cluster.event import EVENT_UPDATE
 from pilosa_tpu.cluster.node import URI, Node
+
+#: active jobs by id, so completion ACKs arriving as control-plane
+#: messages can find their job (reference: the coordinator's resizeJob
+#: map, cluster.go:1413).
+_JOBS: dict[str, "ResizeJob"] = {}
+_JOBS_LOCK = threading.Lock()
+_JOB_SEQ = itertools.count(1)
+
+
+def deliver_completion(message: dict) -> None:
+    """Route a resize-instruction-complete message to its job
+    (reference ResizeInstructionComplete, cluster.go:1413-1438)."""
+    with _JOBS_LOCK:
+        job = _JOBS.get(message.get("job", ""))
+    if job is not None:
+        job.complete(message.get("node", ""), message.get("error"))
+
+
+def handle_resize_instruction(holder, client, cluster: Cluster,
+                              message: dict, local_id: str) -> None:
+    """Target-side entry point. When the instruction carries a job id,
+    apply it in the BACKGROUND and ACK the coordinator with an explicit
+    resize-instruction-complete message — the dispatch RPC returns
+    immediately, so a large fragment stream can take arbitrarily longer
+    than any HTTP client timeout (reference followResizeInstruction runs
+    in a goroutine and POSTs ResizeInstructionComplete back,
+    cluster.go:1297-1315). Without a job id (direct/legacy callers) the
+    apply stays synchronous."""
+    job_id = message.get("job")
+    if job_id is None:
+        apply_resize_instruction(holder, client, cluster,
+                                 message["sources"],
+                                 schema=message.get("schema"))
+        return
+    coord = message.get("coordinator") or {}
+
+    def work():
+        err = None
+        try:
+            apply_resize_instruction(holder, client, cluster,
+                                     message["sources"],
+                                     schema=message.get("schema"))
+        except Exception as e:  # noqa: BLE001 — every failure must ACK
+            err = f"{type(e).__name__}: {e}"
+        node = cluster.node_by_id(coord.get("id", ""))
+        if node is None and coord.get("uri"):
+            node = Node.from_json(coord)
+        if node is None:
+            return
+        try:
+            client.send_message(node, {"type": "resize-instruction-complete",
+                                       "job": job_id, "node": local_id,
+                                       "error": err})
+        except (ConnectionError, RuntimeError):
+            pass  # coordinator's ACK deadline treats us as failed
+
+    threading.Thread(target=work, name="resize-apply", daemon=True).start()
 
 
 @dataclass
@@ -48,17 +107,30 @@ class ResizeSource:
 def fragment_sources(old: Cluster, new: Cluster, schema_fragments) -> dict[str, list[ResizeSource]]:
     """Pure placement diff: target node id -> fragments to fetch.
 
-    A node in the NEW owner set that wasn't an OLD owner fetches from the
-    first old owner (reference fragSources cluster.go:784-868)."""
+    A node in the NEW owner set that wasn't an OLD owner fetches from an
+    old owner that SURVIVES into the new view (reference fragSources
+    cluster.go:784-868 skips removed nodes at :823-826) — a node being
+    removed is usually dead, so it must never be chosen as a source.
+    Raises ValueError when a fragment has no surviving replica (the
+    reference's "not enough data to perform resize")."""
     out: dict[str, list[ResizeSource]] = {}
+    new_ids = {n.id for n in new.nodes}
     for index, field, view, shard in schema_fragments:
         old_owners = old.shard_nodes(index, shard)
+        if not old_owners:
+            continue
         old_ids = [n.id for n in old_owners]
         new_owners = [n.id for n in new.shard_nodes(index, shard)]
+        surviving = [n for n in old_owners if n.id in new_ids]
         for target in new_owners:
-            if target in old_ids or not old_owners:
+            if target in old_ids:
                 continue
-            src = old_owners[0]
+            if not surviving:
+                raise ValueError(
+                    f"resize: fragment {index}/{field}/{view}/{shard} has "
+                    f"no surviving replica to stream from (replication "
+                    f"factor too low to remove its owners)")
+            src = surviving[0]
             out.setdefault(target, []).append(ResizeSource(
                 source_node=src.id, index=index, field=field,
                 view=view, shard=shard,
@@ -81,10 +153,10 @@ def apply_resize_instruction(holder, client, cluster: Cluster,
         src = ResizeSource(**s)
         node = cluster.node_by_id(src.source_node)
         if node is None and src.source_host:
-            node = Node(id=src.source_node,
-                        uri=URI(scheme=src.source_scheme or "http",
-                                host=src.source_host,
-                                port=src.source_port))
+            node = Node.from_json({
+                "id": src.source_node,
+                "uri": {"scheme": src.source_scheme or "http",
+                        "host": src.source_host, "port": src.source_port}})
         if node is None:
             raise ConnectionError(
                 f"resize source {src.source_node!r} unknown")
@@ -112,13 +184,8 @@ def apply_cluster_status(cluster: Cluster, nodes_json: list[dict],
         cluster.replica_n = int(replica_n)
     if partition_n:
         cluster.partition_n = int(partition_n)
-    cluster.nodes = sorted(
-        (Node(id=n["id"],
-              uri=URI(scheme=n["uri"].get("scheme", "http"),
-                      host=n["uri"]["host"], port=n["uri"]["port"]),
-              is_coordinator=n.get("isCoordinator", False))
-         for n in nodes_json),
-        key=lambda n: n.id)
+    cluster.nodes = sorted((Node.from_json(n) for n in nodes_json),
+                           key=lambda n: n.id)
     cluster._update_state()
     if holder is not None and availability:
         for index, fields in availability.items():
@@ -147,14 +214,38 @@ class ResizeJob:
     shard availability); remote-only time views are re-synced by
     anti-entropy after the resize."""
 
+    #: how long the coordinator waits for every target's completion ACK.
+    #: Generous by design: fragment streaming is bounded by data volume,
+    #: not RPC timeouts, now that apply runs off the dispatch request.
+    ACK_TIMEOUT = 600.0
+
     def __init__(self, cluster: Cluster, holder, client):
         self.cluster = cluster
         self.holder = holder
         self.client = client
         self.state = "RUNNING"
+        self.job_id = f"resize-{next(_JOB_SEQ)}"
+        self._cond = threading.Condition()
+        self._pending: set[str] = set()
+        self.completed: list[str] = []
+        self.failed: list[str] = []
 
     def abort(self) -> None:
-        self.state = "ABORTED"
+        with self._cond:
+            self.state = "ABORTED"
+            self._cond.notify_all()
+
+    def complete(self, node_id: str, error: str | None) -> None:
+        """A target finished applying its instruction (ACK receiver)."""
+        with self._cond:
+            if node_id not in self._pending:
+                return
+            self._pending.discard(node_id)
+            if error:
+                self.failed.append(node_id)
+            else:
+                self.completed.append(node_id)
+            self._cond.notify_all()
 
     def _schema_fragments(self):
         out = set()
@@ -178,17 +269,34 @@ class ResizeJob:
                            replica_n=self.cluster.replica_n,
                            partition_n=self.cluster.partition_n)
         self.cluster.set_state(STATE_RESIZING)
-        #: per-target completion tracking (reference
-        #: ResizeInstructionComplete + per-node map, cluster.go:1315,
-        #: :1413-1438): the new topology is committed ONLY after every
-        #: target acknowledged its instruction; any failure leaves the
-        #: old topology fully intact.
-        self.completed: list[str] = []
-        self.failed: list[str] = []
+        # Per-target completion tracking (reference
+        # ResizeInstructionComplete + per-node map, cluster.go:1315,
+        # :1413-1438): the new topology is committed ONLY after every
+        # target acknowledged its instruction; any failure leaves the
+        # old topology fully intact. Remote targets apply in the
+        # background and ACK via an explicit resize-instruction-complete
+        # message, so a long fragment stream never hits an RPC timeout.
+        with _JOBS_LOCK:
+            _JOBS[self.job_id] = self
+
+        # A target that dies after accepting its dispatch would otherwise
+        # stall the job for the full ACK deadline with the resize gate
+        # held: let the failure detector's DOWN event fail its pending
+        # ACK immediately (the reference aborts the job on node-failure
+        # events, cluster.go:1754).
+        def on_event(ev):
+            if ev.state == "DOWN":
+                self.complete(ev.node_id, "node down during resize")
+
+        self.cluster.subscribe(on_event)
         try:
             schema = self.holder.schema()
-            instructions = fragment_sources(old_view, new_view,
-                                            self._schema_fragments())
+            try:
+                instructions = fragment_sources(old_view, new_view,
+                                                self._schema_fragments())
+            except ValueError:
+                self.state = "FAILED"
+                raise
             # Every ADDED node gets an instruction even with nothing to
             # fetch: the message carries the schema, which a fresh
             # joiner doesn't have yet.
@@ -196,6 +304,9 @@ class ResizeJob:
             for n in new_view.nodes:
                 if n.id not in old_ids:
                     instructions.setdefault(n.id, [])
+            local = self.cluster.node_by_id(self.cluster.local_id)
+            coord_json = local.to_json() if local is not None else {
+                "id": self.cluster.local_id}
             for target_id, sources in sorted(instructions.items()):
                 if self.state == "ABORTED":
                     return self.state
@@ -204,18 +315,34 @@ class ResizeJob:
                     if target_id == self.cluster.local_id:
                         apply_resize_instruction(self.holder, self.client,
                                                  old_view, payload)
+                        self.completed.append(target_id)
                     else:
                         node = new_view.node_by_id(target_id)
-                        # send_message is synchronous: a 2xx response IS
-                        # the target's completion ACK (it applies the
-                        # instruction inside the request).
+                        with self._cond:
+                            self._pending.add(target_id)
+                        # Dispatch only: the target applies in the
+                        # background and ACKs with
+                        # resize-instruction-complete.
                         self.client.send_message(
                             node, {"type": "resize-instruction",
+                                   "job": self.job_id,
+                                   "coordinator": coord_json,
                                    "schema": schema,
                                    "sources": payload})
-                    self.completed.append(target_id)
                 except (ConnectionError, LookupError, RuntimeError):
+                    with self._cond:
+                        self._pending.discard(target_id)
                     self.failed.append(target_id)
+            # Wait for every dispatched target's ACK (or abort/deadline).
+            with self._cond:
+                self._cond.wait_for(
+                    lambda: not self._pending or self.state == "ABORTED",
+                    timeout=self.ACK_TIMEOUT)
+                if self.state == "ABORTED":
+                    return self.state
+                if self._pending:  # deadline: never-ACKed targets failed
+                    self.failed.extend(sorted(self._pending))
+                    self._pending.clear()
             if self.failed:
                 # A target never confirmed its fragments: committing the
                 # new topology would route reads to holes. Old topology
@@ -239,6 +366,9 @@ class ResizeJob:
             self.state = "DONE"
             return self.state
         finally:
+            self.cluster.unsubscribe(on_event)
+            with _JOBS_LOCK:
+                _JOBS.pop(self.job_id, None)
             if self.cluster.state == STATE_RESIZING:
                 self.cluster.set_state(STATE_NORMAL)
 

@@ -119,9 +119,21 @@ class Call:
         return any(isinstance(v, Condition) for v in self.args.values())
 
     def clone(self) -> "Call":
+        # Deep-clone Call-valued args (and Calls nested inside list args):
+        # translation rewrites arg values in place, so a shallow copy would
+        # let one index's translated ids leak into the parse-cached tree.
+        def _clone_val(v: Any) -> Any:
+            if isinstance(v, Call):
+                return v.clone()
+            if isinstance(v, list):
+                return [_clone_val(x) for x in v]
+            if isinstance(v, Condition):
+                return Condition(op=v.op, value=_clone_val(v.value))
+            return v
+
         return Call(
             name=self.name,
-            args=dict(self.args),
+            args={k: _clone_val(v) for k, v in self.args.items()},
             children=[c.clone() for c in self.children],
         )
 

@@ -20,25 +20,38 @@ from pilosa_tpu.cluster.node import Node
 class HTTPInternalClient:
     """Implements the InternalClient protocol against peer HTTP servers."""
 
-    def __init__(self, timeout: float = 30.0):
+    def __init__(self, timeout: float = 30.0, ca_cert: str | None = None,
+                 skip_verify: bool | None = None):
         self._ssl_ctx = None
         self.timeout = timeout
+        self.ca_cert = ca_cert
+        # Verification policy (reference tls.skip-verify,
+        # server/config.go): with a CA bundle, verify by default; the
+        # CERT_NONE fallback is only for CA-less (self-signed) clusters
+        # or an explicit skip_verify=True.
+        self.skip_verify = (skip_verify if skip_verify is not None
+                            else ca_cert is None)
+        if ca_cert is not None:
+            # Fail fast at startup: a typo'd CA path raising lazily on
+            # the first HTTPS request would kill background threads
+            # (join/announce, anti-entropy) with an uncaught error.
+            import ssl
+            ssl.create_default_context(cafile=ca_cert)
 
     def _url(self, node: Node, path: str) -> str:
         return f"{node.uri}{path}"
 
     def _ctx(self, url: str):
-        """SSL context for https peers: internal RPC skips verification
-        (clusters use self-signed certs; the reference's
-        tls.skip-verify). Plain http gets None."""
+        """SSL context for https peers. Plain http gets None."""
         if not url.startswith("https:"):
             return None
         ctx = self._ssl_ctx
         if ctx is None:
             import ssl
-            ctx = ssl.create_default_context()
-            ctx.check_hostname = False
-            ctx.verify_mode = ssl.CERT_NONE
+            ctx = ssl.create_default_context(cafile=self.ca_cert)
+            if self.skip_verify:
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE
             self._ssl_ctx = ctx
         return ctx
 

@@ -45,7 +45,9 @@ class ServerNode:
                  join: str | None = None,
                  data_dir: str | None = None,
                  tls_cert: str | None = None,
-                 tls_key: str | None = None):
+                 tls_key: str | None = None,
+                 tls_ca_cert: str | None = None,
+                 tls_skip_verify: bool | None = None):
         host, _, port = bind.partition(":")
         self.host, self.port = host or "127.0.0.1", int(port or 10101)
         # Node identity IS the address — member ids are built the same
@@ -75,7 +77,9 @@ class ServerNode:
         if len(members) > 1 or join is not None:
             self.cluster = Cluster(local_id=self.id, nodes=members,
                                    replica_n=replica_n,
-                                   client=HTTPInternalClient())
+                                   client=HTTPInternalClient(
+                                       ca_cert=tls_ca_cert,
+                                       skip_verify=tls_skip_verify))
             self.cluster.set_state(STATE_NORMAL)
         self._scheme = scheme
 
@@ -301,10 +305,12 @@ class ServerNode:
     def handle_message(self, message: dict) -> None:
         t = message.get("type")
         if t == "resize-instruction" and self.cluster is not None:
-            from pilosa_tpu.cluster.resize import apply_resize_instruction
-            apply_resize_instruction(self.holder, self.cluster.client,
-                                     self.cluster, message["sources"],
-                                     schema=message.get("schema"))
+            from pilosa_tpu.cluster.resize import handle_resize_instruction
+            handle_resize_instruction(self.holder, self.cluster.client,
+                                      self.cluster, message, self.id)
+        elif t == "resize-instruction-complete":
+            from pilosa_tpu.cluster.resize import deliver_completion
+            deliver_completion(message)
         elif t == "cluster-status" and self.cluster is not None:
             from pilosa_tpu.cluster.resize import apply_cluster_status
             apply_cluster_status(self.cluster, message["nodes"],

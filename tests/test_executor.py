@@ -408,3 +408,36 @@ def test_store_requires_set_field(bsi_env):
     h, idx, e = bsi_env
     with pytest.raises(QueryError):
         q(e, "Store(Row(v > 0), v=1)")
+
+
+def test_parse_cache_not_poisoned_by_translation(env):
+    """Call.clone() must deep-clone Call-valued args: translation
+    rewrites the filter Row's key in place, and a shallow clone would
+    bake index A's translated id into the parse-cached tree, corrupting
+    the same query text run against index B (ADVICE r3 #1)."""
+    h, _, e = env
+    for name in ("a", "b"):
+        idx = h.create_index(name)
+        idx.create_field("f")
+        idx.create_field("g", FieldOptions(keys=True))
+    # "k" translates to different ids on a and b: allocate a decoy first
+    # on b so the shared key lands on a different row id.
+    e.execute("a", 'Set(1, g="k")')
+    e.execute("b", 'Set(9, g="decoy")')
+    e.execute("b", 'Set(2, g="k")')
+    e.execute("a", "Set(1, f=0)")
+    e.execute("b", "Set(2, f=0)")
+    query = 'GroupBy(Rows(f), filter=Row(g="k"))'
+    (ga,) = e.execute("a", query)
+    (gb,) = e.execute("b", query)  # same text: parse cache hit
+    assert [g.count for g in ga] == [1]
+    assert [g.count for g in gb] == [1]
+
+
+def test_call_clone_deep_copies_nested_calls():
+    from pilosa_tpu.pql.parser import parse
+    q = parse('GroupBy(Rows(f), filter=Row(g="k"))')
+    call = q.calls[0]
+    c2 = call.clone()
+    c2.args["filter"].args["g"] = 42
+    assert call.args["filter"].args["g"] == "k"

@@ -373,3 +373,80 @@ def test_streaming_fragment_transfer_constant_memory(monkeypatch, rng):
     # Each chunk bounded: budget + at most one whole row's overshoot.
     assert max(sizes) <= 2048 + SHARD_WIDTH
     assert sum(sizes) == total_bits            # no loss, no duplication
+
+
+def test_fragment_sources_skips_removed_node():
+    """A removed node must never be picked as a stream source — it is
+    usually dead (reference cluster.go:823-826)."""
+    old = Cluster("a", [Node(id="a"), Node(id="b"), Node(id="c")],
+                  replica_n=2)
+    new = Cluster("a", [Node(id="a"), Node(id="b")], replica_n=2)
+    frags = [("i", "f", "standard", s) for s in range(32)]
+    srcs = fragment_sources(old, new, frags)
+    for sources in srcs.values():
+        for s in sources:
+            assert s.source_node != "c"
+
+
+def test_fragment_sources_no_surviving_replica_errors():
+    """replica_n=1 + removing a shard's only owner: the resize must
+    refuse (data would be lost), like the reference's not-enough-data
+    error."""
+    old = Cluster("a", [Node(id="a"), Node(id="b")], replica_n=1)
+    new = Cluster("a", [Node(id="a")], replica_n=1)
+    # find a shard whose sole old owner is node b
+    shard = next(s for s in range(64)
+                 if old.shard_nodes("i", s)[0].id == "b")
+    with pytest.raises(ValueError):
+        fragment_sources(old, new, [("i", "f", "standard", shard)])
+
+
+def test_resize_ack_deadline_marks_silent_target_failed():
+    """A target that accepts the instruction but never ACKs must fail
+    the job at the ACK deadline — old topology stays live."""
+    lc = LocalCluster(2)
+    seed(lc)
+
+    class SilentPeer:
+        def handle_message(self, message):
+            pass  # swallow the instruction, never ACK
+
+    lc.client.register("nodeX", SilentPeer())
+    job = ResizeJob(lc[0].cluster, lc[0].holder, lc.client)
+    job.ACK_TIMEOUT = 0.5
+    state = job.run([Node(id=n.id, uri=n.uri) for n in lc[0].cluster.nodes]
+                    + [Node(id="nodeX", uri=URI(port=10199))])
+    assert state == "FAILED"
+    assert "nodeX" in job.failed
+    assert len(lc[0].cluster.nodes) == 2  # membership unchanged
+
+
+def test_down_event_fails_pending_ack_immediately():
+    """A target that dies after accepting its dispatch must not stall
+    the resize for the whole ACK deadline: the failure detector's DOWN
+    event fails its pending ACK at once."""
+    import threading
+
+    lc = LocalCluster(2)
+    seed(lc)
+
+    class AcceptNeverAck:
+        def handle_message(self, message):
+            pass  # accepted, then "crashed": no ACK ever
+
+    lc.client.register("nodeX", AcceptNeverAck())
+    job = ResizeJob(lc[0].cluster, lc[0].holder, lc.client)
+    job.ACK_TIMEOUT = 30.0  # deadline is NOT what unblocks us
+
+    def kill_target():
+        lc[0].cluster._emit("update", "nodeX", "DOWN")
+
+    t = threading.Timer(0.2, kill_target)
+    t.start()
+    import time
+    start = time.monotonic()
+    state = job.run([Node(id=n.id, uri=n.uri) for n in lc[0].cluster.nodes]
+                    + [Node(id="nodeX", uri=URI(port=10199))])
+    assert state == "FAILED"
+    assert time.monotonic() - start < 10.0
+    assert "nodeX" in job.failed
