@@ -15,6 +15,7 @@
 
 #include <cstdint>
 #include <cstdio>
+#include <algorithm>
 #include <cstdlib>
 #include <cstring>
 #include <vector>
@@ -26,6 +27,14 @@ int64_t roaring_decode(const uint8_t* buf, int64_t len, uint64_t* out,
 int64_t roaring_encode_bound(const uint64_t* pos, int64_t n);
 int64_t roaring_encode(const uint64_t* pos, int64_t n, uint8_t* out,
                        int64_t cap);
+void scatter_row_blocks(const uint64_t* cols, int64_t n, int exp,
+                        uint32_t* blocks, int64_t n_shards,
+                        int64_t words_per_shard, uint8_t* touched,
+                        int64_t* block_counts);
+int scatter_bsi_blocks(const uint64_t* cols, const int64_t* vals,
+                       int64_t n, int exp, int depth, uint32_t* blocks,
+                       int64_t n_shards, int64_t words_per_shard,
+                       uint8_t* touched, int64_t* block_counts);
 }
 
 namespace {
@@ -209,6 +218,34 @@ void one_case(const std::vector<uint8_t>& buf, bool valid) {
   }
 }
 
+// Sanitizer exercise of the bulk-import scatters (ASan/UBSan build):
+// random shapes through both entry points, including the staged
+// write-combining partition and the inline-count paths.
+void scatter_case() {
+  int exp = 14 + rnd() % 3;                       // small shard widths
+  int64_t wps = (1LL << exp) / 32;
+  int64_t n_shards = 1 + rnd() % 40;
+  int64_t n = 1 + rnd() % 300000;                 // crosses the 2^18 gate
+  std::vector<uint64_t> cols(n);
+  uint64_t span = (n_shards + 1) << exp;          // some out-of-range
+  for (auto& c : cols) c = rnd() % span;
+  std::vector<uint32_t> blocks(n_shards * wps, 0);
+  std::vector<uint8_t> touched(n_shards, 0);
+  std::vector<int64_t> counts(n_shards, 0);
+  scatter_row_blocks(cols.data(), n, exp, blocks.data(), n_shards, wps,
+                     touched.data(), counts.data());
+  int depth = 1 + rnd() % 20;
+  std::vector<int64_t> vals(n);
+  for (auto& v : vals)
+    v = (int64_t)(rnd() % (1ULL << depth)) - (1LL << (depth - 1));
+  std::vector<uint32_t> bblocks(n_shards * (depth + 2) * wps, 0);
+  std::fill(touched.begin(), touched.end(), 0);
+  std::vector<int64_t> bcounts(n_shards * (depth + 2), 0);
+  scatter_bsi_blocks(cols.data(), vals.data(), n, exp, depth,
+                     bblocks.data(), n_shards, wps, touched.data(),
+                     bcounts.data());
+}
+
 }  // namespace
 
 int main(int argc, char** argv) {
@@ -222,6 +259,7 @@ int main(int argc, char** argv) {
       for (int m = 0; m < k; m++) mutate(&buf);
     }
     one_case(buf, valid);
+    if (i % 2000 == 0) scatter_case();
   }
   printf("fuzz_roaring: %ld iterations clean\n", iters);
   return 0;

@@ -16,10 +16,111 @@
 //   popcount_words(words_u32, n_words)          -> total set bits
 
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <vector>
 
+#if defined(__AVX2__)
+#include <immintrin.h>
+#endif
+#if defined(__linux__)
+#include <sys/mman.h>
+#endif
+
 namespace {
+
+// --- write-combining radix partition -------------------------------------
+//
+// Shared by the bulk-import scatters: partitioning N random keys into
+// ~1000 per-shard output streams is memory-bandwidth bound, and naive
+// per-element stores both thrash the TLB (each store lands on a cold
+// page of a 100s-of-MB buffer) and pollute the cache with lines that
+// are written once and never read back.  Classic fix: stage 16 values
+// (one cache line) per shard in an L1-resident buffer and flush full
+// lines with non-temporal stores.  Segment starts are padded to
+// 16-element alignment so every flush is a whole aligned line.
+
+struct Partitioned {
+  // start[s] (inclusive) .. end[s] (exclusive) index shard s's values
+  // inside the 64-byte-aligned buffer `part` (capacity start[n_shards]).
+  std::vector<int64_t> start, end;
+  uint32_t* part = nullptr;
+  ~Partitioned() { std::free(part); }
+};
+
+// Ask the kernel for 2 MiB pages on a large fresh buffer BEFORE first
+// touch: on virtualized hosts each 4 KiB first-touch fault costs
+// microseconds, so a 200 MB staging buffer pays >1 s in faults alone —
+// with huge pages that drops to ~100 faults (and the TLB stops
+// thrashing during the many-stream partition writes).
+inline void advise_huge(void* p, size_t len) {
+#if defined(__linux__) && defined(MADV_HUGEPAGE)
+  uintptr_t a = (reinterpret_cast<uintptr_t>(p) + 4095) & ~uintptr_t(4095);
+  uintptr_t e = (reinterpret_cast<uintptr_t>(p) + len) & ~uintptr_t(4095);
+  if (e > a) madvise(reinterpret_cast<void*>(a), e - a, MADV_HUGEPAGE);
+#else
+  (void)p;
+  (void)len;
+#endif
+}
+
+inline void flush_line(uint32_t* dst, const uint32_t* src) {
+#if defined(__AVX2__)
+  _mm256_stream_si256(reinterpret_cast<__m256i*>(dst),
+                      _mm256_load_si256(reinterpret_cast<const __m256i*>(src)));
+  _mm256_stream_si256(reinterpret_cast<__m256i*>(dst) + 1,
+                      _mm256_load_si256(reinterpret_cast<const __m256i*>(src) + 1));
+#else
+  std::memcpy(dst, src, 64);
+#endif
+}
+
+// Partition local positions (cols & mask) by shard (cols >> exp).
+// Returns false on allocation failure.  Out-of-range shards are dropped,
+// matching the historical scatter behaviour.
+bool partition_by_shard(const uint64_t* cols, int64_t n, int exp,
+                        int64_t n_shards, Partitioned& out) {
+  const uint64_t mask = (1ULL << exp) - 1;
+  std::vector<int64_t> count(n_shards, 0);
+  for (int64_t k = 0; k < n; k++) {
+    uint64_t s = cols[k] >> exp;
+    if (static_cast<int64_t>(s) < n_shards) count[s]++;
+  }
+  out.start.resize(n_shards + 1);
+  out.start[0] = 0;
+  for (int64_t s = 0; s < n_shards; s++)
+    out.start[s + 1] = out.start[s] + ((count[s] + 15) & ~15LL);
+  const size_t part_bytes = ((out.start[n_shards] + 15) & ~15LL) * 4 + 64;
+  out.part = static_cast<uint32_t*>(std::aligned_alloc(64, part_bytes));
+  if (out.part == nullptr) return false;
+  advise_huge(out.part, part_bytes);
+  std::vector<int64_t> head(out.start.begin(), out.start.end() - 1);
+  std::vector<uint32_t> stage(n_shards * 16 + 16);
+  uint32_t* stg = reinterpret_cast<uint32_t*>(
+      (reinterpret_cast<uintptr_t>(stage.data()) + 63) & ~uintptr_t(63));
+  std::vector<uint8_t> fill(n_shards, 0);
+  for (int64_t k = 0; k < n; k++) {
+    uint64_t c = cols[k];
+    uint64_t s = c >> exp;
+    if (static_cast<int64_t>(s) >= n_shards) continue;
+    uint8_t f = fill[s];
+    stg[s * 16 + f] = static_cast<uint32_t>(c & mask);
+    if (++f == 16) {
+      flush_line(&out.part[head[s]], &stg[s * 16]);
+      head[s] += 16;
+      f = 0;
+    }
+    fill[s] = f;
+  }
+#if defined(__AVX2__)
+  _mm_sfence();
+#endif
+  for (int64_t s = 0; s < n_shards; s++)
+    for (uint8_t i = 0; i < fill[s]; i++)
+      out.part[head[s]++] = stg[s * 16 + i];
+  out.end.assign(head.begin(), head.end());
+  return true;
+}
 
 constexpr uint32_t kMagic = 12348;
 // Official RoaringFormatSpec cookies (32-bit roaring; the constants are
@@ -388,7 +489,8 @@ int64_t intersection_count_words(const uint32_t* a, const uint32_t* b,
 
 void scatter_row_blocks(const uint64_t* cols, int64_t n, int exp,
                         uint32_t* blocks, int64_t n_shards,
-                        int64_t words_per_shard, uint8_t* touched) {
+                        int64_t words_per_shard, uint8_t* touched,
+                        int64_t* block_counts) {
   // Bulk-import scatter for ONE bitmap row: absolute column ids ->
   // dense per-shard word blocks (blocks is [n_shards, words_per_shard],
   // caller-zeroed). The order-insensitivity of a bitset means no sort
@@ -403,7 +505,9 @@ void scatter_row_blocks(const uint64_t* cols, int64_t n, int exp,
   // shard into one block that fits in L2.
   const uint64_t mask = (1ULL << exp) - 1;
   // Small batches: partitioning overhead isn't worth it.
-  if (n < (1 << 18) || n_shards <= 4) {
+  Partitioned p;
+  if (n < (1 << 18) || n_shards <= 4 ||
+      !partition_by_shard(cols, n, exp, n_shards, p)) {
     for (int64_t k = 0; k < n; k++) {
       uint64_t c = cols[k];
       uint64_t shard = c >> exp;
@@ -412,38 +516,41 @@ void scatter_row_blocks(const uint64_t* cols, int64_t n, int exp,
       blocks[shard * words_per_shard + (local >> 5)] |= 1u << (local & 31);
       touched[shard] = 1;
     }
+    if (block_counts != nullptr)
+      for (int64_t s = 0; s < n_shards; s++) {
+        if (!touched[s]) continue;
+        const uint32_t* block = blocks + s * words_per_shard;
+        int64_t total = 0;
+        for (int64_t w = 0; w < words_per_shard; w++)
+          total += __builtin_popcount(block[w]);
+        block_counts[s] = total;
+      }
     return;
   }
-  std::vector<int64_t> counts(n_shards + 1, 0);
-  for (int64_t k = 0; k < n; k++) {
-    uint64_t shard = cols[k] >> exp;
-    if (static_cast<int64_t>(shard) < n_shards) counts[shard + 1]++;
-  }
-  for (int64_t s = 0; s < n_shards; s++) counts[s + 1] += counts[s];
-  std::vector<uint32_t> part(counts[n_shards]);
-  std::vector<int64_t> head(counts.begin(), counts.end() - 1);
-  for (int64_t k = 0; k < n; k++) {
-    uint64_t c = cols[k];
-    uint64_t shard = c >> exp;
-    if (static_cast<int64_t>(shard) >= n_shards) continue;
-    part[head[shard]++] = static_cast<uint32_t>(c & mask);
-  }
   for (int64_t s = 0; s < n_shards; s++) {
-    int64_t lo = counts[s], hi = counts[s + 1];
+    int64_t lo = p.start[s], hi = p.end[s];
     if (lo == hi) continue;
     uint32_t* block = blocks + s * words_per_shard;
+    // Count fresh bits inline (the old word is already loaded for the
+    // OR) — cheaper than a whole-block popcount pass afterwards, which
+    // would re-read every word including the untouched majority.
+    int64_t cnt = 0;
     for (int64_t k = lo; k < hi; k++) {
-      uint32_t local = part[k];
-      block[local >> 5] |= 1u << (local & 31);
+      uint32_t local = p.part[k];
+      uint32_t bit = 1u << (local & 31);
+      uint32_t old = block[local >> 5];
+      cnt += (old & bit) == 0;
+      block[local >> 5] = old | bit;
     }
     touched[s] = 1;
+    if (block_counts != nullptr) block_counts[s] = cnt;
   }
 }
 
-void scatter_bsi_blocks(const uint64_t* cols, const int64_t* vals, int64_t n,
-                        int exp, int depth, uint32_t* blocks,
-                        int64_t n_shards, int64_t words_per_shard,
-                        uint8_t* touched) {
+int scatter_bsi_blocks(const uint64_t* cols, const int64_t* vals, int64_t n,
+                       int exp, int depth, uint32_t* blocks,
+                       int64_t n_shards, int64_t words_per_shard,
+                       uint8_t* touched, int64_t* block_counts) {
   // BSI bulk-import scatter: (column, value) pairs -> dense bit-plane
   // blocks. blocks is [n_shards, depth+2, words_per_shard] caller-zeroed;
   // per shard the row order is exists, sign, then magnitude planes
@@ -456,40 +563,94 @@ void scatter_bsi_blocks(const uint64_t* cols, const int64_t* vals, int64_t n,
   // before the new value lands — no host-side dedupe sort needed.
   const uint64_t mask = (1ULL << exp) - 1;
   const int64_t rows = depth + 2;
-  std::vector<int64_t> counts(n_shards + 1, 0);
+  std::vector<int64_t> count(n_shards, 0);
   for (int64_t k = 0; k < n; k++) {
     uint64_t shard = cols[k] >> exp;
-    if (static_cast<int64_t>(shard) < n_shards) counts[shard + 1]++;
+    if (static_cast<int64_t>(shard) < n_shards) count[shard]++;
   }
-  for (int64_t s = 0; s < n_shards; s++) counts[s + 1] += counts[s];
-  std::vector<uint32_t> plocal(counts[n_shards]);
-  std::vector<int64_t> pval(counts[n_shards]);
-  std::vector<int64_t> head(counts.begin(), counts.end() - 1);
+  // Same write-combining partition as scatter_row_blocks, with a
+  // parallel int64 value stream (16 values = two 64-byte lines).
+  std::vector<int64_t> start(n_shards + 1);
+  start[0] = 0;
+  for (int64_t s = 0; s < n_shards; s++)
+    start[s + 1] = start[s] + ((count[s] + 15) & ~15LL);
+  const int64_t cap = start[n_shards];
+  const size_t plocal_bytes = ((cap + 15) & ~15LL) * 4 + 64;
+  const size_t pval_bytes = ((cap + 15) & ~15LL) * 8 + 128;
+  uint32_t* plocal = static_cast<uint32_t*>(
+      std::aligned_alloc(64, plocal_bytes));
+  int64_t* pval = static_cast<int64_t*>(std::aligned_alloc(64, pval_bytes));
+  if (plocal != nullptr) advise_huge(plocal, plocal_bytes);
+  if (pval != nullptr) advise_huge(pval, pval_bytes);
+  std::vector<int64_t> head(start.begin(), start.end() - 1);
+  std::vector<uint32_t> lstage_v(n_shards * 16 + 16);
+  std::vector<int64_t> vstage_v(n_shards * 16 + 8);
+  uint32_t* lstage = reinterpret_cast<uint32_t*>(
+      (reinterpret_cast<uintptr_t>(lstage_v.data()) + 63) & ~uintptr_t(63));
+  int64_t* vstage = reinterpret_cast<int64_t*>(
+      (reinterpret_cast<uintptr_t>(vstage_v.data()) + 63) & ~uintptr_t(63));
+  std::vector<uint8_t> fill(n_shards, 0);
+  if (plocal == nullptr || pval == nullptr) {
+    std::free(plocal);
+    std::free(pval);
+    return -1;  // alloc failure: caller must fall back (blocks untouched)
+  }
   for (int64_t k = 0; k < n; k++) {
     uint64_t c = cols[k];
     uint64_t shard = c >> exp;
     if (static_cast<int64_t>(shard) >= n_shards) continue;
-    int64_t at = head[shard]++;
-    plocal[at] = static_cast<uint32_t>(c & mask);
-    pval[at] = vals[k];
+    uint8_t f = fill[shard];
+    lstage[shard * 16 + f] = static_cast<uint32_t>(c & mask);
+    vstage[shard * 16 + f] = vals[k];
+    if (++f == 16) {
+      flush_line(&plocal[head[shard]], &lstage[shard * 16]);
+#if defined(__AVX2__)
+      for (int i = 0; i < 4; i++)
+        _mm256_stream_si256(
+            reinterpret_cast<__m256i*>(&pval[head[shard]]) + i,
+            _mm256_load_si256(
+                reinterpret_cast<const __m256i*>(&vstage[shard * 16]) + i));
+#else
+      std::memcpy(&pval[head[shard]], &vstage[shard * 16], 128);
+#endif
+      head[shard] += 16;
+      f = 0;
+    }
+    fill[shard] = f;
   }
+#if defined(__AVX2__)
+  _mm_sfence();
+#endif
+  for (int64_t s = 0; s < n_shards; s++)
+    for (uint8_t i = 0; i < fill[s]; i++) {
+      plocal[head[s]] = lstage[s * 16 + i];
+      pval[head[s]++] = vstage[s * 16 + i];
+    }
+  // Value-at-a-time per shard with INLINE per-plane counts: dedupe
+  // first against the exists plane (walking the shard's slice BACKWARD
+  // keeps the LAST occurrence, preserving last-write-wins on the
+  // caller-guaranteed fresh view), so the set passes never need the
+  // all-plane duplicate clear, and counts come for free with the sets —
+  // a whole-plane popcount pass afterwards would re-read
+  // (depth+2)*128 KiB per shard, dwarfing a sparse batch.
+  std::vector<int64_t> cnt(rows);
   for (int64_t s = 0; s < n_shards; s++) {
-    int64_t lo = counts[s], hi = counts[s + 1];
+    int64_t lo = start[s], hi = head[s];
     if (lo == hi) continue;
     uint32_t* base = blocks + s * rows * words_per_shard;
-    for (int64_t k = lo; k < hi; k++) {
+    std::fill(cnt.begin(), cnt.end(), 0);
+    for (int64_t k = hi - 1; k >= lo; k--) {
       uint32_t local = plocal[k];
       int64_t w = local >> 5;
       uint32_t bit = 1u << (local & 31);
-      if (base[w] & bit) {  // duplicate column: clear every plane bit
-        for (int64_t r = 1; r < rows; r++)
-          base[r * words_per_shard + w] &= ~bit;
-      }
+      if (base[w] & bit) continue;  // a later write owns this column
       base[w] |= bit;  // exists row
+      cnt[0]++;
       int64_t v = pval[k];
       uint64_t mag;
       if (v < 0) {
         base[words_per_shard + w] |= bit;  // sign row
+        cnt[1]++;
         mag = static_cast<uint64_t>(-v);
       } else {
         mag = static_cast<uint64_t>(v);
@@ -497,11 +658,19 @@ void scatter_bsi_blocks(const uint64_t* cols, const int64_t* vals, int64_t n,
       while (mag) {
         int i = __builtin_ctzll(mag);
         mag &= mag - 1;
-        if (i < depth) base[(2 + i) * words_per_shard + w] |= bit;
+        if (i < depth) {
+          base[(2 + i) * words_per_shard + w] |= bit;
+          cnt[2 + i]++;
+        }
       }
     }
     touched[s] = 1;
+    if (block_counts != nullptr)
+      for (int64_t r = 0; r < rows; r++) block_counts[s * rows + r] = cnt[r];
   }
+  std::free(plocal);
+  std::free(pval);
+  return 0;
 }
 
 }  // extern "C"

@@ -558,12 +558,21 @@ class Field:
         distinct = np.unique(rows[:4096])
         if len(distinct) > self._SCATTER_MAX_ROWS:
             return False
-        masks = [rows == rid for rid in distinct.tolist()]
-        covered = masks[0].sum()
-        for m in masks[1:]:
-            covered += m.sum()
-        if int(covered) != len(rows):  # sample missed rows: bail
-            return False
+        if len(distinct) == 1:
+            # Single-row batch (the bulk-load common case): a min/max
+            # scan proves coverage without materializing a 1-bit-per-
+            # element mask array.
+            rid = int(distinct[0])
+            if int(rows.min()) != rid or int(rows.max()) != rid:
+                return False
+            masks: list = [None]
+        else:
+            masks = [rows == rid for rid in distinct.tolist()]
+            covered = masks[0].sum()
+            for m in masks[1:]:
+                covered += m.sum()
+            if int(covered) != len(rows):  # sample missed rows: bail
+                return False
         exp = SHARD_WIDTH.bit_length() - 1
         n_shards = (int(column_ids.max()) >> exp) + 1
         if n_shards * WORDS_PER_SHARD * 4 > self._SCATTER_MAX_BYTES:
@@ -574,12 +583,21 @@ class Field:
                 exp, n_shards, WORDS_PER_SHARD)
             if out is None:
                 return False
-            blocks, touched = out
-            for shard in np.flatnonzero(touched).tolist():
+            blocks, touched, counts = out
+            shards = np.flatnonzero(touched)
+            # Dense batches use nearly the whole buffer: hand fragments
+            # VIEWS into it (slices are disjoint, so in-place fragment
+            # mutation stays correct) — copying would double the memory
+            # traffic for no pinning benefit. Sparse batches copy so a
+            # few live rows can't pin a huge base array. The test is
+            # BYTES USED (adopted rows keep the whole base alive).
+            used = len(shards) * WORDS_PER_SHARD * 4
+            adopt = used * 2 >= blocks.nbytes
+            for shard in shards.tolist():
                 frag = view.create_fragment_if_not_exists(int(shard))
-                # Copy the row out of the big buffer so an adopted dense
-                # block never pins all shards' blocks via the base array.
-                frag.merge_row_words(int(rid), blocks[shard].copy())
+                row = blocks[shard] if adopt else blocks[shard].copy()
+                frag.merge_row_words(int(rid), row,
+                                     bit_count=int(counts[shard]))
         return True
 
     def import_values(self, column_ids, values, clear: bool = False) -> None:
@@ -645,15 +663,24 @@ class Field:
                                         WORDS_PER_SHARD)
         if out is None:
             return False
-        blocks, touched = out
-        for shard in np.flatnonzero(touched).tolist():
+        blocks, touched, counts = out
+        shards = np.flatnonzero(touched)
+        # Bytes-used test (see _scatter_import): only NON-EMPTY planes
+        # get adopted, so count them — a batch whose values light few
+        # planes must copy rather than pin the whole plane buffer.
+        used = int(np.count_nonzero(counts)) * WORDS_PER_SHARD * 4
+        adopt = used * 2 >= blocks.nbytes
+        for shard in shards.tolist():
             frag = view.create_fragment_if_not_exists(int(shard))
             for r in range(depth + 2):
                 # Per-shard plane order: exists, sign, magnitude planes
                 # (BSI row ids 0, 1, 2+i — fragment.go:87-93).
                 row_id = r if r < 2 else BSI_OFFSET_BIT + (r - 2)
                 assert BSI_SIGN_BIT == 1
-                frag.merge_row_words(row_id, blocks[shard][r].copy())
+                row = (blocks[shard][r] if adopt
+                       else blocks[shard][r].copy())
+                frag.merge_row_words(row_id, row,
+                                     bit_count=int(counts[shard][r]))
         return True
 
     def import_roaring(self, shard: int, data: bytes, view: str = VIEW_STANDARD,

@@ -77,21 +77,50 @@ def _load() -> ctypes.CDLL | None:
             ctypes.c_int,
             np.ctypeslib.ndpointer(np.uint32, flags="C"), ctypes.c_int64,
             ctypes.c_int64,
-            np.ctypeslib.ndpointer(np.uint8, flags="C")]
-        lib.scatter_bsi_blocks.restype = None
+            np.ctypeslib.ndpointer(np.uint8, flags="C"),
+            np.ctypeslib.ndpointer(np.int64, flags="C")]
+        lib.scatter_bsi_blocks.restype = ctypes.c_int
         lib.scatter_bsi_blocks.argtypes = [
             np.ctypeslib.ndpointer(np.uint64, flags="C"),
             np.ctypeslib.ndpointer(np.int64, flags="C"), ctypes.c_int64,
             ctypes.c_int, ctypes.c_int,
             np.ctypeslib.ndpointer(np.uint32, flags="C"), ctypes.c_int64,
             ctypes.c_int64,
-            np.ctypeslib.ndpointer(np.uint8, flags="C")]
+            np.ctypeslib.ndpointer(np.uint8, flags="C"),
+            np.ctypeslib.ndpointer(np.int64, flags="C")]
         _lib = lib
         return _lib
 
 
 def available() -> bool:
     return _load() is not None
+
+
+_MADV_HUGEPAGE = 14
+_PAGE = 4096
+_libc = None
+
+
+def _advise_huge(arr: np.ndarray) -> None:
+    """Opt a large, not-yet-touched buffer into 2 MiB pages (Linux
+    MADV_HUGEPAGE). First-touch faults on virtualized hosts cost ~µs per
+    4 KiB page — over 1 s for the scatter buffers — and the partition's
+    ~1000 write streams thrash a 4 KiB-page TLB. Best-effort: any
+    failure silently keeps normal pages."""
+    global _libc
+    if not hasattr(os, "posix_fadvise"):  # non-POSIX: skip
+        return
+    try:
+        if _libc is None:
+            _libc = ctypes.CDLL(None, use_errno=True)
+        addr = arr.ctypes.data
+        a = (addr + _PAGE - 1) & ~(_PAGE - 1)
+        e = (addr + arr.nbytes) & ~(_PAGE - 1)
+        if e > a:
+            _libc.madvise(ctypes.c_void_p(a), ctypes.c_size_t(e - a),
+                          ctypes.c_int(_MADV_HUGEPAGE))
+    except Exception:
+        pass
 
 
 def decode_roaring(buf: bytes) -> np.ndarray:
@@ -178,26 +207,30 @@ def scatter_row_blocks(cols: np.ndarray, exp: int,
                        n_shards: int, words_per_shard: int):
     """Scatter one row's absolute column ids into dense per-shard word
     blocks in a single unsorted pass. Returns (blocks[n_shards, W],
-    touched[n_shards] bool) or None when the native library is missing
-    (callers fall back to the sorted import path)."""
+    touched[n_shards] bool, counts[n_shards] int64 — set bits per
+    block, counted cache-hot) or None when the native library is
+    missing (callers fall back to the sorted import path)."""
     lib = _load()
     if lib is None:
         return None
     cols = np.ascontiguousarray(cols, dtype=np.uint64)
     blocks = np.zeros((n_shards, words_per_shard), dtype=np.uint32)
+    _advise_huge(blocks)
     touched = np.zeros(n_shards, dtype=np.uint8)
+    counts = np.zeros(n_shards, dtype=np.int64)
     lib.scatter_row_blocks(cols, len(cols), exp,
                            blocks.reshape(-1), n_shards, words_per_shard,
-                           touched)
-    return blocks, touched.astype(bool)
+                           touched, counts)
+    return blocks, touched.astype(bool), counts
 
 
 def scatter_bsi_blocks(cols: np.ndarray, vals: np.ndarray, exp: int,
                        depth: int, n_shards: int, words_per_shard: int):
     """Scatter (column, value) pairs into dense BSI bit-plane blocks
     ([n_shards, depth+2, W]; per-shard rows: exists, sign, planes) in one
-    native pass. Columns must be unique. Returns (blocks, touched) or
-    None when the native library is missing."""
+    native pass. Columns must be unique. Returns (blocks, touched,
+    counts[n_shards, depth+2]) or None when the native library is
+    missing."""
     lib = _load()
     if lib is None:
         return None
@@ -205,8 +238,13 @@ def scatter_bsi_blocks(cols: np.ndarray, vals: np.ndarray, exp: int,
     vals = np.ascontiguousarray(vals, dtype=np.int64)
     blocks = np.zeros((n_shards, depth + 2, words_per_shard),
                       dtype=np.uint32)
+    _advise_huge(blocks)
     touched = np.zeros(n_shards, dtype=np.uint8)
-    lib.scatter_bsi_blocks(cols, vals, len(cols), exp, depth,
-                           blocks.reshape(-1), n_shards, words_per_shard,
-                           touched)
-    return blocks, touched.astype(bool)
+    counts = np.zeros((n_shards, depth + 2), dtype=np.int64)
+    rc = lib.scatter_bsi_blocks(cols, vals, len(cols), exp, depth,
+                                blocks.reshape(-1), n_shards,
+                                words_per_shard, touched,
+                                counts.reshape(-1))
+    if rc != 0:  # staging alloc failed: caller takes the exact path
+        return None
+    return blocks, touched.astype(bool), counts

@@ -132,6 +132,14 @@ class MeshPlanner:
             fut: Future = Future()
             fut.set_result(0)
             return fut
+        fn, arrays = self.prepare_count(idx, c, shards)
+        return self.dispatch_count(fn, arrays)
+
+    def prepare_count(self, idx: Index, c: Call, shards: list[int]):
+        """Resolve Count(tree) to its (jitted fn, leaf device arrays)
+        without dispatching — the executor's prepared-query fast path
+        caches the pair and re-dispatches with zero per-query planning
+        as long as the index epochs stand still."""
         # schema_epoch: plans bake field STRUCTURE (a BSI comparator's
         # bit-depth, sign-class branches, base folds), so any schema
         # change — field create/delete, bit-depth growth — must miss.
@@ -154,11 +162,17 @@ class MeshPlanner:
                     self._plan_cache.popitem(last=False)
         arrays = [self._fetch_leaf(idx, leaf, tuple(shards))
                   for leaf in leaves]
-        out = fn(*arrays)
+        return fn, arrays
+
+    @staticmethod
+    def _sum_host(host) -> int:
         # Per-shard int32 popcounts (≤2^20 each) summed in Python ints —
         # immune to int32 overflow past ~2k full shards.
-        return self.batcher.submit(
-            out, lambda host: int(host.astype(np.int64).sum()))
+        return int(host.astype(np.int64).sum())
+
+    def dispatch_count(self, fn, arrays):
+        """Enqueue a prepared count's device program; Future[int]."""
+        return self.batcher.submit(fn(*arrays), self._sum_host)
 
     def _tree_stack(self, idx: Index, c: Call, shards: list[int]) -> jax.Array:
         """Evaluate a bitmap tree to its stacked [S_pad, W] device array."""
