@@ -44,6 +44,32 @@ class Cluster:
         self._lock = threading.RLock()
         #: NodeEvent consumers (cluster/event.py).
         self._listeners: list[Callable] = []
+        #: shared fan-out pool for map_reduce (lazily created): a pool
+        #: per query cost ~0.5 ms of thread spawn on a slow host and
+        #: capped concurrency at one query's node count; sharing lets
+        #: CONCURRENT cluster queries overlap all their remote hops.
+        self._fanout_pool = None
+        self._fanout_lock = threading.Lock()
+
+    #: shared fan-out pool size — bounds total in-flight remote
+    #: sub-queries, not per-query fan-out.
+    FANOUT_POOL_SIZE = 32
+
+    def _pool(self):
+        if self._fanout_pool is None:
+            with self._fanout_lock:
+                if self._fanout_pool is None:
+                    self._fanout_pool = ThreadPoolExecutor(
+                        max_workers=self.FANOUT_POOL_SIZE,
+                        thread_name_prefix="fanout")
+        return self._fanout_pool
+
+    def close(self) -> None:
+        """Release the fan-out pool (idempotent)."""
+        with self._fanout_lock:
+            pool, self._fanout_pool = self._fanout_pool, None
+        if pool is not None:
+            pool.shutdown(wait=False, cancel_futures=True)
 
     # -- membership --------------------------------------------------------
 
@@ -211,24 +237,41 @@ class Cluster:
                     nodes = [n for n in nodes if n.id != node_id]
                     failed.extend(node_shards)
             else:
-                with ThreadPoolExecutor(max_workers=len(groups)) as pool:
-                    for node_id, node_shards in groups.items():
-                        if node_id == self.local_id:
-                            fut = pool.submit(run_local, node_shards)
-                        else:
-                            fut = pool.submit(run_remote, node_id, node_shards)
+                # Remote hops dispatch as futures on the SHARED pool and
+                # the LOCAL batch runs on this thread concurrently with
+                # them — reduce consumes completions afterwards
+                # (reference mapReduce's goroutine fan-in,
+                # executor.go:2455).
+                pool = self._pool()
+                local_shards = None
+                for node_id, node_shards in groups.items():
+                    if node_id == self.local_id:
+                        local_shards = node_shards
+                    else:
+                        fut = pool.submit(run_remote, node_id, node_shards)
                         tasks.append((node_id, node_shards, fut))
-                    for node_id, node_shards, fut in tasks:
-                        try:
-                            acc = fut.result()
-                        except ConnectionError:
-                            # Failover: drop the node, re-map its shards
-                            # onto replicas (executor.go:2492-2503).
-                            nodes = [n for n in nodes if n.id != node_id]
-                            failed.extend(node_shards)
-                            continue
+                if local_shards is not None:
+                    try:
+                        acc = run_local(local_shards)
                         result = acc if result is None else \
                             reduce_fn(result, acc)
+                    except ConnectionError:
+                        # Drop the local node too — otherwise its failed
+                        # shards re-map straight back to it and the
+                        # retry loop never terminates.
+                        nodes = [n for n in nodes if n.id != self.local_id]
+                        failed.extend(local_shards)
+                for node_id, node_shards, fut in tasks:
+                    try:
+                        acc = fut.result()
+                    except ConnectionError:
+                        # Failover: drop the node, re-map its shards
+                        # onto replicas (executor.go:2492-2503).
+                        nodes = [n for n in nodes if n.id != node_id]
+                        failed.extend(node_shards)
+                        continue
+                    result = acc if result is None else \
+                        reduce_fn(result, acc)
             pending = failed
         return result
 

@@ -1,0 +1,111 @@
+"""Cross-node result-cache invalidation.
+
+The executor's result cache is validated by the index mutation epoch,
+which only local writes bump — so cluster-mode coordinator caching needs
+peers to learn about each other's writes. Every local epoch bump marks
+the index dirty here; marks are coalesced per index inside a small
+trailing window and broadcast as ``index-dirty`` control messages, and
+the receiving node bumps its own epoch WITHOUT re-notifying (no echo
+storm). Consistency is the reference's: eventual across nodes (there is
+no cross-node read-your-writes either way — a remote write is visible
+only after its owner applied it), with staleness bounded by
+window + one control-message delivery.
+
+Reference analog: the cache-invalidation role of NodeStatus/broadcast
+messages (broadcast.go:55-72); the reference sidesteps the problem by
+having no coordinator result cache at all — here the cache is the system
+answer to a device link whose per-sync latency dwarfs compute, so
+invalidation has to exist.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class DirtyBroadcaster:
+    """Coalescing per-index ``index-dirty`` fan-out."""
+
+    #: trailing coalesce window (seconds): a write burst sends at most
+    #: one broadcast per index per window, plus one trailing flush.
+    DEFAULT_WINDOW = 0.05
+
+    def __init__(self, cluster, window: float | None = None):
+        self.cluster = cluster
+        self.window = self.DEFAULT_WINDOW if window is None else window
+        self._lock = threading.Lock()
+        self._last_sent: dict[str, float] = {}
+        self._pending: set[str] = set()
+        self._timer: threading.Timer | None = None
+        self._closed = False
+
+    def attach(self, idx) -> None:
+        """Subscribe to an index's data epoch (Holder.index_listener)."""
+        idx.epoch.subscribe(lambda name=idx.name: self.mark(name))
+
+    def mark(self, index_name: str) -> None:
+        """A local write bumped this index's epoch."""
+        if self._closed:
+            return
+        now = time.monotonic()
+        with self._lock:
+            if index_name in self._pending:
+                return  # a flush is already scheduled
+            last = self._last_sent.get(index_name, -1e9)
+            if now - last >= self.window:
+                self._last_sent[index_name] = now
+                delay = 0.0
+            else:
+                delay = (last + self.window) - now
+            self._pending.add(index_name)
+            self._schedule(delay)
+
+    def _schedule(self, delay: float) -> None:
+        # One timer at a time; sends always happen OFF the write path
+        # (a write must never block on N-1 peer RPCs).
+        if self._timer is not None:
+            return  # the live timer flushes everything pending
+        t = threading.Timer(delay, self._flush)
+        t.daemon = True
+        self._timer = t
+        t.start()
+
+    def _flush(self) -> None:
+        with self._lock:
+            names = sorted(self._pending)
+            self._pending.clear()
+            self._timer = None
+            now = time.monotonic()
+            for n in names:
+                self._last_sent[n] = now
+        for name in names:
+            msg = {"type": "index-dirty", "index": name}
+            for node in self.cluster.nodes:
+                if node.id == self.cluster.local_id or node.state == "DOWN":
+                    continue
+                try:
+                    self.cluster.client.send_message(node, msg)
+                except (ConnectionError, RuntimeError, LookupError):
+                    pass  # peer down: its cache rebuilds via epoch on rejoin
+
+    def flush_now(self) -> None:
+        """Synchronous flush (tests / shutdown)."""
+        self._flush()
+
+    def close(self) -> None:
+        # Flush anything pending FIRST: dropping the trailing broadcast
+        # would leave peers' caches stale past the promised bound.
+        with self._lock:
+            t, self._timer = self._timer, None
+        if t is not None:
+            t.cancel()
+        self._flush()
+        self._closed = True
+
+
+def apply_index_dirty(holder, message: dict) -> None:
+    """Receiver side: bump the local epoch without re-notifying."""
+    idx = holder.index(message.get("index", ""))
+    if idx is not None:
+        idx.epoch.bump(notify=False)

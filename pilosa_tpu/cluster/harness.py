@@ -53,9 +53,13 @@ class ClusterNode:
 
     def __init__(self, node_id: str, cluster: Cluster, planner=None):
         self.id = node_id
+        from pilosa_tpu.cluster.dirty import DirtyBroadcaster
+        self.dirty = DirtyBroadcaster(cluster)
         # New local fragments broadcast CreateShardMessage so every node's
-        # shard map stays complete (reference view.go:263-304).
-        self.holder = Holder(fragment_listener=self._broadcast_shard)
+        # shard map stays complete (reference view.go:263-304); new
+        # indexes wire their epoch to the cross-node dirty broadcaster.
+        self.holder = Holder(fragment_listener=self._broadcast_shard,
+                             index_listener=self.dirty.attach)
         self.cluster = cluster
         self.executor = Executor(self.holder, cluster=cluster,
                                  node_id=node_id, planner=planner)
@@ -84,6 +88,9 @@ class ClusterNode:
         elif t == "resize-instruction-complete":
             from pilosa_tpu.cluster.resize import deliver_completion
             deliver_completion(message)
+        elif t == "index-dirty":
+            from pilosa_tpu.cluster.dirty import apply_index_dirty
+            apply_index_dirty(self.holder, message)
         elif t == "cluster-status":
             from pilosa_tpu.cluster.resize import apply_cluster_status
             apply_cluster_status(self.cluster, message["nodes"],

@@ -673,14 +673,16 @@ class Field:
         for shard in shards.tolist():
             frag = view.create_fragment_if_not_exists(int(shard))
             for r in range(depth + 2):
+                n_bits = int(counts[shard][r])
+                if n_bits == 0:
+                    continue  # empty plane: skip the copy + lock trip
                 # Per-shard plane order: exists, sign, magnitude planes
                 # (BSI row ids 0, 1, 2+i — fragment.go:87-93).
                 row_id = r if r < 2 else BSI_OFFSET_BIT + (r - 2)
                 assert BSI_SIGN_BIT == 1
                 row = (blocks[shard][r] if adopt
                        else blocks[shard][r].copy())
-                frag.merge_row_words(row_id, row,
-                                     bit_count=int(counts[shard][r]))
+                frag.merge_row_words(row_id, row, bit_count=n_bits)
         return True
 
     def import_roaring(self, shard: int, data: bytes, view: str = VIEW_STANDARD,

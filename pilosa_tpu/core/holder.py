@@ -19,10 +19,13 @@ class Holder:
     """Reference Holder (holder.go:50)."""
 
     def __init__(self, stats=None, fragment_listener=None,
-                 op_writer_factory=None):
+                 op_writer_factory=None, index_listener=None):
         self.indexes: dict[str, Index] = {}
         self.stats = stats
         self.fragment_listener = fragment_listener
+        #: called with each newly created Index (cluster mode wires the
+        #: cross-node dirty broadcaster to its epoch here).
+        self.index_listener = index_listener
         self.op_writer_factory = op_writer_factory
         self._lock = threading.RLock()
 
@@ -45,6 +48,8 @@ class Holder:
                         fragment_listener=self.fragment_listener,
                         op_writer_factory=self.op_writer_factory)
             self.indexes[name] = idx
+            if self.index_listener is not None:
+                self.index_listener(idx)
             return idx
 
     def create_index_if_not_exists(self, name: str,

@@ -47,17 +47,32 @@ class Epoch:
     planner's leaf-stack cache and the executor's result cache validate
     with ONE epoch compare instead of walking per-fragment generations
     (the per-query 954-fragment walk was the r2 flagship bottleneck).
+
+    Listeners (cluster mode) turn local bumps into index-dirty
+    broadcasts so PEER nodes can invalidate their coordinator result
+    caches; remote-triggered bumps pass ``notify=False`` to stop the
+    echo from re-broadcasting forever.
     """
 
-    __slots__ = ("_value", "_lock")
+    __slots__ = ("_value", "_lock", "_listeners")
 
     def __init__(self):
         self._value = 0
         self._lock = threading.Lock()
+        self._listeners: list = []
 
-    def bump(self) -> None:
+    def bump(self, notify: bool = True) -> None:
         with self._lock:
             self._value += 1
+        if notify:
+            for fn in list(self._listeners):
+                try:
+                    fn()
+                except Exception:
+                    pass  # observers never break the write path
+
+    def subscribe(self, fn) -> None:
+        self._listeners.append(fn)
 
     @property
     def value(self) -> int:

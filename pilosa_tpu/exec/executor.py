@@ -157,14 +157,13 @@ class Executor:
             shards = sorted(idx.available_shards())
         shards = list(shards) if shards is not None else []
 
-        # Cluster caveat: the epoch only tracks LOCAL mutations, so on a
-        # clustered node the cache is only safe for forwarded (remote)
-        # sub-queries — every write to an owned shard lands locally on
-        # its owner. Coordinator-side full queries span shards whose
-        # writes this node never sees; caching them would serve stale
-        # reads forever.
+        # Cluster mode: coordinator-side caching is safe because every
+        # node broadcasts index-dirty on its local writes (the
+        # DirtyBroadcaster bumps peers' epochs), so remote mutations
+        # invalidate this node's entries within the coalesce window +
+        # one control message — the same eventual visibility a remote
+        # write has without any cache.
         cacheable = (cache and self.result_cache_enabled and raw is not None
-                     and (self.cluster is None or opt.remote)
                      and not query.has_writes())
         if cacheable:
             key = self._cache_key(idx, raw, shards, opt)
@@ -260,8 +259,7 @@ class Executor:
                     e = None
                 if (e is not None
                         and ((shards is None and e[8])
-                             or (shards is not None
-                                 and (shards is e[3] or shards == e[3])))):
+                             or (shards is not None and shards == e[3]))):
                     _, _, epoch, _, fn, arrays, rkey, post, _ = e
                     with self._cache_lock:
                         if (index_name, raw) in self._prepared:
@@ -326,18 +324,18 @@ class Executor:
                 fn, arrays = self.planner.prepare_count(
                     idx, call.children[0], shards)
                 if raw is not None:
-                    # Keep the original caller list (when one was given)
-                    # so the fast path can revalidate with an `is` check.
-                    kept = shards_obj if shards_obj is not None else shards
                     sum_host = self.planner._sum_host
                     with self._cache_lock:
-                        # Final flag: prepared from shards=None (the full
-                        # available set at this epoch) — only such
-                        # entries may serve later shards=None callers; a
-                        # subset program must never answer a full query.
+                        # `shards` is OUR copy — never the caller's
+                        # mutable list, which could change under an
+                        # identity check. Final flag: prepared from
+                        # shards=None (the full available set at this
+                        # epoch) — only such entries may serve later
+                        # shards=None callers; a subset program must
+                        # never answer a full query.
                         self._prepared[(index_name, raw)] = (
                             idx.instance_id, idx.schema_epoch.value,
-                            epoch, kept, fn, arrays, key,
+                            epoch, shards, fn, arrays, key,
                             lambda host, _s=sum_host: [_s(host)],
                             shards_obj is None)
                         while len(self._prepared) > self.PREPARED_CACHE_SIZE:

@@ -108,7 +108,8 @@ def _advise_huge(arr: np.ndarray) -> None:
     ~1000 write streams thrash a 4 KiB-page TLB. Best-effort: any
     failure silently keeps normal pages."""
     global _libc
-    if not hasattr(os, "posix_fadvise"):  # non-POSIX: skip
+    import sys
+    if sys.platform != "linux":  # advice value 14 is Linux-specific
         return
     try:
         if _libc is None:
@@ -228,9 +229,11 @@ def scatter_bsi_blocks(cols: np.ndarray, vals: np.ndarray, exp: int,
                        depth: int, n_shards: int, words_per_shard: int):
     """Scatter (column, value) pairs into dense BSI bit-plane blocks
     ([n_shards, depth+2, W]; per-shard rows: exists, sign, planes) in one
-    native pass. Columns must be unique. Returns (blocks, touched,
-    counts[n_shards, depth+2]) or None when the native library is
-    missing."""
+    native pass. Duplicate columns resolve last-write-wins (the kernel
+    dedupes against the exists plane, which the caller guarantees starts
+    empty). Returns (blocks, touched, counts[n_shards, depth+2]) or
+    None when the native library is missing or its staging alloc
+    failed."""
     lib = _load()
     if lib is None:
         return None

@@ -85,7 +85,14 @@ class ServerNode:
 
         from pilosa_tpu.obs import MemoryStats
         self.stats = MemoryStats()
-        self.holder = Holder(fragment_listener=self._broadcast_shard)
+        self.dirty = None
+        index_listener = None
+        if self.cluster is not None:
+            from pilosa_tpu.cluster.dirty import DirtyBroadcaster
+            self.dirty = DirtyBroadcaster(self.cluster)
+            index_listener = self.dirty.attach
+        self.holder = Holder(fragment_listener=self._broadcast_shard,
+                             index_listener=index_listener)
         planner = None
         if use_planner:
             try:
@@ -268,6 +275,10 @@ class ServerNode:
 
     def close(self) -> None:
         self._closed = True
+        if self.dirty is not None:
+            self.dirty.close()
+        if self.cluster is not None:
+            self.cluster.close()
         # Stop accepting NEW connections first; handler threads are
         # daemons and may outlive this (the batcher resolves
         # synchronously after close for exactly that race).
@@ -311,6 +322,9 @@ class ServerNode:
         elif t == "resize-instruction-complete":
             from pilosa_tpu.cluster.resize import deliver_completion
             deliver_completion(message)
+        elif t == "index-dirty":
+            from pilosa_tpu.cluster.dirty import apply_index_dirty
+            apply_index_dirty(self.holder, message)
         elif t == "cluster-status" and self.cluster is not None:
             from pilosa_tpu.cluster.resize import apply_cluster_status
             apply_cluster_status(self.cluster, message["nodes"],

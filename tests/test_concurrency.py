@@ -228,10 +228,11 @@ def test_options_wrapped_write_not_cached():
     assert ex.execute("i", "Count(Row(f=1))") == [1]
 
 
-def test_cluster_coordinator_results_not_cached():
-    """On a clustered node only forwarded (remote) sub-queries are
-    cacheable: the coordinator's epoch never sees writes applied purely
-    on other owners, so full-query caching would serve stale reads."""
+def test_cluster_coordinator_cache_invalidated_by_owner_write():
+    """Cluster-mode coordinator caching is ON (r4): a write applied
+    directly on another owner invalidates node 0's cached read once the
+    owner's index-dirty broadcast lands (deterministic here via
+    flush_now; production pays the coalesce window)."""
     from pilosa_tpu.cluster.harness import LocalCluster
     lc = LocalCluster(3, replica_n=1)
     lc.create_index("i")
@@ -243,6 +244,7 @@ def test_cluster_coordinator_results_not_cached():
     owner = lc[0].cluster.shard_nodes("i", 0)[0]
     lc.client.peers[owner.id].holder.fragment(
         "i", "f", "standard", 0).set_bit(1, 7)
+    lc.client.peers[owner.id].dirty.flush_now()
     assert lc.query("i", "Count(Row(f=1))") == [2]  # no stale cache
 
 
