@@ -300,28 +300,40 @@ def _bench_http(extra, expected):
         tls = _threading.local()
         host, p = base.replace("http://", "").split(":")
 
-        def run():
-            conn = getattr(tls, "conn", None)
-            if conn is None:
-                conn = tls.conn = http.client.HTTPConnection(
-                    host, int(p), timeout=60)
-                conn.connect()
-                # Nagle + delayed-ACK adds ~40ms to every small POST
-                # (headers and body go in separate writes).
-                conn.sock.setsockopt(socket.IPPROTO_TCP,
-                                     socket.TCP_NODELAY, 1)
-            try:
-                conn.request("POST", "/index/b/query", q.encode())
-                resp = conn.getresponse()
-                return json.loads(resp.read())
-            except (http.client.HTTPException, OSError):
-                tls.conn = None
-                raise
+        def make_runner(path):
+            def run():
+                conn = getattr(tls, "conn", None)
+                if conn is None:
+                    conn = tls.conn = http.client.HTTPConnection(
+                        host, int(p), timeout=60)
+                    conn.connect()
+                    # Nagle + delayed-ACK adds ~40ms to every small POST
+                    # (headers and body go in separate writes).
+                    conn.sock.setsockopt(socket.IPPROTO_TCP,
+                                         socket.TCP_NODELAY, 1)
+                try:
+                    conn.request("POST", path, q.encode())
+                    resp = conn.getresponse()
+                    return json.loads(resp.read())
+                except (http.client.HTTPException, OSError):
+                    tls.conn = None
+                    raise
+            return run
+
+        run = make_runner("/index/b/query")
 
         assert run() == warm
         qps, p50 = _timer(run, 256, threads=8)
         extra["http_count_qps_32m"] = round(qps, 1)
         extra["http_count_p50_ms_32m"] = round(p50, 2)
+
+        # Cold REST path (VERDICT r4 #10): cache bypassed server-side,
+        # so every request runs its device program through the full
+        # stack — what a real FIRST query costs end to end.
+        run_cold = make_runner("/index/b/query?noCache=true")
+        assert run_cold() == warm
+        _, p50c = _timer(run_cold, 12)
+        extra["http_count_cold_p50_ms"] = round(p50c, 2)
     finally:
         proc.terminate()
         proc.wait(timeout=15)

@@ -36,6 +36,7 @@ _DEFAULTS = {
     "tls_key": "",
     "tls_ca_cert": "",
     "tls_skip_verify": "",
+    "trace_endpoint": "",
     "planner": True,
 }
 
@@ -84,6 +85,8 @@ def cmd_server(args) -> int:
         cfg["tls_ca_cert"] = args.tls_ca_cert
     if args.tls_skip_verify:
         cfg["tls_skip_verify"] = "true"
+    if args.trace_endpoint:
+        cfg["trace_endpoint"] = args.trace_endpoint
 
     from pilosa_tpu.server.node import ServerNode
     node = ServerNode(
@@ -101,6 +104,7 @@ def cmd_server(args) -> int:
         tls_skip_verify=(str(cfg["tls_skip_verify"]).lower()
                          in ("1", "true", "yes")
                          if str(cfg["tls_skip_verify"]) else None),
+        trace_endpoint=str(cfg["trace_endpoint"]) or None,
     )
     node.open()  # starts the (single) serve loop in the background
     print(f"pilosa-tpu serving at {node.address}", file=sys.stderr)
@@ -239,6 +243,7 @@ def cmd_generate_config(args) -> int:
           'tls-cert = ""\n'
           'tls-key = ""\n'
           'tls-ca-cert = ""\n'
+          '# trace-endpoint = "http://127.0.0.1:4318/v1/traces"\n'
           '# tls-skip-verify = false\n'
           'planner = true')
     return 0
@@ -260,6 +265,8 @@ def main(argv: list[str] | None = None) -> int:
     s.add_argument("--tls-key", default="")
     s.add_argument("--tls-ca-cert", default="")
     s.add_argument("--tls-skip-verify", action="store_true")
+    s.add_argument("--trace-endpoint", default="",
+                   help="OTLP/HTTP collector URL for trace export")
     s.add_argument("--config", default=None)
     s.set_defaults(fn=cmd_server)
 

@@ -92,10 +92,12 @@ class ClusterNode:
             from pilosa_tpu.cluster.dirty import apply_index_dirty
             apply_index_dirty(self.holder, message)
         elif t == "cluster-status":
+            from pilosa_tpu.cluster.cleaner import clean_holder
             from pilosa_tpu.cluster.resize import apply_cluster_status
             apply_cluster_status(self.cluster, message["nodes"],
                                  holder=self.holder,
                                  availability=message.get("availability"))
+            clean_holder(self.holder, self.cluster)
         else:
             handle_cluster_message(self.holder, message)
 

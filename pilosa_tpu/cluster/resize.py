@@ -219,10 +219,13 @@ class ResizeJob:
     #: not RPC timeouts, now that apply runs off the dispatch request.
     ACK_TIMEOUT = 600.0
 
-    def __init__(self, cluster: Cluster, holder, client):
+    def __init__(self, cluster: Cluster, holder, client, store=None):
         self.cluster = cluster
         self.holder = holder
         self.client = client
+        #: DiskStore (optional) so the commit-time holderCleaner can
+        #: unlink the files of fragments it drops.
+        self.store = store
         self.state = "RUNNING"
         self.job_id = f"resize-{next(_JOB_SEQ)}"
         self._cond = threading.Condition()
@@ -363,6 +366,12 @@ class ResizeJob:
                     except (ConnectionError, RuntimeError):
                         pass
             apply_cluster_status(self.cluster, status["nodes"])
+            # Coordinator-side holderCleaner (holder.go:1126): peers GC
+            # on receiving the status broadcast; the coordinator adopted
+            # it directly, so GC here (disk half included when a store
+            # was attached).
+            from pilosa_tpu.cluster.cleaner import clean_holder
+            clean_holder(self.holder, self.cluster, store=self.store)
             self.state = "DONE"
             return self.state
         finally:

@@ -76,6 +76,13 @@ class View:
     def available_shards(self) -> set[int]:
         return set(self.fragments)
 
+    def delete_fragment(self, shard: int) -> bool:
+        """Drop a fragment this node no longer owns (holderCleaner,
+        holder.go:1126). In-flight queries holding the object finish on
+        the orphan; new lookups miss."""
+        with self._lock:
+            return self.fragments.pop(shard, None) is not None
+
     # -- bit ops -----------------------------------------------------------
 
     def set_bit(self, row_id: int, column_id: int) -> bool:

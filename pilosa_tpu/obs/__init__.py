@@ -8,6 +8,8 @@ intentionally NOT implemented (always off).
 """
 
 from pilosa_tpu.obs.logger import Logger, NopLogger, StandardLogger
+from pilosa_tpu.obs.otlp import OTLPTracer
+from pilosa_tpu.obs.profiler import sample_profile
 from pilosa_tpu.obs.runtime import RuntimeMonitor, collect_runtime_gauges
 from pilosa_tpu.obs.stats import (
     MemoryStats,
@@ -32,6 +34,7 @@ __all__ = [
     "MemoryStats", "NopStats", "StatsClient", "StatsdStats",
     "prometheus_text",
     "RuntimeMonitor", "collect_runtime_gauges",
-    "NopTracer", "SimpleTracer", "Span", "Tracer",
-    "current_trace_id", "get_tracer", "set_tracer", "start_span",
+    "NopTracer", "OTLPTracer", "SimpleTracer", "Span", "Tracer",
+    "current_trace_id", "get_tracer", "sample_profile", "set_tracer",
+    "start_span",
 ]

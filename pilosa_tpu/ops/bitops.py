@@ -165,21 +165,34 @@ def any_bit(a):
 
 
 def shift_left(a, n: int = 1):
-    """Shift every bit toward higher column ids by ``n`` (< 32), carrying
-    across word boundaries along the last axis; bits shifted past the shard
-    edge fall off (reference Shift, roaring.go:946 — per-shard semantics,
-    executor.go executeShiftShard)."""
+    """Shift every bit toward higher column ids by ``n`` (any n ≥ 0),
+    carrying across word boundaries along the last axis; bits shifted
+    past the shard edge fall off (reference Shift, roaring.go:946 —
+    per-shard semantics, executor.go executeShiftShard).
+
+    ``n`` is static: it decomposes into a whole-word roll (a lane-wise
+    concat XLA fuses for free) plus an intra-word carry shift, so any
+    0 ≤ n ≤ SHARD_WIDTH compiles to the same two-op program."""
     if n == 0:
         return a
-    if not 0 < n < WORD_BITS:
-        raise ValueError("shift amount must be in [0, 32)")
-    n_ = jnp.uint32(n)
-    hi = a << n_
-    carry = a >> jnp.uint32(WORD_BITS - n)
-    carry = jnp.concatenate(
-        [jnp.zeros(a.shape[:-1] + (1,), a.dtype), carry[..., :-1]], axis=-1
-    )
-    return hi | carry
+    if n < 0:
+        raise ValueError("shift amount must be non-negative")
+    words, bits = divmod(n, WORD_BITS)
+    if words:
+        w = a.shape[-1]
+        if words >= w:
+            return jnp.zeros_like(a)
+        a = jnp.concatenate(
+            [jnp.zeros(a.shape[:-1] + (words,), a.dtype), a[..., :-words]],
+            axis=-1)
+    if bits:
+        hi = a << jnp.uint32(bits)
+        carry = a >> jnp.uint32(WORD_BITS - bits)
+        carry = jnp.concatenate(
+            [jnp.zeros(a.shape[:-1] + (1,), a.dtype), carry[..., :-1]],
+            axis=-1)
+        a = hi | carry
+    return a
 
 
 def range_mask(start, stop, words: int = WORDS_PER_SHARD):

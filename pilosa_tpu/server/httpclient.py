@@ -55,19 +55,23 @@ class HTTPInternalClient:
             self._ssl_ctx = ctx
         return ctx
 
-    def _request(self, node: Node, method: str, path: str,
-                 body: bytes | None = None) -> Any:
+    def _request_raw(self, node: Node, method: str, path: str,
+                     body: bytes | None = None,
+                     accept: str | None = None) -> tuple[bytes, str]:
+        """Returns (body, content-type)."""
         req = urllib.request.Request(self._url(node, path), data=body,
                                      method=method)
         if body is not None:
             req.add_header("Content-Type", "application/json")
+        if accept is not None:
+            req.add_header("Accept", accept)
         from pilosa_tpu.obs.tracing import inject_http_headers
         for k, v in inject_http_headers({}).items():
             req.add_header(k, v)
         try:
             with urllib.request.urlopen(req, timeout=self.timeout,
                                         context=self._ctx(req.full_url)) as resp:
-                data = resp.read()
+                return resp.read(), resp.headers.get("Content-Type", "")
         except urllib.error.HTTPError as e:
             # The peer is alive but rejected the request — application
             # error, NOT a connection failure (failover must not trigger).
@@ -77,6 +81,10 @@ class HTTPInternalClient:
             raise RuntimeError(f"node {node.id} HTTP {e.code}: {detail}") from e
         except (urllib.error.URLError, OSError) as e:
             raise ConnectionError(f"node {node.id} unreachable: {e}") from e
+
+    def _request(self, node: Node, method: str, path: str,
+                 body: bytes | None = None) -> Any:
+        data, _ = self._request_raw(node, method, path, body)
         return json.loads(data) if data else {}
 
     # -- InternalClient protocol -------------------------------------------
@@ -86,12 +94,23 @@ class HTTPInternalClient:
         path = f"/index/{index}/query?remote={'true' if remote else 'false'}"
         if shards:
             path += "&shards=" + ",".join(str(s) for s in shards)
+        from pilosa_tpu.server import wire
+        if remote:
+            # Advertise binary-frame support: Row results come back as
+            # roaring blobs instead of JSON int lists (~10-100x smaller
+            # for large rows; wire.encode_frames).
+            data, ctype = self._request_raw(
+                node, "POST", path, query.encode(),
+                accept=wire.FRAMES_CONTENT_TYPE)
+            if ctype.startswith(wire.FRAMES_CONTENT_TYPE):
+                return wire.decode_frames(data)
+            resp = json.loads(data) if data else {}
+            if "error" in resp:
+                raise RuntimeError(resp["error"])
+            return [wire.decode_result(r) for r in resp["results"]]
         resp = self._request(node, "POST", path, query.encode())
         if "error" in resp:
             raise RuntimeError(resp["error"])
-        if remote:
-            from pilosa_tpu.server import wire
-            return [wire.decode_result(r) for r in resp["results"]]
         return resp["results"]
 
     def fragment_blocks(self, node, index, field, view, shard):

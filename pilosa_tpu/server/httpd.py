@@ -113,6 +113,7 @@ def _make_handler(api: API):
         def _dispatch(self, method: str):
             parsed = urlparse(self.path)
             params = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+            params["_accept"] = self.headers.get("Accept", "")
             length = int(self.headers.get("Content-Length") or 0)
             body = self.rfile.read(length) if length else b""
             for pattern, methods in routes:
@@ -157,6 +158,9 @@ def _make_handler(api: API):
             else:
                 data = str(payload).encode()
                 ctype = "text/plain"
+            if headers and "Content-Type" in headers:
+                headers = dict(headers)
+                ctype = headers.pop("Content-Type")
             self.send_response(status)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
@@ -249,6 +253,10 @@ def _build_routes(api: API):
         shards = None
         if params.get("shards"):
             shards = [int(s) for s in params["shards"].split(",")]
+        from pilosa_tpu.server import wire
+        remote = params.get("remote") == "true"
+        frames = (remote
+                  and wire.FRAMES_CONTENT_TYPE in params.get("_accept", ""))
         try:
             resp = api.query(
                 pv["index"], body.decode(),
@@ -256,11 +264,14 @@ def _build_routes(api: API):
                 column_attrs=params.get("columnAttrs") == "true",
                 exclude_row_attrs=params.get("excludeRowAttrs") == "true",
                 exclude_columns=params.get("excludeColumns") == "true",
-                remote=params.get("remote") == "true")
+                remote=remote, accept_frames=frames,
+                cache=params.get("noCache") != "true")
         except _NOT_FOUND:
             raise
         except (QueryError, ParseError, PilosaError, ValueError) as e:
             return 400, {"error": str(e)}
+        if isinstance(resp, bytes):
+            return 200, resp, {"Content-Type": wire.FRAMES_CONTENT_TYPE}
         return 200, resp
 
     def get_export(pv, params, body):
@@ -318,6 +329,17 @@ def _build_routes(api: API):
             out.append(f"--- {names.get(tid, '?')} ({tid}) ---\n"
                        + "".join(traceback.format_stack(frame)))
         return 200, "\n".join(out)
+
+    def get_debug_profile(pv, params, body):
+        """Whole-process sampling CPU profile for N seconds; the
+        response is a pstats-loadable marshal blob (reference
+        /debug/pprof/profile, http/handler.go:281)."""
+        from pilosa_tpu.obs.profiler import sample_profile
+        seconds = min(max(float(params.get("seconds", 2)), 0.1), 60.0)
+        blob = sample_profile(seconds)
+        return 200, blob, {"Content-Type": "application/octet-stream",
+                           "Content-Disposition":
+                               'attachment; filename="profile.pstats"'}
 
     def post_recalculate(pv, params, body):
         api.recalculate_caches()
@@ -433,6 +455,7 @@ def _build_routes(api: API):
         (r"/metrics", {"GET": get_metrics}),
         (r"/debug/vars", {"GET": get_debug_vars}),
         (r"/debug/threads", {"GET": get_debug_threads}),
+        (r"/debug/profile", {"GET": get_debug_profile}),
         (r"/recalculate-caches", {"POST": post_recalculate}),
         (r"/internal/shards/max", {"GET": get_shards_max}),
         (r"/internal/translate/keys", {"POST": post_translate_keys}),

@@ -47,7 +47,8 @@ class ServerNode:
                  tls_cert: str | None = None,
                  tls_key: str | None = None,
                  tls_ca_cert: str | None = None,
-                 tls_skip_verify: bool | None = None):
+                 tls_skip_verify: bool | None = None,
+                 trace_endpoint: str | None = None):
         host, _, port = bind.partition(":")
         self.host, self.port = host or "127.0.0.1", int(port or 10101)
         # Node identity IS the address — member ids are built the same
@@ -85,6 +86,15 @@ class ServerNode:
 
         from pilosa_tpu.obs import MemoryStats
         self.stats = MemoryStats()
+        self.tracer = None
+        if trace_endpoint:
+            # Concrete exporter behind the Tracer protocol (reference
+            # tracing/opentracing Jaeger glue): spans from this node
+            # stream to the OTLP collector at the given endpoint.
+            from pilosa_tpu.obs import OTLPTracer, set_tracer
+            self.tracer = OTLPTracer(endpoint=trace_endpoint,
+                                     service_name=f"pilosa-tpu:{self.id}")
+            set_tracer(self.tracer)
         self.dirty = None
         index_listener = None
         if self.cluster is not None:
@@ -241,6 +251,7 @@ class ServerNode:
                 applied = sync_translation(self.holder, self.cluster,
                                            self.cluster.client)
                 repaired = self.syncer.sync_holder()
+                self.clean_holder()  # ownership GC backstop
                 if applied:
                     self.stats.count("antiEntropyTranslateApplied", applied)
                 if repaired:
@@ -275,6 +286,11 @@ class ServerNode:
 
     def close(self) -> None:
         self._closed = True
+        if self.tracer is not None:
+            from pilosa_tpu.obs import NopTracer, get_tracer, set_tracer
+            if get_tracer() is self.tracer:
+                set_tracer(NopTracer())  # don't leave a closed exporter
+            self.tracer.close()
         if self.dirty is not None:
             self.dirty.close()
         if self.cluster is not None:
@@ -332,6 +348,10 @@ class ServerNode:
                                  availability=message.get("availability"),
                                  replica_n=message.get("replicaN"),
                                  partition_n=message.get("partitionN"))
+            # Topology changed: GC fragments this node no longer owns
+            # (holderCleaner, holder.go:1126) off the RPC thread.
+            threading.Thread(target=self.clean_holder,
+                             name="holder-cleaner", daemon=True).start()
         elif t == "node-join" and self.cluster is not None:
             self.handle_join(message["addr"])
         else:
@@ -393,11 +413,26 @@ class ServerNode:
         if not self._resize_gate.acquire(blocking=False):
             raise RuntimeError("resize already in progress")
         try:
-            job = ResizeJob(self.cluster, self.holder, self.cluster.client)
+            job = ResizeJob(self.cluster, self.holder, self.cluster.client,
+                            store=self.store)
             self.api.resize_job = job
             return job.run(new_nodes)
         finally:
             self._resize_gate.release()
+
+    def clean_holder(self) -> int:
+        """holderCleaner (holder.go:1126): drop fragments this node no
+        longer owns; also runs as an anti-entropy backstop."""
+        if self.cluster is None:
+            return 0
+        from pilosa_tpu.cluster.cleaner import clean_holder
+        try:
+            n = clean_holder(self.holder, self.cluster, store=self.store)
+        except Exception:
+            return 0  # GC must never take down the node
+        if n:
+            self.stats.count("holderCleanerRemoved", n)
+        return n
 
     def handle_internal_import(self, req: dict) -> None:
         """JSON /internal/import payloads: fragment-level (anti-entropy

@@ -9,10 +9,18 @@ objects before reducing.
 
 from __future__ import annotations
 
+import json
+import struct
 from typing import Any
+
+import numpy as np
 
 from pilosa_tpu.core.row import Row
 from pilosa_tpu.exec.result import FieldRow, GroupCount, Pair, ValCount
+
+#: binary frame response for remote queries (see encode_frames).
+FRAMES_CONTENT_TYPE = "application/x-pilosa-frames"
+_FRAME_MAGIC = b"PTF1"
 
 
 def encode_result(r: Any) -> dict:
@@ -60,3 +68,57 @@ def decode_result(d: dict) -> Any:
     if t == "scalar":
         return d["v"]
     raise TypeError(f"undecodable internal result {d!r}")
+
+
+# -- binary frames (reference encoding/proto/proto.go:29) -------------------
+#
+# A distributed Row() result is a bitmap; as a JSON int list a 1M-bit row
+# costs ~8 MB of text. The frame format keeps the tagged-JSON envelope
+# for small typed results but carries each Row as SERIALIZED ROARING
+# BYTES (the codec both ends already share) in a length-prefixed binary
+# section:
+#
+#   "PTF1" | u32 header_len | header JSON | blob 0 | blob 1 | ...
+#
+# header = {"results": [...], "blobs": [len0, len1, ...]} where a Row
+# appears as {"t": "row_frame", "blob": k, "attrs": {...}}.
+
+
+def encode_frames(results: list) -> bytes:
+    blobs: list[bytes] = []
+    metas: list[dict] = []
+    from pilosa_tpu import native
+    for r in results:
+        if isinstance(r, Row):
+            cols = np.asarray(r.columns(), dtype=np.uint64)
+            metas.append({"t": "row_frame", "blob": len(blobs),
+                          "attrs": r.attrs})
+            blobs.append(native.encode_roaring(cols))
+        else:
+            metas.append(encode_result(r))
+    header = json.dumps({"results": metas,
+                         "blobs": [len(b) for b in blobs]}).encode()
+    return b"".join([_FRAME_MAGIC, struct.pack("<I", len(header)), header]
+                    + blobs)
+
+
+def decode_frames(data: bytes) -> list[Any]:
+    if data[:4] != _FRAME_MAGIC:
+        raise ValueError("bad frame magic")
+    (hlen,) = struct.unpack_from("<I", data, 4)
+    header = json.loads(data[8:8 + hlen].decode())
+    off = 8 + hlen
+    blobs = []
+    for ln in header["blobs"]:
+        blobs.append(data[off:off + ln])
+        off += ln
+    from pilosa_tpu import native
+    out: list[Any] = []
+    for m in header["results"]:
+        if m.get("t") == "row_frame":
+            row = Row.from_columns(native.decode_roaring(blobs[m["blob"]]))
+            row.attrs = m.get("attrs") or {}
+            out.append(row)
+        else:
+            out.append(decode_result(m))
+    return out

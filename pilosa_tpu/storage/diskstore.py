@@ -56,6 +56,11 @@ class DiskStore:
         self.fsync_appends = fsync_appends
         os.makedirs(data_dir, exist_ok=True)
         self._writers: dict[tuple, WalWriter] = {}
+        #: tombstones: fragments the holderCleaner removed. A snapshot
+        #: worker racing the deletion must not resurrect their files;
+        #: re-creating the fragment (re-ownership) clears the tombstone
+        #: via _op_writer_factory.
+        self._deleted: set[tuple] = set()
         self._lock = threading.Lock()
         # Background snapshot queue (holder.go:163: depth 100, 2 workers).
         self._snap_q: "queue.Queue[tuple | None]" = queue.Queue(maxsize=100)
@@ -168,6 +173,8 @@ class DiskStore:
     def _op_writer_factory(self, index: str, field: str, view: str,
                            shard: int):
         key = (index, field, view, shard)
+        with self._lock:
+            self._deleted.discard(key)  # fragment (re)created: live again
 
         def op_writer(op: str, rows, cols):
             w = self._writer(key)
@@ -186,6 +193,30 @@ class DiskStore:
                 w = self._writers[key] = WalWriter(
                     self._wal_path(key), fsync_appends=self.fsync_appends)
             return w
+
+    def delete_fragment_files(self, key: tuple) -> None:
+        """Remove a fragment's snapshot + WAL (holderCleaner's disk
+        half, holder.go:1170): tombstone the key, close its writer,
+        unlink both files — all under the store lock so a racing
+        snapshot worker can neither resurrect the files nor re-register
+        a writer (its publish step re-checks the tombstone under the
+        same lock)."""
+        with self._lock:
+            self._deleted.add(key)
+            w = self._writers.pop(key, None)
+            self._snap_pending.discard(key)
+            if w is not None:
+                w.close()
+            for path in (self._snap_path(key), self._wal_path(key)):
+                try:
+                    os.remove(path)
+                except FileNotFoundError:
+                    pass
+            index, field, view, _ = key
+            try:
+                _fsync_dir(self._frag_dir(index, field, view))
+            except OSError:
+                pass
 
     # -- snapshots (fragment.go:187-239, :2337-2393) -----------------------
 
@@ -220,6 +251,9 @@ class DiskStore:
     def snapshot_fragment(self, key: tuple) -> None:
         """Write <shard>.snap.tmp, fsync-rename, truncate the WAL."""
         index, field, view, shard = key
+        with self._lock:
+            if key in self._deleted:
+                return  # cleaner removed it; don't resurrect files
         frag = self.holder.fragment(index, field, view, shard)
         if frag is None:
             return
@@ -240,12 +274,28 @@ class DiskStore:
                                     positions=positions)
                 fh.flush()
                 os.fsync(fh.fileno())
-            os.replace(tmp, path)
-            _fsync_dir(os.path.dirname(path))
-            # Snapshot is durable; only now may the WAL be discarded.
-            # The outer lock keeps the WAL truncation atomic with the
-            # snapshot (no append may land between them).
-            self._writer(key).truncate()
+            # Publish + truncate under the store lock, mutually exclusive
+            # with delete_fragment_files' tombstone-and-unlink — a
+            # racing cleaner can then never see its deletion undone.
+            with self._lock:
+                if key in self._deleted:
+                    try:
+                        os.remove(tmp)
+                    except OSError:
+                        pass
+                    return
+                os.replace(tmp, path)
+                _fsync_dir(os.path.dirname(path))
+                # Snapshot is durable; only now may the WAL be
+                # discarded. The outer fragment lock keeps the WAL
+                # truncation atomic with the snapshot (no append may
+                # land between them).
+                w = self._writers.get(key)
+                if w is None:
+                    w = self._writers[key] = WalWriter(
+                        self._wal_path(key),
+                        fsync_appends=self.fsync_appends)
+            w.truncate()
 
     def snapshot_all(self) -> None:
         for key in self._all_keys():

@@ -198,3 +198,108 @@ def test_trace_propagates_across_nodes():
                 n.close()
             except Exception:
                 pass
+
+
+def test_otlp_exporter_against_collector_double(tmp_path):
+    """OTLPTracer (VERDICT r4 #9): spans flush as OTLP/HTTP JSON to a
+    local collector double; structure and parentage survive."""
+    import http.server
+    import json
+    import threading
+
+    from pilosa_tpu.obs.otlp import OTLPTracer
+
+    received = []
+
+    class Collector(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            received.append(json.loads(self.rfile.read(n)))
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Collector)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        tr = OTLPTracer(
+            endpoint=f"http://127.0.0.1:{srv.server_port}/v1/traces",
+            service_name="test-node", flush_interval=60.0)
+        parent = tr.start_span("Executor.Execute")
+        parent.set_tag("index", "i")
+        child = tr.start_span("planner.count", parent_id=parent.span_id)
+        child.finish()
+        parent.finish()
+        tr.flush()
+        assert tr.exported == 2 and tr.dropped == 0
+        (batch,) = received
+        rs = batch["resourceSpans"][0]
+        svc = rs["resource"]["attributes"][0]
+        assert svc["key"] == "service.name"
+        assert svc["value"]["stringValue"] == "test-node"
+        spans = rs["scopeSpans"][0]["spans"]
+        by_name = {s["name"]: s for s in spans}
+        assert set(by_name) == {"Executor.Execute", "planner.count"}
+        p = by_name["Executor.Execute"]
+        c = by_name["planner.count"]
+        assert c["parentSpanId"] == p["spanId"]
+        assert len(p["traceId"]) == 32 and len(p["spanId"]) == 16
+        assert int(p["endTimeUnixNano"]) >= int(p["startTimeUnixNano"])
+        assert {"key": "index", "value": {"stringValue": "i"}} \
+            in p["attributes"]
+        tr.close()
+    finally:
+        srv.shutdown()
+
+
+def test_otlp_exporter_collector_down_never_raises():
+    from pilosa_tpu.obs.otlp import OTLPTracer
+    tr = OTLPTracer(endpoint="http://127.0.0.1:1/v1/traces",
+                    flush_interval=60.0, timeout=0.5)
+    tr.start_span("x").finish()
+    tr.flush()  # collector unreachable: drop, don't raise
+    assert tr.dropped == 1
+    tr.close()
+
+
+def test_debug_profile_route_returns_pstats_blob(tmp_path):
+    """/debug/profile?seconds=N yields a non-empty blob the standard
+    pstats tooling loads (VERDICT r4 #9 done-bar)."""
+    import pstats
+    import threading
+    import time
+    import urllib.request
+
+    from pilosa_tpu.server.node import ServerNode
+
+    n = ServerNode(bind="127.0.0.1:0", use_planner=False)
+    n.open()
+    stop = threading.Event()
+
+    def busy():  # give the sampler something to see
+        while not stop.is_set():
+            sum(i * i for i in range(2000))
+            time.sleep(0.001)
+
+    t = threading.Thread(target=busy, daemon=True)
+    t.start()
+    try:
+        with urllib.request.urlopen(
+                n.address + "/debug/profile?seconds=0.4",
+                timeout=30) as resp:
+            blob = resp.read()
+            assert resp.headers["Content-Type"] == \
+                "application/octet-stream"
+        assert len(blob) > 0
+        path = tmp_path / "profile.pstats"
+        path.write_bytes(blob)
+        st = pstats.Stats(str(path))
+        assert st.total_calls > 0
+        funcs = {f for (_, _, f) in st.stats}
+        assert "busy" in funcs  # the sampler saw the busy thread
+    finally:
+        stop.set()
+        n.close()

@@ -450,3 +450,207 @@ def test_down_event_fails_pending_ack_immediately():
     assert state == "FAILED"
     assert time.monotonic() - start < 10.0
     assert "nodeX" in job.failed
+
+
+def test_holder_cleaner_removes_unowned_fragments():
+    """After a grow-resize, the old owner GCs fragments that moved away
+    (reference holderCleaner, holder.go:1126): memory fragment gone,
+    shard still queryable via its new owner."""
+    lc = LocalCluster(2)
+    cols = seed(lc, n_shards=12)
+
+    from pilosa_tpu.cluster.cluster import STATE_NORMAL
+    from pilosa_tpu.cluster.harness import ClusterNode
+    new_member = Node(id="node2", uri=URI(port=10103))
+    member_list = [Node(id=n.id, uri=n.uri) for n in lc[0].cluster.nodes]
+    c2 = Cluster("node2", member_list + [new_member], replica_n=1,
+                 client=lc.client)
+    c2.set_state(STATE_NORMAL)
+    cn2 = ClusterNode("node2", c2)
+    cn2.apply_schema(lc[0].holder.schema())
+    lc.client.register("node2", cn2)
+    lc.nodes.append(cn2)
+
+    before = {cn.id: {s for v in cn.holder.field("i", "f").views.values()
+                      for s in v.available_shards()}
+              for cn in lc.nodes[:2]}
+    job = ResizeJob(lc[0].cluster, lc[0].holder, lc.client)
+    assert job.run([Node(id=n.id, uri=n.uri) for n in lc[0].cluster.nodes]
+                   + [new_member]) == "DONE"
+
+    import time
+    deadline = time.time() + 5.0
+    moved_any = False
+    while time.time() < deadline:
+        moved_any = False
+        ok = True
+        for cn in lc.nodes[:2]:
+            cl = cn.cluster
+            f = cn.holder.field("i", "f")
+            local_now = {s for v in f.views.values()
+                         for s in v.available_shards()}
+            for s in before[cn.id]:
+                owned = any(n.id == cn.id for n in cl.shard_nodes("i", s))
+                if not owned:
+                    moved_any = True
+                    if s in local_now:
+                        ok = False  # cleaner hasn't run yet
+        if ok:
+            break
+        time.sleep(0.05)
+    assert moved_any, "resize moved nothing; test is vacuous"
+    assert ok, "old owners still hold fragments for moved shards"
+    # Data completeness survives the GC.
+    for node in range(3):
+        assert lc.query("i", "Count(Row(f=1))", node=node,
+                        cache=False) == [len(cols)]
+
+
+def test_holder_cleaner_prevents_stale_bits_on_reownership():
+    """Clear a bit after its shard moved away, then move the shard BACK:
+    the original owner must serve the repaired state, not resurrect its
+    stale pre-move fragment (the exact failure holderCleaner exists to
+    prevent — anti-entropy merges never REMOVE bits)."""
+    import time
+
+    lc = LocalCluster(2, replica_n=2)
+    lc.create_index("i")
+    lc.create_field("i", "f")
+    # Pick a shard whose 3-node replica set will DROP one of the two
+    # original nodes (deterministic ring math, no luck involved).
+    ring3 = Cluster("node0", [Node(id=f"node{i}", uri=URI(port=10101 + i))
+                              for i in range(3)], replica_n=2)
+    shard = next(s for s in range(64)
+                 if {"node0", "node1"} -
+                 {n.id for n in ring3.shard_nodes("i", s)})
+    x = shard * SHARD_WIDTH + 11
+    lc.query("i", f"Set({x}, f=1)")
+    assert lc.query("i", "Count(Row(f=1))") == [1]
+
+    # Grow to 3 nodes: some shards' replica sets drop node0 or node1.
+    from pilosa_tpu.cluster.cluster import STATE_NORMAL
+    from pilosa_tpu.cluster.harness import ClusterNode
+    new_member = Node(id="node2", uri=URI(port=10103))
+    member_list = [Node(id=n.id, uri=n.uri) for n in lc[0].cluster.nodes]
+    c2 = Cluster("node2", member_list + [new_member], replica_n=2,
+                 client=lc.client)
+    c2.set_state(STATE_NORMAL)
+    cn2 = ClusterNode("node2", c2)
+    cn2.apply_schema(lc[0].holder.schema())
+    lc.client.register("node2", cn2)
+    lc.nodes.append(cn2)
+    job = ResizeJob(lc[0].cluster, lc[0].holder, lc.client)
+    assert job.run([Node(id=n.id, uri=n.uri) for n in lc[0].cluster.nodes]
+                   + [new_member]) == "DONE"
+    time.sleep(0.2)  # background ACK applies
+
+    cl = lc[0].cluster
+    owners = {n.id for n in cl.shard_nodes("i", shard)}
+    demoted = {"node0", "node1"} - owners
+    assert demoted, "ring math changed; pick logic needs updating"
+    loser = demoted.pop()
+    # The demoted node's fragment must be GONE (cleaner ran on commit
+    # or on the status broadcast).
+    lv = lc.client.peers[loser].holder.field("i", "f").views
+    assert all(shard not in v.available_shards() for v in lv.values())
+
+    # Clear x on the CURRENT owners (the demoted node doesn't see it).
+    lc.query("i", f"Clear({x}, f=1)")
+    assert lc.query("i", "Count(Row(f=1))", cache=False) == [0]
+
+    # Shrink back to the original two nodes: shard 3 maps back to the
+    # demoted node, which refetches the REPAIRED fragment.
+    keep = [Node(id=n.id, uri=n.uri, is_coordinator=n.is_coordinator)
+            for n in lc[0].cluster.nodes if n.id != "node2"]
+    job2 = ResizeJob(lc[0].cluster, lc[0].holder, lc.client)
+    assert job2.run(keep) == "DONE"
+    time.sleep(0.2)
+    for node in range(2):
+        assert lc.query("i", "Count(Row(f=1))", node=node,
+                        cache=False) == [0], "stale bit resurrected"
+
+
+def test_holder_cleaner_deletes_on_disk_files(tmp_path):
+    """HTTP + DiskStore: after a join moves shards away, the old
+    owner's .snap/.wal files for those shards are unlinked (reference
+    holderCleaner's disk GC, holder.go:1170)."""
+    import json
+    import os
+    import time
+    import urllib.request
+    from pilosa_tpu.server.node import ServerNode
+
+    ports = _free_ports(3)
+    addrs = [f"127.0.0.1:{p}" for p in ports]
+    dirs = [str(tmp_path / f"n{i}") for i in range(3)]
+    nodes = [ServerNode(bind=a, peers=[x for x in addrs[:2] if x != a],
+                        replica_n=1, use_planner=False,
+                        anti_entropy_interval=0.0, check_nodes_interval=0.0,
+                        data_dir=dirs[i])
+             for i, a in enumerate(addrs[:2])]
+    for n in nodes:
+        n.open()
+    joiner = None
+    try:
+        base = nodes[0].address
+
+        def post(path, body):
+            r = urllib.request.Request(base + path, data=body.encode(),
+                                       method="POST")
+            return json.loads(urllib.request.urlopen(r, timeout=10).read()
+                              or b"{}")
+
+        post("/index/i", "{}")
+        post("/index/i/field/f", "{}")
+        cols = [s * SHARD_WIDTH for s in range(10)]
+        for c in cols:
+            post("/index/i/query", f"Set({c}, f=1)")
+        for n in nodes:
+            n.store.flush()
+
+        joiner = ServerNode(bind=addrs[2], join=addrs[1],
+                            use_planner=False, anti_entropy_interval=0.0,
+                            check_nodes_interval=0.0, data_dir=dirs[2])
+        joiner.open()
+        deadline = time.time() + 15.0
+        while time.time() < deadline and len(joiner.cluster.nodes) != 3:
+            time.sleep(0.1)
+        assert len(joiner.cluster.nodes) == 3
+
+        # Wait for the cleaners, then assert: every shard an original
+        # node no longer owns has no .snap/.wal on its disk.
+        def stale_files(node):
+            out = []
+            cl = node.cluster
+            for vname in ("standard",):
+                vdir = os.path.join(node.data_dir, "i", "f", vname)
+                if not os.path.isdir(vdir):
+                    continue
+                for fn in os.listdir(vdir):
+                    shard = int(fn.rsplit(".", 1)[0])
+                    if not any(nd.id == node.id
+                               for nd in cl.shard_nodes("i", shard)):
+                        out.append(fn)
+            return out
+
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            leftovers = [f for n in nodes for f in stale_files(n)]
+            if not leftovers:
+                break
+            time.sleep(0.2)
+        cl = nodes[0].cluster
+        moved = any(
+            not any(nd.id == n.id for nd in cl.shard_nodes("i", s))
+            for n in nodes for s in range(10))
+        assert moved, "join moved no shards off the originals; vacuous"
+        assert not leftovers, leftovers
+        # Completeness from every node.
+        assert post("/index/i/query", "Count(Row(f=1))") == \
+            {"results": [len(cols)]}
+    finally:
+        for n in nodes + ([joiner] if joiner else []):
+            try:
+                n.close()
+            except Exception:
+                pass

@@ -14,6 +14,17 @@ from pilosa_tpu.config import SHARD_WIDTH
 from pilosa_tpu.server.node import ServerNode
 
 
+def _free_ports(n):
+    import socket
+    out = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        out.append(s.getsockname()[1])
+        s.close()
+    return out
+
+
 def req(base, method, path, body=None):
     data = body.encode() if isinstance(body, str) else body
     r = urllib.request.Request(base + path, data=data, method=method)
@@ -374,6 +385,106 @@ def test_tls_dynamic_join(tmp_path):
         assert post("/index/j/query", "Count(Row(f=1))") == {"results": [6]}
     finally:
         for n in nodes + ([joiner] if joiner else []):
+            try:
+                n.close()
+            except Exception:
+                pass
+
+
+def test_wire_frames_roundtrip_and_size():
+    """Binary frames (VERDICT r4 #6): a 1M-bit Row result encodes as
+    roaring bytes >=10x smaller than its JSON int-list envelope, and
+    round-trips exactly; mixed result lists keep non-Row types."""
+    import json as _json
+
+    import numpy as np
+
+    from pilosa_tpu.core.row import Row
+    from pilosa_tpu.exec.result import Pair
+    from pilosa_tpu.server import wire
+
+    rng = np.random.default_rng(5)
+    cols = np.unique(rng.integers(0, 4_000_000, 1_200_000,
+                                  dtype=np.uint64))[:1_000_000]
+    row = Row.from_columns(cols)
+    row.attrs = {"tag": "x"}
+    results = [row, 42, Pair(id=7, count=9)]
+
+    framed = wire.encode_frames(results)
+    as_json = _json.dumps(
+        {"results": [wire.encode_result(r) for r in results]}).encode()
+    assert len(as_json) >= 10 * len(framed), (len(as_json), len(framed))
+
+    back = wire.decode_frames(framed)
+    assert isinstance(back[0], Row)
+    np.testing.assert_array_equal(np.asarray(back[0].columns()), cols)
+    assert back[0].attrs == {"tag": "x"}
+    assert back[1] == 42
+    assert back[2].id == 7 and back[2].count == 9
+
+
+def test_distributed_row_uses_roaring_frames(tmp_path):
+    """End-to-end: a distributed Row() over a 1M-bit remote fragment
+    travels as roaring frames over real HTTP."""
+    import json
+    import urllib.request
+
+    import numpy as np
+
+    from pilosa_tpu import native
+    from pilosa_tpu.config import SHARD_WIDTH
+    from pilosa_tpu.server import wire
+
+    ports = _free_ports(2)
+    addrs = [f"127.0.0.1:{p}" for p in ports]
+    nodes = [ServerNode(bind=a, peers=[x for x in addrs if x != a],
+                        replica_n=1, use_planner=False,
+                        anti_entropy_interval=0.0, check_nodes_interval=0.0)
+             for a in addrs]
+    for n in nodes:
+        n.open()
+    try:
+        base = nodes[0].address
+
+        def post(path, body):
+            r = urllib.request.Request(
+                base + path,
+                data=body if isinstance(body, bytes) else body.encode(),
+                method="POST")
+            return json.loads(urllib.request.urlopen(r, timeout=30).read()
+                              or b"{}")
+
+        post("/index/i", "{}")
+        post("/index/i/field/f", "{}")
+        # A shard the REMOTE node owns, filled with 1M bits of row 1.
+        cl = nodes[0].cluster
+        shard = next(s for s in range(32)
+                     if cl.shard_nodes("i", s)[0].id != nodes[0].id)
+        rng = np.random.default_rng(9)
+        local = np.unique(rng.integers(0, SHARD_WIDTH, 1_050_000,
+                                       dtype=np.uint64))
+        blob = native.encode_roaring(local + np.uint64(SHARD_WIDTH))  # row 1
+        post(f"/index/i/field/f/import-roaring/{shard}", blob)
+
+        seen = []
+        orig = wire.decode_frames
+
+        def spy(data):
+            seen.append(len(data))
+            return orig(data)
+
+        wire.decode_frames = spy
+        try:
+            resp = post("/index/i/query", "Row(f=1)")
+        finally:
+            wire.decode_frames = orig
+        got = resp["results"][0]["columns"]
+        expected = (local + np.uint64(shard * SHARD_WIDTH)).tolist()
+        assert got == expected
+        assert seen, "remote Row did not travel as roaring frames"
+        assert seen[0] < len(local) * 2.5  # bytes, not JSON text
+    finally:
+        for n in nodes:
             try:
                 n.close()
             except Exception:
