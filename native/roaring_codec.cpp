@@ -40,14 +40,6 @@ namespace {
 // lines with non-temporal stores.  Segment starts are padded to
 // 16-element alignment so every flush is a whole aligned line.
 
-struct Partitioned {
-  // start[s] (inclusive) .. end[s] (exclusive) index shard s's values
-  // inside the 64-byte-aligned buffer `part` (capacity start[n_shards]).
-  std::vector<int64_t> start, end;
-  uint32_t* part = nullptr;
-  ~Partitioned() { std::free(part); }
-};
-
 // Ask the kernel for 2 MiB pages on a large fresh buffer BEFORE first
 // touch: on virtualized hosts each 4 KiB first-touch fault costs
 // microseconds, so a 200 MB staging buffer pays >1 s in faults alone —
@@ -63,6 +55,42 @@ inline void advise_huge(void* p, size_t len) {
   (void)len;
 #endif
 }
+
+struct Partitioned {
+  // start[s] (inclusive) .. end[s] (exclusive) index shard s's values
+  // inside the 64-byte-aligned buffer `part` (borrowed from the
+  // thread-local staging arena — NOT owned).
+  std::vector<int64_t> start, end;
+  uint32_t* part = nullptr;
+};
+
+// Thread-local staging arenas, grow-only and reused across imports:
+// first-touch faults on a fresh multi-hundred-MB buffer cost more than
+// the partition itself on virtualized hosts, so paying them once per
+// thread (instead of once per import) is the single biggest win for
+// repeated bulk loads. Bounded: buffers above the cap are freed after
+// use instead of retained.
+constexpr size_t kArenaRetainBytes = size_t(1) << 29;  // 512 MiB
+
+inline void* arena_get(std::vector<uint8_t>& a, size_t bytes) {
+  bytes += 64;  // alignment slack
+  if (a.size() < bytes) {
+    a.resize(bytes);
+    advise_huge(a.data(), a.size());
+  }
+  return reinterpret_cast<void*>(
+      (reinterpret_cast<uintptr_t>(a.data()) + 63) & ~uintptr_t(63));
+}
+
+inline void arena_trim(std::vector<uint8_t>& a) {
+  if (a.size() > kArenaRetainBytes) {
+    a.clear();
+    a.shrink_to_fit();
+  }
+}
+
+thread_local std::vector<uint8_t> g_part_arena;
+thread_local std::vector<uint8_t> g_val_arena;
 
 inline void flush_line(uint32_t* dst, const uint32_t* src) {
 #if defined(__AVX2__)
@@ -91,9 +119,11 @@ bool partition_by_shard(const uint64_t* cols, int64_t n, int exp,
   for (int64_t s = 0; s < n_shards; s++)
     out.start[s + 1] = out.start[s] + ((count[s] + 15) & ~15LL);
   const size_t part_bytes = ((out.start[n_shards] + 15) & ~15LL) * 4 + 64;
-  out.part = static_cast<uint32_t*>(std::aligned_alloc(64, part_bytes));
-  if (out.part == nullptr) return false;
-  advise_huge(out.part, part_bytes);
+  try {
+    out.part = static_cast<uint32_t*>(arena_get(g_part_arena, part_bytes));
+  } catch (const std::bad_alloc&) {
+    return false;
+  }
   std::vector<int64_t> head(out.start.begin(), out.start.end() - 1);
   std::vector<uint32_t> stage(n_shards * 16 + 16);
   uint32_t* stg = reinterpret_cast<uint32_t*>(
@@ -545,6 +575,7 @@ void scatter_row_blocks(const uint64_t* cols, int64_t n, int exp,
     touched[s] = 1;
     if (block_counts != nullptr) block_counts[s] = cnt;
   }
+  arena_trim(g_part_arena);
 }
 
 int scatter_bsi_blocks(const uint64_t* cols, const int64_t* vals, int64_t n,
@@ -577,11 +608,14 @@ int scatter_bsi_blocks(const uint64_t* cols, const int64_t* vals, int64_t n,
   const int64_t cap = start[n_shards];
   const size_t plocal_bytes = ((cap + 15) & ~15LL) * 4 + 64;
   const size_t pval_bytes = ((cap + 15) & ~15LL) * 8 + 128;
-  uint32_t* plocal = static_cast<uint32_t*>(
-      std::aligned_alloc(64, plocal_bytes));
-  int64_t* pval = static_cast<int64_t*>(std::aligned_alloc(64, pval_bytes));
-  if (plocal != nullptr) advise_huge(plocal, plocal_bytes);
-  if (pval != nullptr) advise_huge(pval, pval_bytes);
+  uint32_t* plocal = nullptr;
+  int64_t* pval = nullptr;
+  try {
+    plocal = static_cast<uint32_t*>(arena_get(g_part_arena, plocal_bytes));
+    pval = static_cast<int64_t*>(arena_get(g_val_arena, pval_bytes));
+  } catch (const std::bad_alloc&) {
+    plocal = nullptr;
+  }
   std::vector<int64_t> head(start.begin(), start.end() - 1);
   std::vector<uint32_t> lstage_v(n_shards * 16 + 16);
   std::vector<int64_t> vstage_v(n_shards * 16 + 8);
@@ -591,8 +625,6 @@ int scatter_bsi_blocks(const uint64_t* cols, const int64_t* vals, int64_t n,
       (reinterpret_cast<uintptr_t>(vstage_v.data()) + 63) & ~uintptr_t(63));
   std::vector<uint8_t> fill(n_shards, 0);
   if (plocal == nullptr || pval == nullptr) {
-    std::free(plocal);
-    std::free(pval);
     return -1;  // alloc failure: caller must fall back (blocks untouched)
   }
   for (int64_t k = 0; k < n; k++) {
@@ -668,8 +700,8 @@ int scatter_bsi_blocks(const uint64_t* cols, const int64_t* vals, int64_t n,
     if (block_counts != nullptr)
       for (int64_t r = 0; r < rows; r++) block_counts[s * rows + r] = cnt[r];
   }
-  std::free(plocal);
-  std::free(pval);
+  arena_trim(g_part_arena);
+  arena_trim(g_val_arena);
   return 0;
 }
 

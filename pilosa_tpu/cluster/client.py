@@ -162,6 +162,9 @@ class LocalClient:
     def schema(self, node) -> list[dict]:
         return self._peer(node).handle_schema()
 
+    def nodes(self, node) -> list[dict]:
+        return self._peer(node).handle_nodes()
+
     def attr_blocks(self, node, index, field):
         return self._peer(node).handle_attr_blocks(index, field)
 

@@ -14,7 +14,12 @@ from typing import Any, Callable
 
 from pilosa_tpu.config import DEFAULT_PARTITION_N
 from pilosa_tpu.cluster.client import InternalClient, NopClient
-from pilosa_tpu.cluster.event import EVENT_JOIN, EVENT_LEAVE, NodeEvent
+from pilosa_tpu.cluster.event import (
+    EVENT_JOIN,
+    EVENT_LEAVE,
+    EVENT_UPDATE,
+    NodeEvent,
+)
 from pilosa_tpu.cluster.node import Node
 from pilosa_tpu.cluster.placement import jump_hash, partition
 from pilosa_tpu.errors import PilosaError
@@ -41,6 +46,13 @@ class Cluster:
         self.partition_n = partition_n
         self.client = client or NopClient()
         self.state = STATE_STARTING
+        #: monotonically increasing topology version, bumped by every
+        #: committed resize and carried on cluster-status broadcasts and
+        #: membership pulls: a peer's view is only adopted when its
+        #: version is NEWER, so a stale node can never resurrect a
+        #: removed member (ghost re-add -> wrong placement -> the GC
+        #: deleting live data).
+        self.topology_version = 0
         self._lock = threading.RLock()
         #: NodeEvent consumers (cluster/event.py).
         self._listeners: list[Callable] = []
@@ -107,6 +119,40 @@ class Cluster:
                 n.state = "DOWN"
                 self._emit(EVENT_LEAVE, node_id, "DOWN")
             self._update_state()
+
+    def merge_membership(self, nodes_json: list[dict],
+                         version: int) -> list[str]:
+        """Transitive discovery (memberlist push/pull analog,
+        gossip/gossip.go:295-443): adopt a peer's WHOLE member list —
+        adds AND removals — but only when its topology version is
+        strictly newer, so a node partitioned through a resize still
+        learns the committed ring through any reachable member, while a
+        STALE peer can never resurrect a removed ghost (which would
+        shift jump-hash placement and let the holder GC delete live
+        data)."""
+        changed: list[str] = []
+        with self._lock:
+            if version <= self.topology_version:
+                return changed
+            old = {n.id: n for n in self.nodes}
+            new_nodes = sorted((Node.from_json(d) for d in nodes_json),
+                               key=lambda n: n.id)
+            new_ids = {n.id for n in new_nodes}
+            if self.local_id not in new_ids:
+                # A newer topology that excludes US means we were
+                # removed; adopt nothing here — the operator/rejoin flow
+                # owns that transition.
+                return changed
+            for n in new_nodes:  # keep live probe state across merge
+                if n.id in old:
+                    n.state = old[n.id].state
+            changed = sorted(set(old) ^ new_ids)
+            self.nodes = new_nodes
+            self.topology_version = version
+            self._update_state()
+        for nid in changed:
+            self._emit(EVENT_UPDATE, nid, "MERGED")
+        return changed
 
     def subscribe(self, listener: Callable) -> None:
         """Register a NodeEvent consumer (reference ReceiveEvent's

@@ -96,7 +96,8 @@ class ClusterNode:
             from pilosa_tpu.cluster.resize import apply_cluster_status
             apply_cluster_status(self.cluster, message["nodes"],
                                  holder=self.holder,
-                                 availability=message.get("availability"))
+                                 availability=message.get("availability"),
+                                 version=message.get("version"))
             clean_holder(self.holder, self.cluster)
         else:
             handle_cluster_message(self.holder, message)
@@ -168,6 +169,10 @@ class ClusterNode:
 
     def handle_schema(self):
         return self.holder.schema()
+
+    def handle_nodes(self):
+        return {"version": self.cluster.topology_version,
+                "nodes": [n.to_json() for n in self.cluster.nodes]}
 
     def apply_schema(self, schema) -> None:
         self.holder.apply_schema(schema)

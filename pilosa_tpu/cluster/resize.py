@@ -174,7 +174,8 @@ def apply_resize_instruction(holder, client, cluster: Cluster,
 def apply_cluster_status(cluster: Cluster, nodes_json: list[dict],
                          holder=None, availability: dict | None = None,
                          replica_n: int | None = None,
-                         partition_n: int | None = None) -> None:
+                         partition_n: int | None = None,
+                         version: int | None = None) -> None:
     """mergeClusterStatus (cluster.go:1943): adopt a broadcast topology
     and, like the reference's NodeStatus, the sender's per-field shard
     availability so new members can route queries for shards they don't
@@ -186,6 +187,8 @@ def apply_cluster_status(cluster: Cluster, nodes_json: list[dict],
         cluster.partition_n = int(partition_n)
     cluster.nodes = sorted((Node.from_json(n) for n in nodes_json),
                            key=lambda n: n.id)
+    if version is not None:
+        cluster.topology_version = int(version)
     cluster._update_state()
     if holder is not None and availability:
         for index, fields in availability.items():
@@ -358,6 +361,7 @@ class ResizeJob:
                       "nodes": [n.to_json() for n in new_nodes],
                       "replicaN": self.cluster.replica_n,
                       "partitionN": self.cluster.partition_n,
+                      "version": self.cluster.topology_version + 1,
                       "availability": holder_availability(self.holder)}
             for node in new_nodes:
                 if node.id != self.cluster.local_id:
@@ -365,7 +369,8 @@ class ResizeJob:
                         self.client.send_message(node, status)
                     except (ConnectionError, RuntimeError):
                         pass
-            apply_cluster_status(self.cluster, status["nodes"])
+            apply_cluster_status(self.cluster, status["nodes"],
+                                 version=status["version"])
             # Coordinator-side holderCleaner (holder.go:1126): peers GC
             # on receiving the status broadcast; the coordinator adopted
             # it directly, so GC here (disk half included when a store
@@ -382,10 +387,13 @@ class ResizeJob:
                 self.cluster.set_state(STATE_NORMAL)
 
 
-def check_nodes(cluster: Cluster, client, retries: int = 2) -> list[str]:
+def check_nodes(cluster: Cluster, client, retries: int = 2,
+                discover: bool = True) -> list[str]:
     """Failure detector sweep: probe every peer, confirm before marking
     down (reference confirmNodeDown cluster.go:1724-1751: /version probe
-    with retry). Returns ids whose state changed."""
+    with retry). Returns ids whose state changed. ``discover`` adds the
+    membership push/pull (one GET per live peer) — callers on a tight
+    sweep cadence can run it every few sweeps."""
     changed = []
     for node in cluster.nodes:
         if node.id == cluster.local_id:
@@ -398,6 +406,20 @@ def check_nodes(cluster: Cluster, client, retries: int = 2) -> list[str]:
                 break
             except ConnectionError:
                 continue
+        if alive and discover:
+            # Transitive membership exchange rides the liveness sweep
+            # (memberlist's push/pull, gossip.go:295): a peer holding a
+            # STRICTLY NEWER committed topology hands us the whole ring,
+            # so discovery doesn't depend on reaching the coordinator —
+            # and stale peers can't resurrect removed members.
+            try:
+                resp = client.nodes(node)
+            except (ConnectionError, RuntimeError, LookupError,
+                    AttributeError):
+                resp = None
+            if isinstance(resp, dict) and resp.get("nodes"):
+                changed.extend(cluster.merge_membership(
+                    resp["nodes"], int(resp.get("version", 0))))
         if alive and node.state == "DOWN":
             node.state = "READY"
             changed.append(node.id)

@@ -263,10 +263,11 @@ class API:
             "localID": self.cluster.local_id,
         }
 
-    def hosts(self) -> list[dict]:
+    def hosts(self) -> dict:
         if self.cluster is None:
-            return []
-        return [n.to_json() for n in self.cluster.nodes]
+            return {"version": 0, "nodes": []}
+        return {"version": self.cluster.topology_version,
+                "nodes": [n.to_json() for n in self.cluster.nodes]}
 
     def info(self) -> dict:
         import pilosa_tpu

@@ -230,6 +230,12 @@ class HTTPInternalClient:
         resp = self._request(node, "GET", path)
         return [(int(i), k) for i, k in resp["entries"]]
 
+    def nodes(self, node) -> dict:
+        """Peer membership pull: {"version", "nodes"} (transitive
+        discovery — the memberlist LocalState/MergeRemoteState analog,
+        gossip/gossip.go:295-443)."""
+        return self._request(node, "GET", "/internal/nodes")
+
     def schema(self, node) -> list[dict]:
         """Peer schema pull (reference NodeStatus carries Schema;
         server.go:640 handles it on receive)."""

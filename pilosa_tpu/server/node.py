@@ -267,11 +267,19 @@ class ServerNode:
         self._sync_timer.daemon = True
         self._sync_timer.start()
 
+    #: membership push/pull piggybacks on every Nth liveness sweep
+    #: (full-ring pulls each sweep would double detector traffic).
+    DISCOVER_EVERY_N_SWEEPS = 5
+
     def _schedule_check_nodes(self) -> None:
         def tick():
             try:
                 from pilosa_tpu.cluster.resize import check_nodes
-                changed = check_nodes(self.cluster, self.cluster.client)
+                self._sweep_n = getattr(self, "_sweep_n", 0) + 1
+                changed = check_nodes(
+                    self.cluster, self.cluster.client,
+                    discover=(self._sweep_n %
+                              self.DISCOVER_EVERY_N_SWEEPS == 0))
                 if changed:
                     self.stats.count("checkNodesChanged", len(changed))
             except Exception:
@@ -347,7 +355,8 @@ class ServerNode:
                                  holder=self.holder,
                                  availability=message.get("availability"),
                                  replica_n=message.get("replicaN"),
-                                 partition_n=message.get("partitionN"))
+                                 partition_n=message.get("partitionN"),
+                                 version=message.get("version"))
             # Topology changed: GC fragments this node no longer owns
             # (holderCleaner, holder.go:1126) off the RPC thread.
             threading.Thread(target=self.clean_holder,

@@ -178,6 +178,9 @@ class DiskStore:
 
         def op_writer(op: str, rows, cols):
             w = self._writer(key)
+            if w is None:
+                return  # fragment GC'd; orphan writes must not recreate
+                # the WAL file (stale bits would replay on restart)
             if op == "setRow":
                 w.append("setRow", rows[:1], cols)
             else:
@@ -186,8 +189,10 @@ class DiskStore:
                 self._enqueue_snapshot(key)
         return op_writer
 
-    def _writer(self, key: tuple) -> WalWriter:
+    def _writer(self, key: tuple) -> WalWriter | None:
         with self._lock:
+            if key in self._deleted:
+                return None
             w = self._writers.get(key)
             if w is None:
                 w = self._writers[key] = WalWriter(
@@ -295,7 +300,10 @@ class DiskStore:
                     w = self._writers[key] = WalWriter(
                         self._wal_path(key),
                         fsync_appends=self.fsync_appends)
-            w.truncate()
+                # Truncate INSIDE the store lock: a racing
+                # delete_fragment_files would otherwise close this
+                # writer between fetch and truncate.
+                w.truncate()
 
     def snapshot_all(self) -> None:
         for key in self._all_keys():

@@ -654,3 +654,43 @@ def test_holder_cleaner_deletes_on_disk_files(tmp_path):
                 n.close()
             except Exception:
                 pass
+
+
+def test_transitive_membership_discovery():
+    """A node that missed a committed topology learns it from ANY live
+    peer holding a NEWER version (memberlist push/pull analog): A's view
+    lacks C, B carries topology v1 including C, one sweep on A adopts
+    it. A STALE peer (older version) can never pollute the ring."""
+    lc = LocalCluster(3, replica_n=1)
+    a = lc[0]
+    # A missed C's join: amputate C and leave A at version 0 while the
+    # others committed version 1.
+    a.cluster.nodes = [n for n in a.cluster.nodes if n.id != "node2"]
+    for cn in lc.nodes[1:]:
+        cn.cluster.topology_version = 1
+    assert a.cluster.node_by_id("node2") is None
+    changed = check_nodes(a.cluster, lc.client)
+    assert "node2" in changed
+    assert a.cluster.node_by_id("node2") is not None
+    assert a.cluster.topology_version == 1
+    # Idempotent: next sweep adds nothing.
+    assert check_nodes(a.cluster, lc.client) == []
+
+
+def test_stale_peer_cannot_resurrect_removed_member():
+    """The ghost-resurrection hazard: B holds a STALE view (missed a
+    shrink) that still lists the removed node2; A (same or newer
+    version) must NOT re-adopt it — placement would shift and the
+    holder GC would delete live data."""
+    lc = LocalCluster(3, replica_n=1)
+    a, b = lc[0], lc[1]
+    # A committed the shrink at version 2; B is stale at version 1 and
+    # still lists node2.
+    a.cluster.nodes = [n for n in a.cluster.nodes if n.id != "node2"]
+    a.cluster.topology_version = 2
+    b.cluster.topology_version = 1
+    lc.client.down.add("node2")
+    changed = check_nodes(a.cluster, lc.client)
+    assert a.cluster.node_by_id("node2") is None, "ghost resurrected"
+    assert a.cluster.topology_version == 2
+    assert all(c != "node2" or True for c in changed)
