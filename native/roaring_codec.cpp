@@ -18,6 +18,7 @@
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <mutex>
 #include <vector>
 
 #if defined(__AVX2__)
@@ -56,41 +57,146 @@ inline void advise_huge(void* p, size_t len) {
 #endif
 }
 
+void* pool_alloc_impl(int64_t bytes, int zero);
+void pool_free_impl(void* p, int64_t bytes);
+
 struct Partitioned {
   // start[s] (inclusive) .. end[s] (exclusive) index shard s's values
-  // inside the 64-byte-aligned buffer `part` (borrowed from the
-  // thread-local staging arena — NOT owned).
+  // inside the 64-byte-aligned buffer `part` (a pool staging chunk,
+  // returned to the pool on destruction).
   std::vector<int64_t> start, end;
   uint32_t* part = nullptr;
+  void* owned = nullptr;
+  int64_t owned_bytes = 0;
+  ~Partitioned() {
+    if (owned != nullptr) pool_free_impl(owned, owned_bytes);
+  }
 };
 
-// Thread-local staging arenas, grow-only and reused across imports:
-// first-touch faults on a fresh multi-hundred-MB buffer cost more than
-// the partition itself on virtualized hosts, so paying them once per
-// thread (instead of once per import) is the single biggest win for
-// repeated bulk loads. Bounded: buffers above the cap are freed after
-// use instead of retained.
-constexpr size_t kArenaRetainBytes = size_t(1) << 29;  // 512 MiB
+// --- recycled page pool ---------------------------------------------------
+//
+// Buffer pool for the large (100s of MB) block/staging buffers the bulk
+// import path churns through. On virtualized hosts without working
+// transparent huge pages (AnonHugePages: 0 even under MADV_HUGEPAGE),
+// first-touch faults on a fresh anonymous mapping run at ~0.7-2 GB/s —
+// slower than the import math itself — while an explicit memset of
+// already-faulted memory runs at ~8 GB/s. Classic database answer:
+// fault pages once (at boot via pool_reserve, or on first import) and
+// recycle them forever. Plays the role the reference's mmapped
+// fragment files + page cache play (fragment.go:311 openStorage):
+// storage memory there is also faulted once and reused by the kernel.
+//
+// Best-fit freelist over privately mmapped chunks, 2 MiB granularity,
+// split on allocation, never coalesced (the workload is a handful of
+// large long-lived block arrays plus per-import staging; external
+// fragmentation is bounded in practice and the limit evicts cleanly).
+constexpr size_t kPoolAlign = size_t(2) << 20;  // 2 MiB granularity
 
-inline void* arena_get(std::vector<uint8_t>& a, size_t bytes) {
-  bytes += 64;  // alignment slack
-  if (a.size() < bytes) {
-    a.resize(bytes);
-    advise_huge(a.data(), a.size());
-  }
-  return reinterpret_cast<void*>(
-      (reinterpret_cast<uintptr_t>(a.data()) + 63) & ~uintptr_t(63));
+struct PoolChunk {
+  uint8_t* p;
+  size_t sz;
+};
+
+std::mutex g_pool_mu;
+std::vector<PoolChunk> g_pool_free;       // recycled, fault-warm chunks
+size_t g_pool_free_bytes = 0;
+size_t g_pool_limit = size_t(3) << 30;    // retained-bytes cap (3 GiB)
+int64_t g_pool_fresh_mmaps = 0;           // stats: cold allocations
+int64_t g_pool_recycled = 0;              // stats: warm allocations
+
+inline size_t pool_round(size_t bytes) {
+  return (bytes + kPoolAlign - 1) & ~(kPoolAlign - 1);
 }
 
-inline void arena_trim(std::vector<uint8_t>& a) {
-  if (a.size() > kArenaRetainBytes) {
-    a.clear();
-    a.shrink_to_fit();
-  }
+// Recycling requires mmap (chunks are split at arbitrary offsets, so a
+// freed pointer may be interior to its original mapping — munmap of a
+// page range handles that; free() cannot). Off Linux the pool degrades
+// to plain calloc/free with no freelist: correct, just not warm.
+#if defined(__linux__)
+uint8_t* pool_mmap(size_t sz) {
+  void* p = mmap(nullptr, sz, PROT_READ | PROT_WRITE,
+                 MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+  if (p == MAP_FAILED) return nullptr;
+  advise_huge(p, sz);
+  return static_cast<uint8_t*>(p);
 }
 
-thread_local std::vector<uint8_t> g_part_arena;
-thread_local std::vector<uint8_t> g_val_arena;
+void pool_munmap(uint8_t* p, size_t sz) { munmap(p, sz); }
+#endif
+
+// Evict largest-first while over the retained cap. Caller holds the lock.
+#if !defined(__linux__)
+void pool_enforce_limit_locked() {}  // freelist never populated off Linux
+#else
+void pool_enforce_limit_locked() {
+  while (g_pool_free_bytes > g_pool_limit && !g_pool_free.empty()) {
+    size_t worst = 0;
+    for (size_t i = 1; i < g_pool_free.size(); i++)
+      if (g_pool_free[i].sz > g_pool_free[worst].sz) worst = i;
+    g_pool_free_bytes -= g_pool_free[worst].sz;
+    pool_munmap(g_pool_free[worst].p, g_pool_free[worst].sz);
+    g_pool_free[worst] = g_pool_free.back();
+    g_pool_free.pop_back();
+  }
+}
+#endif
+
+// Allocate `bytes` (rounded to 2 MiB). zero!=0 gives np.zeros semantics;
+// recycled chunks are memset (fast: pages already faulted), fresh mmaps
+// are kernel-zeroed lazily. Returns nullptr on failure.
+void* pool_alloc_impl(int64_t bytes, int zero) {
+  if (bytes <= 0) return nullptr;
+  size_t need = pool_round(static_cast<size_t>(bytes));
+#if !defined(__linux__)
+  return zero ? std::calloc(need, 1) : std::malloc(need);
+#else
+  uint8_t* p = nullptr;
+  bool recycled = false;
+  {
+    std::lock_guard<std::mutex> g(g_pool_mu);
+    size_t best = g_pool_free.size();
+    for (size_t i = 0; i < g_pool_free.size(); i++)
+      if (g_pool_free[i].sz >= need &&
+          (best == g_pool_free.size() ||
+           g_pool_free[i].sz < g_pool_free[best].sz))
+        best = i;
+    if (best < g_pool_free.size()) {
+      PoolChunk c = g_pool_free[best];
+      g_pool_free[best] = g_pool_free.back();
+      g_pool_free.pop_back();
+      g_pool_free_bytes -= c.sz;
+      if (c.sz > need) {  // split: tail goes back on the freelist
+        g_pool_free.push_back({c.p + need, c.sz - need});
+        g_pool_free_bytes += c.sz - need;
+      }
+      p = c.p;
+      recycled = true;
+      g_pool_recycled++;
+    }
+  }
+  if (p == nullptr) {
+    p = pool_mmap(need);
+    if (p == nullptr) return nullptr;
+    std::lock_guard<std::mutex> g(g_pool_mu);
+    g_pool_fresh_mmaps++;
+  }
+  if (zero && recycled) std::memset(p, 0, need);
+  return p;
+#endif
+}
+
+void pool_free_impl(void* p, int64_t bytes) {
+  if (p == nullptr || bytes <= 0) return;
+#if !defined(__linux__)
+  std::free(p);
+#else
+  size_t sz = pool_round(static_cast<size_t>(bytes));
+  std::lock_guard<std::mutex> g(g_pool_mu);
+  g_pool_free.push_back({static_cast<uint8_t*>(p), sz});
+  g_pool_free_bytes += sz;
+  pool_enforce_limit_locked();
+#endif
+}
 
 inline void flush_line(uint32_t* dst, const uint32_t* src) {
 #if defined(__AVX2__)
@@ -119,11 +225,11 @@ bool partition_by_shard(const uint64_t* cols, int64_t n, int exp,
   for (int64_t s = 0; s < n_shards; s++)
     out.start[s + 1] = out.start[s] + ((count[s] + 15) & ~15LL);
   const size_t part_bytes = ((out.start[n_shards] + 15) & ~15LL) * 4 + 64;
-  try {
-    out.part = static_cast<uint32_t*>(arena_get(g_part_arena, part_bytes));
-  } catch (const std::bad_alloc&) {
-    return false;
-  }
+  out.owned = pool_alloc_impl(static_cast<int64_t>(part_bytes), 0);
+  if (out.owned == nullptr) return false;
+  out.owned_bytes = static_cast<int64_t>(part_bytes);
+  out.part = reinterpret_cast<uint32_t*>(
+      (reinterpret_cast<uintptr_t>(out.owned) + 63) & ~uintptr_t(63));
   std::vector<int64_t> head(out.start.begin(), out.start.end() - 1);
   std::vector<uint32_t> stage(n_shards * 16 + 16);
   uint32_t* stg = reinterpret_cast<uint32_t*>(
@@ -303,6 +409,48 @@ int parse_metas(const uint8_t* buf, int64_t len, std::vector<Meta>* metas) {
 }  // namespace
 
 extern "C" {
+
+// --- pool C ABI (see "recycled page pool" above) --------------------------
+
+void* pool_alloc(int64_t bytes, int zero) { return pool_alloc_impl(bytes, zero); }
+
+void pool_free(void* p, int64_t bytes) { pool_free_impl(p, bytes); }
+
+// Pre-fault `bytes` of pool memory (server boot / before a bulk load).
+// Returns bytes actually reserved (0 on failure).
+int64_t pool_reserve(int64_t bytes) {
+#if !defined(__linux__)
+  (void)bytes;
+  return 0;  // no freelist off Linux — nothing to pre-fault
+#else
+  if (bytes <= 0) return 0;
+  size_t sz = pool_round(static_cast<size_t>(bytes));
+  uint8_t* p = pool_mmap(sz);
+  if (p == nullptr) return 0;
+  std::memset(p, 0, sz);  // fault every page now, off the import path
+  std::lock_guard<std::mutex> g(g_pool_mu);
+  g_pool_free.push_back({p, sz});
+  g_pool_free_bytes += sz;
+  g_pool_fresh_mmaps++;
+  pool_enforce_limit_locked();
+  return static_cast<int64_t>(sz);
+#endif
+}
+
+void pool_set_limit(int64_t bytes) {
+  std::lock_guard<std::mutex> g(g_pool_mu);
+  g_pool_limit = bytes < 0 ? 0 : static_cast<size_t>(bytes);
+  pool_enforce_limit_locked();
+}
+
+// out[0]=free_bytes out[1]=fresh_mmaps out[2]=recycled_allocs out[3]=limit
+void pool_stats(int64_t* out) {
+  std::lock_guard<std::mutex> g(g_pool_mu);
+  out[0] = static_cast<int64_t>(g_pool_free_bytes);
+  out[1] = g_pool_fresh_mmaps;
+  out[2] = g_pool_recycled;
+  out[3] = static_cast<int64_t>(g_pool_limit);
+}
 
 int64_t roaring_decode_count(const uint8_t* buf, int64_t len) {
   std::vector<Meta> metas;
@@ -575,7 +723,6 @@ void scatter_row_blocks(const uint64_t* cols, int64_t n, int exp,
     touched[s] = 1;
     if (block_counts != nullptr) block_counts[s] = cnt;
   }
-  arena_trim(g_part_arena);
 }
 
 int scatter_bsi_blocks(const uint64_t* cols, const int64_t* vals, int64_t n,
@@ -608,14 +755,21 @@ int scatter_bsi_blocks(const uint64_t* cols, const int64_t* vals, int64_t n,
   const int64_t cap = start[n_shards];
   const size_t plocal_bytes = ((cap + 15) & ~15LL) * 4 + 64;
   const size_t pval_bytes = ((cap + 15) & ~15LL) * 8 + 128;
-  uint32_t* plocal = nullptr;
-  int64_t* pval = nullptr;
-  try {
-    plocal = static_cast<uint32_t*>(arena_get(g_part_arena, plocal_bytes));
-    pval = static_cast<int64_t*>(arena_get(g_val_arena, pval_bytes));
-  } catch (const std::bad_alloc&) {
-    plocal = nullptr;
-  }
+  void* plocal_owned = pool_alloc_impl(static_cast<int64_t>(plocal_bytes), 0);
+  void* pval_owned = pool_alloc_impl(static_cast<int64_t>(pval_bytes), 0);
+  uint32_t* plocal = reinterpret_cast<uint32_t*>(
+      (reinterpret_cast<uintptr_t>(plocal_owned) + 63) & ~uintptr_t(63));
+  int64_t* pval = reinterpret_cast<int64_t*>(
+      (reinterpret_cast<uintptr_t>(pval_owned) + 63) & ~uintptr_t(63));
+  struct StagingGuard {
+    void *a, *b;
+    int64_t an, bn;
+    ~StagingGuard() {
+      if (a != nullptr) pool_free_impl(a, an);
+      if (b != nullptr) pool_free_impl(b, bn);
+    }
+  } guard{plocal_owned, pval_owned, static_cast<int64_t>(plocal_bytes),
+          static_cast<int64_t>(pval_bytes)};
   std::vector<int64_t> head(start.begin(), start.end() - 1);
   std::vector<uint32_t> lstage_v(n_shards * 16 + 16);
   std::vector<int64_t> vstage_v(n_shards * 16 + 8);
@@ -624,7 +778,7 @@ int scatter_bsi_blocks(const uint64_t* cols, const int64_t* vals, int64_t n,
   int64_t* vstage = reinterpret_cast<int64_t*>(
       (reinterpret_cast<uintptr_t>(vstage_v.data()) + 63) & ~uintptr_t(63));
   std::vector<uint8_t> fill(n_shards, 0);
-  if (plocal == nullptr || pval == nullptr) {
+  if (plocal_owned == nullptr || pval_owned == nullptr) {
     return -1;  // alloc failure: caller must fall back (blocks untouched)
   }
   for (int64_t k = 0; k < n; k++) {
@@ -700,8 +854,6 @@ int scatter_bsi_blocks(const uint64_t* cols, const int64_t* vals, int64_t n,
     if (block_counts != nullptr)
       for (int64_t r = 0; r < rows; r++) block_counts[s * rows + r] = cnt[r];
   }
-  arena_trim(g_part_arena);
-  arena_trim(g_val_arena);
   return 0;
 }
 

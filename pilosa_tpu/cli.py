@@ -38,6 +38,11 @@ _DEFAULTS = {
     "tls_skip_verify": "",
     "trace_endpoint": "",
     "planner": True,
+    # Buffer-pool pre-fault at boot, MB (native recycled page pool; see
+    # roaring_codec.cpp). Imports allocate block/staging buffers from
+    # recycled fault-warm pages instead of paying first-touch faults —
+    # the classic database buffer-pool reserve.
+    "import_pool_mb": 512,
 }
 
 
@@ -87,6 +92,8 @@ def cmd_server(args) -> int:
         cfg["tls_skip_verify"] = "true"
     if args.trace_endpoint:
         cfg["trace_endpoint"] = args.trace_endpoint
+    if args.import_pool_mb is not None:
+        cfg["import_pool_mb"] = args.import_pool_mb
 
     from pilosa_tpu.server.node import ServerNode
     node = ServerNode(
@@ -105,6 +112,7 @@ def cmd_server(args) -> int:
                          in ("1", "true", "yes")
                          if str(cfg["tls_skip_verify"]) else None),
         trace_endpoint=str(cfg["trace_endpoint"]) or None,
+        import_pool_mb=int(cfg["import_pool_mb"]),
     )
     node.open()  # starts the (single) serve loop in the background
     print(f"pilosa-tpu serving at {node.address}", file=sys.stderr)
@@ -265,6 +273,8 @@ def main(argv: list[str] | None = None) -> int:
     s.add_argument("--tls-key", default="")
     s.add_argument("--tls-ca-cert", default="")
     s.add_argument("--tls-skip-verify", action="store_true")
+    s.add_argument("--import-pool-mb", type=int, default=None,
+                   help="buffer-pool pages pre-faulted at boot (0 disables)")
     s.add_argument("--trace-endpoint", default="",
                    help="OTLP/HTTP collector URL for trace export")
     s.add_argument("--config", default=None)

@@ -180,16 +180,29 @@ def apply_cluster_status(cluster: Cluster, nodes_json: list[dict],
     and, like the reference's NodeStatus, the sender's per-field shard
     availability so new members can route queries for shards they don't
     hold locally. replica_n/partition_n ride along so a joiner booted
-    with mismatched settings can't silently compute a different ring."""
-    if replica_n:
-        cluster.replica_n = int(replica_n)
-    if partition_n:
-        cluster.partition_n = int(partition_n)
-    cluster.nodes = sorted((Node.from_json(n) for n in nodes_json),
-                           key=lambda n: n.id)
-    if version is not None:
-        cluster.topology_version = int(version)
-    cluster._update_state()
+    with mismatched settings can't silently compute a different ring.
+
+    The push path enforces the same strictly-newer version gate as the
+    pull path (Cluster.merge_membership): a delayed or replayed
+    broadcast carrying an OLDER committed topology must not roll the
+    ring back — that would resurrect removed members, shift jump-hash
+    placement, and let the holder GC delete live fragments. Unversioned
+    statuses (version None) predate the version field and are adopted
+    as before. Shard availability always merges: it is additive and
+    harmless."""
+    with cluster._lock:
+        stale = (version is not None
+                 and int(version) <= cluster.topology_version)
+        if not stale:
+            if replica_n:
+                cluster.replica_n = int(replica_n)
+            if partition_n:
+                cluster.partition_n = int(partition_n)
+            cluster.nodes = sorted((Node.from_json(n) for n in nodes_json),
+                                   key=lambda n: n.id)
+            if version is not None:
+                cluster.topology_version = int(version)
+            cluster._update_state()
     if holder is not None and availability:
         for index, fields in availability.items():
             idx = holder.index(index)
@@ -395,7 +408,7 @@ def check_nodes(cluster: Cluster, client, retries: int = 2,
     membership push/pull (one GET per live peer) — callers on a tight
     sweep cadence can run it every few sweeps."""
     changed = []
-    for node in cluster.nodes:
+    for node in list(cluster.nodes):
         if node.id == cluster.local_id:
             continue
         alive = False
@@ -420,14 +433,21 @@ def check_nodes(cluster: Cluster, client, retries: int = 2,
             if isinstance(resp, dict) and resp.get("nodes"):
                 changed.extend(cluster.merge_membership(
                     resp["nodes"], int(resp.get("version", 0))))
-        if alive and node.state == "DOWN":
-            node.state = "READY"
-            changed.append(node.id)
-            cluster._emit(EVENT_UPDATE, node.id, "READY")
-        elif not alive and node.state != "DOWN":
-            node.state = "DOWN"
-            changed.append(node.id)
-            cluster._emit(EVENT_UPDATE, node.id, "DOWN")
+        # A merge_membership above may have REPLACED cluster.nodes with
+        # fresh Node objects — re-resolve by id so the liveness
+        # transition lands on the live entry, not an orphan of the old
+        # list (and skip nodes the merge removed outright).
+        live = next((n for n in cluster.nodes if n.id == node.id), None)
+        if live is None:
+            continue
+        if alive and live.state == "DOWN":
+            live.state = "READY"
+            changed.append(live.id)
+            cluster._emit(EVENT_UPDATE, live.id, "READY")
+        elif not alive and live.state != "DOWN":
+            live.state = "DOWN"
+            changed.append(live.id)
+            cluster._emit(EVENT_UPDATE, live.id, "DOWN")
     if changed:
         cluster._update_state()
     return changed

@@ -88,6 +88,16 @@ def _load() -> ctypes.CDLL | None:
             ctypes.c_int64,
             np.ctypeslib.ndpointer(np.uint8, flags="C"),
             np.ctypeslib.ndpointer(np.int64, flags="C")]
+        lib.pool_alloc.restype = ctypes.c_void_p
+        lib.pool_alloc.argtypes = [ctypes.c_int64, ctypes.c_int]
+        lib.pool_free.restype = None
+        lib.pool_free.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.pool_reserve.restype = ctypes.c_int64
+        lib.pool_reserve.argtypes = [ctypes.c_int64]
+        lib.pool_set_limit.restype = None
+        lib.pool_set_limit.argtypes = [ctypes.c_int64]
+        lib.pool_stats.restype = None
+        lib.pool_stats.argtypes = [np.ctypeslib.ndpointer(np.int64, flags="C")]
         _lib = lib
         return _lib
 
@@ -122,6 +132,63 @@ def _advise_huge(arr: np.ndarray) -> None:
                           ctypes.c_int(_MADV_HUGEPAGE))
     except Exception:
         pass
+
+
+def pool_reserve(n_bytes: int) -> int:
+    """Pre-fault ``n_bytes`` of recycled-page pool memory (see the
+    "recycled page pool" note in native/roaring_codec.cpp). Called at
+    server boot (config ``import-pool-mb`` / PILOSA_TPU_POOL_MB) so bulk
+    imports never pay first-touch faults on their block/staging buffers
+    — the buffer-pool move every database makes, and the analog of the
+    reference's mmap page cache staying warm across imports
+    (fragment.go:311). Returns bytes actually reserved (0 if the native
+    library is unavailable)."""
+    lib = _load()
+    if lib is None or n_bytes <= 0:
+        return 0
+    return int(lib.pool_reserve(int(n_bytes)))
+
+
+def pool_set_limit(n_bytes: int) -> None:
+    lib = _load()
+    if lib is not None:
+        lib.pool_set_limit(int(n_bytes))
+
+
+def pool_stats() -> dict | None:
+    lib = _load()
+    if lib is None:
+        return None
+    out = np.zeros(4, dtype=np.int64)
+    lib.pool_stats(out)
+    return {"free_bytes": int(out[0]), "fresh_mmaps": int(out[1]),
+            "recycled_allocs": int(out[2]), "limit_bytes": int(out[3])}
+
+
+def pool_zeros(shape, dtype=np.uint32) -> np.ndarray | None:
+    """np.zeros backed by pool memory: recycled chunks re-zero via
+    memset at warm-memory speed instead of per-page fault+zero. The
+    chunk returns to the pool when the array (and every view of it) is
+    garbage-collected. None when the native library or memory is
+    unavailable — callers fall back to np.zeros."""
+    import weakref
+
+    lib = _load()
+    if lib is None:
+        return None
+    n_bytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
+    if n_bytes <= 0:
+        return None
+    ptr = lib.pool_alloc(n_bytes, 1)
+    if not ptr:
+        return None
+    buf = (ctypes.c_uint8 * n_bytes).from_address(ptr)
+    fin = weakref.finalize(buf, lib.pool_free, ptr, n_bytes)
+    # At interpreter shutdown the pool (and lib) die with the process;
+    # running the finalizer then could touch a torn-down CDLL.
+    fin.atexit = False
+    arr = np.frombuffer(buf, dtype=np.uint8, count=n_bytes)
+    return arr.view(dtype).reshape(shape)
 
 
 def decode_roaring(buf: bytes) -> np.ndarray:
@@ -215,8 +282,10 @@ def scatter_row_blocks(cols: np.ndarray, exp: int,
     if lib is None:
         return None
     cols = np.ascontiguousarray(cols, dtype=np.uint64)
-    blocks = np.zeros((n_shards, words_per_shard), dtype=np.uint32)
-    _advise_huge(blocks)
+    blocks = pool_zeros((n_shards, words_per_shard), np.uint32)
+    if blocks is None:
+        blocks = np.zeros((n_shards, words_per_shard), dtype=np.uint32)
+        _advise_huge(blocks)
     touched = np.zeros(n_shards, dtype=np.uint8)
     counts = np.zeros(n_shards, dtype=np.int64)
     lib.scatter_row_blocks(cols, len(cols), exp,
@@ -239,9 +308,11 @@ def scatter_bsi_blocks(cols: np.ndarray, vals: np.ndarray, exp: int,
         return None
     cols = np.ascontiguousarray(cols, dtype=np.uint64)
     vals = np.ascontiguousarray(vals, dtype=np.int64)
-    blocks = np.zeros((n_shards, depth + 2, words_per_shard),
-                      dtype=np.uint32)
-    _advise_huge(blocks)
+    blocks = pool_zeros((n_shards, depth + 2, words_per_shard), np.uint32)
+    if blocks is None:
+        blocks = np.zeros((n_shards, depth + 2, words_per_shard),
+                          dtype=np.uint32)
+        _advise_huge(blocks)
     touched = np.zeros(n_shards, dtype=np.uint8)
     counts = np.zeros((n_shards, depth + 2), dtype=np.int64)
     rc = lib.scatter_bsi_blocks(cols, vals, len(cols), exp, depth,

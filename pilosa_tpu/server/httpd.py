@@ -220,6 +220,14 @@ def _build_routes(api: API):
     def post_import(pv, params, body):
         req = jbody(body)
         clear = params.get("clear") in ("1", "true")
+        # A typo'd payload (wrong key names) must 400, not silently
+        # import nothing (reference: proto unmarshal rejects unknown
+        # shapes before api.Import runs, http/handler.go import route).
+        known = {"values", "columnIDs", "columnKeys", "rowIDs", "rowKeys",
+                 "timestamps"}
+        if not (known & req.keys()):
+            raise QueryError(
+                "import payload needs rowIDs/columnIDs (or values)")
         if "values" in req:
             api.import_values(pv["index"], pv["field"],
                               req.get("columnIDs") or [],

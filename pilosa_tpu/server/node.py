@@ -48,7 +48,8 @@ class ServerNode:
                  tls_key: str | None = None,
                  tls_ca_cert: str | None = None,
                  tls_skip_verify: bool | None = None,
-                 trace_endpoint: str | None = None):
+                 trace_endpoint: str | None = None,
+                 import_pool_mb: int = 0):
         host, _, port = bind.partition(":")
         self.host, self.port = host or "127.0.0.1", int(port or 10101)
         # Node identity IS the address — member ids are built the same
@@ -122,6 +123,7 @@ class ServerNode:
                                tls_cert=tls_cert, tls_key=tls_key)
         self.port = self.http.port
 
+        self._import_pool_mb = int(import_pool_mb)
         self.syncer = None
         self._sync_timer: threading.Timer | None = None
         self._check_timer: threading.Timer | None = None
@@ -158,6 +160,16 @@ class ServerNode:
 
     def open(self) -> None:
         self.http.serve_background()
+        if self._import_pool_mb > 0:
+            # Fault the import buffer pool off the serving path — boot
+            # keeps serving while pages warm (native recycled page pool;
+            # the analog of the reference's mmap page cache being warm
+            # for re-imported fragments, fragment.go:311).
+            def _warm(mb: int = self._import_pool_mb) -> None:
+                from pilosa_tpu import native
+                native.pool_reserve(mb << 20)
+            threading.Thread(target=_warm, daemon=True,
+                             name="pool-warm").start()
         if self.join_addr is not None:
             self._send_join()
         if self.syncer is not None and self._anti_entropy_interval > 0:

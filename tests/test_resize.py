@@ -693,4 +693,31 @@ def test_stale_peer_cannot_resurrect_removed_member():
     changed = check_nodes(a.cluster, lc.client)
     assert a.cluster.node_by_id("node2") is None, "ghost resurrected"
     assert a.cluster.topology_version == 2
-    assert all(c != "node2" or True for c in changed)
+    assert all(c != "node2" for c in changed), \
+        "removed ghost must not appear as a liveness transition"
+
+
+def test_stale_broadcast_cannot_roll_back_topology():
+    """The PUSH path enforces the same strictly-newer gate as the pull
+    path: a delayed/replayed cluster-status broadcast carrying an OLDER
+    committed topology must not roll the ring back (it would resurrect
+    removed members and shift jump-hash placement under the holder GC)."""
+    from pilosa_tpu.cluster.resize import apply_cluster_status
+
+    lc = LocalCluster(3, replica_n=1)
+    a = lc[0]
+    ghost_json = [n.to_json() for n in a.cluster.nodes]  # includes node2
+    # A committed the shrink at version 2.
+    a.cluster.nodes = [n for n in a.cluster.nodes if n.id != "node2"]
+    a.cluster.topology_version = 2
+    # A delayed broadcast of the PRE-shrink topology (version 1) arrives.
+    apply_cluster_status(a.cluster, ghost_json, version=1)
+    assert a.cluster.node_by_id("node2") is None, "stale push rolled back"
+    assert a.cluster.topology_version == 2
+    # Equal version: replay of the current commit is also a no-op.
+    apply_cluster_status(a.cluster, ghost_json, version=2)
+    assert a.cluster.node_by_id("node2") is None
+    # Strictly newer wins: the ring moves forward.
+    newer = [n.to_json() for n in a.cluster.nodes]
+    apply_cluster_status(a.cluster, newer, version=3)
+    assert a.cluster.topology_version == 3

@@ -102,6 +102,19 @@ def test_query_column_attrs(node):
     assert resp["columnAttrs"] == [{"id": 7, "attrs": {"name": "x"}}]
 
 
+def test_import_rejects_unknown_payload_shape(node):
+    """A typo'd import body (wrong key names) must 400, not silently
+    import nothing — the reference's proto unmarshal rejects unknown
+    shapes before api.Import runs."""
+    b = node.address
+    req(b, "POST", "/index/badimp", "{}")
+    req(b, "POST", "/index/badimp/field/f", "{}")
+    body = json.dumps({"rows": [1], "cols": [3]})  # wrong keys
+    status, resp = req(b, "POST", "/index/badimp/field/f/import", body)
+    assert status == 400
+    assert "rowIDs" in resp["error"]
+
+
 def test_import_and_export(node):
     b = node.address
     req(b, "POST", "/index/i", "{}")
