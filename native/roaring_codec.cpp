@@ -429,6 +429,11 @@ int64_t pool_reserve(int64_t bytes) {
   if (p == nullptr) return 0;
   std::memset(p, 0, sz);  // fault every page now, off the import path
   std::lock_guard<std::mutex> g(g_pool_mu);
+  // An explicit reserve states operator intent: the retained cap must
+  // cover it, or the eviction below would silently unmap the chunk we
+  // just faulted and report success anyway.
+  if (g_pool_limit < g_pool_free_bytes + sz)
+    g_pool_limit = g_pool_free_bytes + sz;
   g_pool_free.push_back({p, sz});
   g_pool_free_bytes += sz;
   g_pool_fresh_mmaps++;

@@ -137,8 +137,9 @@ def _advise_huge(arr: np.ndarray) -> None:
 def pool_reserve(n_bytes: int) -> int:
     """Pre-fault ``n_bytes`` of recycled-page pool memory (see the
     "recycled page pool" note in native/roaring_codec.cpp). Called at
-    server boot (config ``import-pool-mb`` / PILOSA_TPU_POOL_MB) so bulk
-    imports never pay first-touch faults on their block/staging buffers
+    server boot (config ``import-pool-mb``, env
+    PILOSA_TPU_IMPORT_POOL_MB) so bulk imports never pay first-touch
+    faults on their block/staging buffers
     — the buffer-pool move every database makes, and the analog of the
     reference's mmap page cache staying warm across imports
     (fragment.go:311). Returns bytes actually reserved (0 if the native

@@ -35,6 +35,10 @@ class ServerNode:
     #: failure-detector sweep cadence, seconds (reference: memberlist's
     #: SWIM probes + confirmNodeDown cluster.go:1724).
     DEFAULT_CHECK_NODES_INTERVAL = 5.0
+    #: buffer-pool top-up check cadence, seconds (imports adopt pool
+    #: chunks as permanent fragment storage; the pool re-faults the
+    #: deficit in the background).
+    POOL_TOPUP_INTERVAL = 30.0
 
     def __init__(self, bind: str = "127.0.0.1:10101",
                  peers: list[str] | None = None,
@@ -124,6 +128,7 @@ class ServerNode:
         self.port = self.http.port
 
         self._import_pool_mb = int(import_pool_mb)
+        self._pool_stop = threading.Event()
         self.syncer = None
         self._sync_timer: threading.Timer | None = None
         self._check_timer: threading.Timer | None = None
@@ -164,10 +169,24 @@ class ServerNode:
             # Fault the import buffer pool off the serving path — boot
             # keeps serving while pages warm (native recycled page pool;
             # the analog of the reference's mmap page cache being warm
-            # for re-imported fragments, fragment.go:311).
+            # for re-imported fragments, fragment.go:311). Then keep it
+            # topped up: dense imports ADOPT pool-backed block arrays as
+            # permanent fragment storage, permanently draining the
+            # freelist, so a one-shot reserve would go cold after a few
+            # bulk loads. The top-up loop re-faults the deficit in the
+            # background whenever the free level falls below half the
+            # configured size.
             def _warm(mb: int = self._import_pool_mb) -> None:
                 from pilosa_tpu import native
-                native.pool_reserve(mb << 20)
+                target = mb << 20
+                native.pool_reserve(target)
+                while not self._pool_stop.wait(self.POOL_TOPUP_INTERVAL):
+                    stats = native.pool_stats()
+                    if stats is None:
+                        return
+                    deficit = target - stats["free_bytes"]
+                    if deficit > target // 2:
+                        native.pool_reserve(deficit)
             threading.Thread(target=_warm, daemon=True,
                              name="pool-warm").start()
         if self.join_addr is not None:
@@ -306,6 +325,7 @@ class ServerNode:
 
     def close(self) -> None:
         self._closed = True
+        self._pool_stop.set()
         if self.tracer is not None:
             from pilosa_tpu.obs import NopTracer, get_tracer, set_tracer
             if get_tracer() is self.tracer:

@@ -14,8 +14,12 @@ import pytest
 
 from pilosa_tpu import native
 
-pytestmark = pytest.mark.skipif(not native.available(),
-                                reason="native library unavailable")
+import sys
+
+pytestmark = pytest.mark.skipif(
+    not native.available() or sys.platform != "linux",
+    reason="native library unavailable (or non-Linux: the pool degrades "
+           "to plain calloc/free with no freelist there)")
 
 
 def _stats():
@@ -85,6 +89,22 @@ def test_reserve_prefaults_and_scatter_recycles():
     del out, blocks
     gc.collect()
     assert _stats()["free_bytes"] >= before["free_bytes"]
+
+
+def test_reserve_raises_cap_to_cover_itself():
+    """An explicit reserve above the retained cap must raise the cap,
+    not silently evict the chunk it just faulted while reporting
+    success."""
+    base = _stats()
+    native.pool_set_limit(4 << 20)
+    try:
+        got = native.pool_reserve(32 << 20)
+        assert got >= 32 << 20
+        s = _stats()
+        assert s["free_bytes"] >= 32 << 20
+        assert s["limit_bytes"] >= s["free_bytes"]
+    finally:
+        native.pool_set_limit(max(base["limit_bytes"], _stats()["limit_bytes"]))
 
 
 def test_limit_evicts_excess():

@@ -47,6 +47,46 @@ def node():
     n.close()
 
 
+def test_pool_topup_refills_after_drain(monkeypatch):
+    """The boot-time pool warmer keeps the freelist topped up: imports
+    adopt pool chunks as permanent fragment storage, so a one-shot
+    reserve would go cold after a few bulk loads."""
+    import sys
+    import time as _time
+
+    import numpy as np
+
+    from pilosa_tpu import native
+
+    if not native.available() or sys.platform != "linux":
+        pytest.skip("native pool unavailable")
+    monkeypatch.setattr(ServerNode, "POOL_TOPUP_INTERVAL", 0.1)
+    n = ServerNode(bind="127.0.0.1:0", use_planner=False,
+                   import_pool_mb=8)
+    n.open()
+    try:
+        deadline = _time.time() + 5
+        while (native.pool_stats()["free_bytes"] < (8 << 20)
+               and _time.time() < deadline):
+            _time.sleep(0.05)
+        assert native.pool_stats()["free_bytes"] >= 8 << 20
+        # Drain past half the target: the next tick must re-fault it.
+        held = []
+        while native.pool_stats()["free_bytes"] > (3 << 20):
+            a = native.pool_zeros((1 << 20,), np.uint8)
+            if a is None:
+                break
+            held.append(a)
+        deadline = _time.time() + 5
+        while (native.pool_stats()["free_bytes"] < (8 << 20) // 2
+               and _time.time() < deadline):
+            _time.sleep(0.05)
+        assert native.pool_stats()["free_bytes"] >= (8 << 20) // 2
+        del held
+    finally:
+        n.close()
+
+
 def test_home_and_info(node):
     r = urllib.request.urlopen(node.address + "/", timeout=10)
     assert r.status == 200
