@@ -454,17 +454,21 @@ def bench_bsi(extra):
     # Amortized rate at bulk-load batch size: the 2M-value batch above
     # is dominated by the one-time dense plane-buffer creation (see
     # PROFILE_import.md); 8M values over the same columns shows the
-    # steady-state import rate.
-    v8 = idx.create_field("v8", FieldOptions(type=FIELD_TYPE_INT,
-                                             min=-100_000, max=100_000))
+    # steady-state import rate. A STEADY-STATE metric gets the median
+    # of 3 trials — single-shot numbers on this shared vCPU swing 2x
+    # with scheduler/fault luck (same import: 6.6 then 13.3 Mvals/s).
     vc8 = rng.integers(0, cols, 8_000_000, dtype=np.uint64)
     vv8 = rng.integers(-100_000, 100_000, 8_000_000)
-    t0 = time.perf_counter()
-    v8.import_values(vc8, vv8)
-    extra["bsi_import_mvals_per_s_8m"] = round(
-        8_000_000 / (time.perf_counter() - t0) / 1e6, 2)
+    rates = []
+    for t in range(3):
+        v8 = idx.create_field("v8", FieldOptions(type=FIELD_TYPE_INT,
+                                                 min=-100_000, max=100_000))
+        t0 = time.perf_counter()
+        v8.import_values(vc8, vv8)
+        rates.append(8_000_000 / (time.perf_counter() - t0) / 1e6)
+        idx.delete_field("v8")
+    extra["bsi_import_mvals_per_s_8m"] = round(statistics.median(rates), 2)
     del vc8, vv8
-    idx.delete_field("v8")
     f.import_bits(np.ones(500_000, dtype=np.uint64),
                   _rand_positions(rng, 500_000, cols))
 
@@ -590,7 +594,7 @@ def main() -> None:
     # hypervisor's first-touch fault rate (~0.7-2 GB/s vs 8 GB/s warm;
     # THP is unavailable here: AnonHugePages stays 0 under madvise).
     from pilosa_tpu import native as _native
-    extra["pool_reserved_mb"] = _native.pool_reserve(768 << 20) >> 20
+    extra["pool_reserved_mb"] = _native.pool_reserve(1024 << 20) >> 20
 
     qps = cpu_qps = None
     t_all = time.perf_counter()

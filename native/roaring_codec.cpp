@@ -831,6 +831,30 @@ int scatter_bsi_blocks(const uint64_t* cols, const int64_t* vals, int64_t n,
     uint32_t* base = blocks + s * rows * words_per_shard;
     std::fill(cnt.begin(), cnt.end(), 0);
     for (int64_t k = hi - 1; k >= lo; k--) {
+      // Each value touches ~popcount(v) plane words that all share ONE
+      // word offset w but sit 128 KiB apart — every touch is a cache
+      // miss. The addresses are computable from (plocal, pval) alone,
+      // so prefetch a few values ahead: exists + sign + the magnitude's
+      // set-bit planes.
+      if (k - 4 >= lo) {
+        uint32_t pl = plocal[k - 4];
+        int64_t pw = pl >> 5;
+        __builtin_prefetch(&base[pw], 1);
+        int64_t pv = pval[k - 4];
+        uint64_t pm;
+        if (pv < 0) {
+          __builtin_prefetch(&base[words_per_shard + pw], 1);
+          pm = static_cast<uint64_t>(-pv);
+        } else {
+          pm = static_cast<uint64_t>(pv);
+        }
+        while (pm) {
+          int i = __builtin_ctzll(pm);
+          pm &= pm - 1;
+          if (i < depth)
+            __builtin_prefetch(&base[(2 + i) * words_per_shard + pw], 1);
+        }
+      }
       uint32_t local = plocal[k];
       int64_t w = local >> 5;
       uint32_t bit = 1u << (local & 31);

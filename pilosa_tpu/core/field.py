@@ -577,27 +577,40 @@ class Field:
         n_shards = (int(column_ids.max()) >> exp) + 1
         if n_shards * WORDS_PER_SHARD * 4 > self._SCATTER_MAX_BYTES:
             return False
-        for rid, mask in zip(distinct.tolist(), masks):
-            out = native.scatter_row_blocks(
-                column_ids[mask] if len(masks) > 1 else column_ids,
-                exp, n_shards, WORDS_PER_SHARD)
-            if out is None:
-                return False
-            blocks, touched, counts = out
-            shards = np.flatnonzero(touched)
-            # Dense batches use nearly the whole buffer: hand fragments
-            # VIEWS into it (slices are disjoint, so in-place fragment
-            # mutation stays correct) — copying would double the memory
-            # traffic for no pinning benefit. Sparse batches copy so a
-            # few live rows can't pin a huge base array. The test is
-            # BYTES USED (adopted rows keep the whole base alive).
-            used = len(shards) * WORDS_PER_SHARD * 4
-            adopt = used * 2 >= blocks.nbytes
-            for shard in shards.tolist():
-                frag = view.create_fragment_if_not_exists(int(shard))
-                row = blocks[shard] if adopt else blocks[shard].copy()
-                frag.merge_row_words(int(rid), row,
-                                     bit_count=int(counts[shard]))
+        merged_any = False
+        try:
+            for rid, mask in zip(distinct.tolist(), masks):
+                out = native.scatter_row_blocks(
+                    column_ids[mask] if len(masks) > 1 else column_ids,
+                    exp, n_shards, WORDS_PER_SHARD)
+                if out is None:
+                    return False
+                blocks, touched, counts = out
+                shards = np.flatnonzero(touched)
+                # Dense batches use nearly the whole buffer: hand
+                # fragments VIEWS into it (slices are disjoint, so
+                # in-place fragment mutation stays correct) — copying
+                # would double the memory traffic for no pinning
+                # benefit. Sparse batches copy so a few live rows can't
+                # pin a huge base array. The test is BYTES USED
+                # (adopted rows keep the whole base alive).
+                used = len(shards) * WORDS_PER_SHARD * 4
+                adopt = used * 2 >= blocks.nbytes
+                for shard in shards.tolist():
+                    frag = view.create_fragment_if_not_exists(int(shard))
+                    row = blocks[shard] if adopt else blocks[shard].copy()
+                    frag.merge_row_words(int(rid), row,
+                                         bit_count=int(counts[shard]),
+                                         bump_epoch=False)
+                    merged_any = True
+        finally:
+            # ONE shared-epoch bump for the whole batch, not one per
+            # shard — including the partial-failure exit (a later row's
+            # scatter failing after earlier rows merged), where stale
+            # epoch-stamped caches would otherwise serve pre-import
+            # counts for the merged rows.
+            if merged_any:
+                self.index_epoch_bump()
         return True
 
     def import_values(self, column_ids, values, clear: bool = False) -> None:
@@ -658,9 +671,9 @@ class Field:
             return False
         # Last-write-wins for duplicated columns happens inside the
         # native pass (the exists plane is the seen-set on a fresh view).
-        out = native.scatter_bsi_blocks(cols.astype(np.uint64), vals,
-                                        exp, depth, n_shards,
-                                        WORDS_PER_SHARD)
+        out = native.scatter_bsi_blocks(
+            np.ascontiguousarray(cols, dtype=np.uint64), vals,
+            exp, depth, n_shards, WORDS_PER_SHARD)
         if out is None:
             return False
         blocks, touched, counts = out
@@ -670,8 +683,15 @@ class Field:
         # planes must copy rather than pin the whole plane buffer.
         used = int(np.count_nonzero(counts)) * WORDS_PER_SHARD * 4
         adopt = used * 2 >= blocks.nbytes
+        from pilosa_tpu.config import DENSE_CUTOFF
         for shard in shards.tolist():
             frag = view.create_fragment_if_not_exists(int(shard))
+            # Sparse plane rows skip the positions conversion only when
+            # a SIBLING plane of the same shard stays dense anyway (its
+            # view pins the chunk regardless, so positions would cost a
+            # scan and free nothing). An all-sparse shard still
+            # converts, letting the chunk be garbage-collected.
+            pinned = adopt and int(counts[shard].max()) > DENSE_CUTOFF // 2
             for r in range(depth + 2):
                 n_bits = int(counts[shard][r])
                 if n_bits == 0:
@@ -682,8 +702,19 @@ class Field:
                 assert BSI_SIGN_BIT == 1
                 row = (blocks[shard][r] if adopt
                        else blocks[shard][r].copy())
-                frag.merge_row_words(row_id, row, bit_count=n_bits)
+                frag.merge_row_words(row_id, row, bit_count=n_bits,
+                                     bump_epoch=False,
+                                     prefer_dense=pinned)
+        # ONE shared-epoch bump for the whole batch (cache invalidation
+        # + dirty broadcast), not one per landed plane row.
+        self.index_epoch_bump()
         return True
+
+    def index_epoch_bump(self) -> None:
+        """One batched index-epoch bump (bulk importers defer per-row
+        bumps here: one cache invalidation + dirty broadcast per batch)."""
+        if self.epoch is not None:
+            self.epoch.bump()
 
     def import_roaring(self, shard: int, data: bytes, view: str = VIEW_STANDARD,
                        clear: bool = False) -> int:

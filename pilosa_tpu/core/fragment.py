@@ -118,9 +118,9 @@ class Fragment:
 
     # -- mutation ----------------------------------------------------------
 
-    def _invalidate(self):
+    def _invalidate(self, bump_epoch: bool = True):
         self.generation += 1
-        if self.epoch is not None:
+        if bump_epoch and self.epoch is not None:
             self.epoch.bump()
         # Stale device blocks would never be re-hit (generation mismatch) but
         # would pin HBM forever; drop them eagerly.
@@ -285,11 +285,21 @@ class Fragment:
             return changed
 
     def merge_row_words(self, row_id: int, words: np.ndarray,
-                        bit_count: int | None = None) -> int:
+                        bit_count: int | None = None,
+                        bump_epoch: bool = True,
+                        prefer_dense: bool = False) -> int:
         """Merge a freshly-scattered dense word block into one row — the
         landing half of the native bulk-import scatter (reference
         importRoaringBits' container merge, roaring.go:1511). ``words``
-        ownership transfers to the fragment; returns bits added."""
+        ownership transfers to the fragment; returns bits added.
+
+        Bulk callers landing MANY rows per batch pass bump_epoch=False
+        and bump the shared index epoch ONCE at the end (one cache
+        invalidation + dirty broadcast per import, not per plane), and
+        prefer_dense=True when ``words`` is a view of a scatter buffer
+        whose chunk stays pinned by sibling planes anyway — converting a
+        near-empty plane to positions there costs a scan and saves no
+        memory."""
         from pilosa_tpu import native
         with self._lock:
             if bit_count is None:
@@ -298,13 +308,14 @@ class Fragment:
                 return 0
             hr = self.rows.get(row_id)
             if hr is None or hr.n == 0:
-                self.rows[row_id] = HostRow.adopt_words(words, bit_count)
+                self.rows[row_id] = HostRow.adopt_words(
+                    words, bit_count, prefer_dense=prefer_dense)
                 changed = bit_count
             else:
                 changed = hr.merge_words(words)
             if changed:
                 self._col_row = None
-                self._invalidate()
+                self._invalidate(bump_epoch=bump_epoch)
                 if self.op_writer:
                     pos = native.words_to_positions(words)
                     base = np.uint64(self.shard * SHARD_WIDTH)

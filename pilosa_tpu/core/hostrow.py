@@ -272,14 +272,19 @@ class HostRow:
         return added
 
     @classmethod
-    def adopt_words(cls, words: np.ndarray, n: int | None = None) -> "HostRow":
+    def adopt_words(cls, words: np.ndarray, n: int | None = None,
+                    prefer_dense: bool = False) -> "HostRow":
         """Build a row AROUND a freshly-scattered dense block (caller
-        relinquishes ownership — no copy for dense rows)."""
+        relinquishes ownership — no copy for dense rows). prefer_dense
+        skips the sparse conversion even for near-empty rows — right
+        when ``words`` is a view whose backing chunk stays pinned by
+        sibling rows regardless, so positions would cost a scan and
+        save nothing."""
         from pilosa_tpu import native
         r = cls()
         if n is None:
             n = native.popcount_words(words)
-        if n > DENSE_CUTOFF // 2:  # see merge_words on the lower bar
+        if prefer_dense or n > DENSE_CUTOFF // 2:  # see merge_words
             r.dense = words
             r.positions = None
         else:

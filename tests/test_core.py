@@ -416,6 +416,76 @@ def test_scatter_import_equivalence(rng):
             assert fr1.rows[r].n == fr2.rows[r].n
 
 
+def test_scatter_partial_failure_still_bumps_epoch(rng, monkeypatch):
+    """A multi-row scatter whose SECOND row's native scatter fails must
+    still bump the index epoch for the rows already merged — otherwise
+    epoch-stamped result caches keep serving pre-import counts."""
+    from pilosa_tpu import native
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.config import SHARD_WIDTH
+    import numpy as np
+
+    if not native.available():
+        import pytest
+        pytest.skip("native library unavailable")
+    n_bits = 70_000
+    cols = rng.integers(0, 3 * SHARD_WIDTH, n_bits, dtype=np.uint64)
+    rows = rng.integers(0, 2, n_bits).astype(np.uint64)
+
+    h = Holder()
+    idx = h.create_index("a")
+    f = idx.create_field("f")
+    before = idx.epoch.value
+
+    real = native.scatter_row_blocks
+    calls = {"n": 0}
+
+    def flaky(*a, **k):
+        calls["n"] += 1
+        if calls["n"] >= 2:
+            raise MemoryError("simulated alloc failure on second row")
+        return real(*a, **k)
+
+    monkeypatch.setattr(native, "scatter_row_blocks", flaky)
+    import pytest
+    with pytest.raises(MemoryError):
+        f.import_bits(rows, cols)
+    # Row 0 merged before the failure: the epoch must reflect it even
+    # though the batch died mid-flight.
+    assert idx.epoch.value > before
+
+
+def test_all_sparse_scatter_rows_convert_to_positions(rng):
+    """A BSI batch that is sparse within EVERY plane must not pin the
+    whole scatter buffer as dense views: all-sparse shards convert to
+    position arrays so the chunk can be garbage-collected."""
+    from pilosa_tpu import native
+    from pilosa_tpu.core import Holder, FieldOptions
+    from pilosa_tpu.core.field import FIELD_TYPE_INT
+    from pilosa_tpu.config import SHARD_WIDTH
+    import numpy as np
+
+    if not native.available():
+        import pytest
+        pytest.skip("native library unavailable")
+    # ~1.6k values per shard across 64 shards: every plane row stays
+    # far below DENSE_CUTOFF//2, yet >=half the shards are touched so
+    # the adopt heuristic fires.
+    n = 100_000
+    cols = rng.integers(0, 64 * SHARD_WIDTH, n, dtype=np.uint64)
+    vals = rng.integers(-50, 50, n, dtype=np.int64)
+    h = Holder()
+    idx = h.create_index("a")
+    v = idx.create_field("v", FieldOptions(type=FIELD_TYPE_INT,
+                                           min=-50, max=50))
+    v.import_values(cols, vals)
+    for s in sorted(v.available_shards()):
+        frag = h.fragment("a", "v", "bsig_v", s)
+        for hr in frag.rows.values():
+            assert hr.dense is None, \
+                "sparse plane row kept a dense view, pinning the chunk"
+
+
 def test_scatter_import_values_equivalence(rng):
     """Native BSI scatter vs the exact per-shard path, including
     duplicate columns (last write wins) and negatives."""
