@@ -449,8 +449,21 @@ def bench_bsi(extra):
     vv = rng.integers(-100_000, 100_000, n_vals)
     t0 = time.perf_counter()
     v.import_values(vc, vv)
-    extra["bsi_import_mvals_per_s"] = round(
-        n_vals / (time.perf_counter() - t0) / 1e6, 2)
+    first_rate = n_vals / (time.perf_counter() - t0) / 1e6
+    # Median of 3 (fresh field each trial, so the one-time plane-buffer
+    # creation stays IN the metric): single-shot numbers on this shared
+    # vCPU swing with scheduler/fault luck. The first trial's field is
+    # kept — the queries below run against it.
+    rates2m = [first_rate]
+    for t in range(2):
+        vt = idx.create_field(f"v2m{t}", FieldOptions(type=FIELD_TYPE_INT,
+                                                      min=-100_000,
+                                                      max=100_000))
+        t0 = time.perf_counter()
+        vt.import_values(vc, vv)
+        rates2m.append(n_vals / (time.perf_counter() - t0) / 1e6)
+        idx.delete_field(f"v2m{t}")
+    extra["bsi_import_mvals_per_s"] = round(statistics.median(rates2m), 2)
     # Amortized rate at bulk-load batch size: the 2M-value batch above
     # is dominated by the one-time dense plane-buffer creation (see
     # PROFILE_import.md); 8M values over the same columns shows the

@@ -277,6 +277,14 @@ class Field:
     def add_remote_available_shards(self, shards: Iterable[int]) -> None:
         self.remote_available_shards |= set(shards)
 
+    def remove_remote_available_shard(self, shard: int) -> None:
+        """Forget a remotely-advertised shard (reference
+        Field.RemoveAvailableShard, field.go:344 — DELETE
+        /internal/.../remote-available-shards/{shard}): used when the
+        cluster learns a remote shard no longer exists, so queries stop
+        fanning out to it."""
+        self.remote_available_shards.discard(int(shard))
+
     # -- bit ops -----------------------------------------------------------
 
     def set_bit(self, row_id: int, column_id: int,
@@ -684,30 +692,39 @@ class Field:
         used = int(np.count_nonzero(counts)) * WORDS_PER_SHARD * 4
         adopt = used * 2 >= blocks.nbytes
         from pilosa_tpu.config import DENSE_CUTOFF
-        for shard in shards.tolist():
-            frag = view.create_fragment_if_not_exists(int(shard))
-            # Sparse plane rows skip the positions conversion only when
-            # a SIBLING plane of the same shard stays dense anyway (its
-            # view pins the chunk regardless, so positions would cost a
-            # scan and free nothing). An all-sparse shard still
-            # converts, letting the chunk be garbage-collected.
-            pinned = adopt and int(counts[shard].max()) > DENSE_CUTOFF // 2
-            for r in range(depth + 2):
-                n_bits = int(counts[shard][r])
-                if n_bits == 0:
-                    continue  # empty plane: skip the copy + lock trip
-                # Per-shard plane order: exists, sign, magnitude planes
-                # (BSI row ids 0, 1, 2+i — fragment.go:87-93).
-                row_id = r if r < 2 else BSI_OFFSET_BIT + (r - 2)
-                assert BSI_SIGN_BIT == 1
-                row = (blocks[shard][r] if adopt
-                       else blocks[shard][r].copy())
-                frag.merge_row_words(row_id, row, bit_count=n_bits,
-                                     bump_epoch=False,
-                                     prefer_dense=pinned)
-        # ONE shared-epoch bump for the whole batch (cache invalidation
-        # + dirty broadcast), not one per landed plane row.
-        self.index_epoch_bump()
+        # Sparse plane rows skip the positions conversion when ANY row
+        # of the batch stays dense: adopted rows are views of ONE shared
+        # pool chunk, so as long as one dense view lives, the chunk is
+        # pinned regardless and positions would cost a scan and free
+        # nothing. Only an ALL-sparse batch converts everything, letting
+        # the chunk be garbage-collected.
+        pinned = adopt and int(counts.max()) > DENSE_CUTOFF // 2
+        merged_any = False
+        try:
+            for shard in shards.tolist():
+                frag = view.create_fragment_if_not_exists(int(shard))
+                for r in range(depth + 2):
+                    n_bits = int(counts[shard][r])
+                    if n_bits == 0:
+                        continue  # empty plane: skip the copy + lock trip
+                    # Per-shard plane order: exists, sign, magnitude
+                    # planes (BSI row ids 0, 1, 2+i — fragment.go:87-93).
+                    row_id = r if r < 2 else BSI_OFFSET_BIT + (r - 2)
+                    assert BSI_SIGN_BIT == 1
+                    row = (blocks[shard][r] if adopt
+                           else blocks[shard][r].copy())
+                    frag.merge_row_words(row_id, row, bit_count=n_bits,
+                                         bump_epoch=False,
+                                         prefer_dense=pinned)
+                    merged_any = True
+        finally:
+            # ONE shared-epoch bump for the whole batch (cache
+            # invalidation + dirty broadcast), not one per landed plane
+            # row — including the partial-failure exit, where merged
+            # rows would otherwise be served stale from epoch-stamped
+            # caches.
+            if merged_any:
+                self.index_epoch_bump()
         return True
 
     def index_epoch_bump(self) -> None:

@@ -274,6 +274,26 @@ class API:
         return {"shardWidth": SHARD_WIDTH,
                 "version": pilosa_tpu.__version__}
 
+    def fragment_nodes(self, index: str, shard: int) -> list[dict]:
+        """Nodes owning (index, shard) under the current ring —
+        reference GET /internal/fragment/nodes (http/handler.go:1290
+        handleGetFragmentNodes): clients use it to route direct
+        fragment reads/writes."""
+        if self.cluster is None:
+            return [{"id": "standalone", "uri": {}, "isCoordinator": True}]
+        return [n.to_json() for n in self.cluster.shard_nodes(index, shard)]
+
+    def delete_available_shard(self, index: str, field: str,
+                               shard: int) -> None:
+        """Reference api.DeleteAvailableShard (api.go; DELETE
+        /internal/index/{i}/field/{f}/remote-available-shards/{s})."""
+        idx = self.holder.index_or_raise(index)
+        f = idx.field(field)
+        if f is None:
+            from pilosa_tpu.errors import FieldNotFoundError
+            raise FieldNotFoundError(field)
+        f.remove_remote_available_shard(shard)
+
     def max_shards(self) -> dict:
         return {name: max(self.holder.index(name).available_shards())
                 for name in self.holder.index_names()}

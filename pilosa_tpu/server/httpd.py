@@ -442,6 +442,18 @@ def _build_routes(api: API):
     def get_nodes(pv, params, body):
         return 200, api.hosts()
 
+    def get_fragment_nodes(pv, params, body):
+        index = params.get("index")
+        shard = params.get("shard")
+        if index is None or shard is None:
+            return 400, {"error": "index and shard params required"}
+        return 200, api.fragment_nodes(index, int(shard))
+
+    def delete_remote_available_shard(pv, params, body):
+        api.delete_available_shard(pv["index"], pv["field"],
+                                   int(pv["shard"]))
+        return 200, {}
+
     table = [
         (r"/", {"GET": home}),
         (r"/index", {"GET": get_indexes}),
@@ -471,6 +483,10 @@ def _build_routes(api: API):
         (r"/internal/cluster/message", {"POST": post_cluster_message}),
         (r"/internal/fragment/blocks", {"GET": get_fragment_blocks}),
         (r"/internal/fragment/data", {"GET": get_fragment_data}),
+        (r"/internal/fragment/nodes", {"GET": get_fragment_nodes}),
+        (r"/internal/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)"
+         r"/remote-available-shards/(?P<shard>[0-9]+)",
+         {"DELETE": delete_remote_available_shard}),
         (r"/cluster/resize/abort", {"POST": post_resize_abort}),
         (r"/cluster/resize/remove-node", {"POST": post_resize_remove_node}),
         (r"/cluster/resize/set-coordinator", {"POST": post_set_coordinator}),

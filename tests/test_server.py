@@ -142,6 +142,27 @@ def test_query_column_attrs(node):
     assert resp["columnAttrs"] == [{"id": 7, "attrs": {"name": "x"}}]
 
 
+def test_fragment_nodes_and_remote_available_shard_delete(node):
+    """GET /internal/fragment/nodes (reference handleGetFragmentNodes)
+    and DELETE .../remote-available-shards/{shard} (reference
+    api.DeleteAvailableShard)."""
+    b = node.address
+    req(b, "POST", "/index/fn", "{}")
+    req(b, "POST", "/index/fn/field/f", "{}")
+    status, nodes = req(b, "GET", "/internal/fragment/nodes?index=fn&shard=0")
+    assert status == 200 and len(nodes) == 1
+    status, _ = req(b, "GET", "/internal/fragment/nodes")
+    assert status == 400
+    # Seed a remote shard, then forget it over HTTP.
+    f = node.holder.index("fn").field("f")
+    f.add_remote_available_shards([7])
+    assert 7 in f.available_shards()
+    status, _ = req(b, "DELETE",
+                    "/internal/index/fn/field/f/remote-available-shards/7")
+    assert status == 200
+    assert 7 not in f.available_shards()
+
+
 def test_import_rejects_unknown_payload_shape(node):
     """A typo'd import body (wrong key names) must 400, not silently
     import nothing — the reference's proto unmarshal rejects unknown
