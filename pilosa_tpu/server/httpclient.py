@@ -57,12 +57,13 @@ class HTTPInternalClient:
 
     def _request_raw(self, node: Node, method: str, path: str,
                      body: bytes | None = None,
-                     accept: str | None = None) -> tuple[bytes, str]:
+                     accept: str | None = None,
+                     content_type: str = "application/json") -> tuple[bytes, str]:
         """Returns (body, content-type)."""
         req = urllib.request.Request(self._url(node, path), data=body,
                                      method=method)
         if body is not None:
-            req.add_header("Content-Type", "application/json")
+            req.add_header("Content-Type", content_type)
         if accept is not None:
             req.add_header("Accept", accept)
         from pilosa_tpu.obs.tracing import inject_http_headers
@@ -83,9 +84,36 @@ class HTTPInternalClient:
             raise ConnectionError(f"node {node.id} unreachable: {e}") from e
 
     def _request(self, node: Node, method: str, path: str,
-                 body: bytes | None = None) -> Any:
-        data, _ = self._request_raw(node, method, path, body)
+                 body: bytes | None = None,
+                 content_type: str = "application/json") -> Any:
+        data, _ = self._request_raw(node, method, path, body,
+                                    content_type=content_type)
         return json.loads(data) if data else {}
+
+    def _post_import(self, node: Node, req: dict,
+                     json_only: bool = False) -> None:
+        """POST /internal/import, binary frames first (wire
+        .encode_import: raw arrays, ~µs to produce vs a Python json
+        walk of millions of ints), falling back to the JSON body once
+        if the peer rejects the frame — a not-yet-upgraded node in a
+        mixed-version cluster 400s on the magic, and a replicated
+        write must not be lost to a rolling upgrade (imports are
+        idempotent, so the retry is safe)."""
+        if not json_only:
+            from pilosa_tpu.server import wire
+            try:
+                self._request(node, "POST", "/internal/import",
+                              wire.encode_import(req),
+                              content_type="application/octet-stream")
+                return
+            except RuntimeError:
+                pass  # peer alive but rejected the frame: retry as JSON
+        body = dict(req)
+        for k in ("rowIDs", "columnIDs", "values"):
+            if body.get(k) is not None:
+                body[k] = np.asarray(body[k]).tolist()
+        self._request(node, "POST", "/internal/import",
+                      json.dumps(body).encode())
 
     # -- InternalClient protocol -------------------------------------------
 
@@ -131,21 +159,23 @@ class HTTPInternalClient:
 
     def import_bits(self, node, index, field, view, shard, rows, cols,
                     clear=False):
-        body = json.dumps({
+        self._post_import(node, {
             "kind": "fragment", "index": index, "field": field,
-            "view": view, "shard": shard, "rowIDs": list(rows),
-            "columnIDs": list(cols), "clear": clear,
-        }).encode()
-        self._request(node, "POST", "/internal/import", body)
+            "view": view, "shard": shard, "rowIDs": rows,
+            "columnIDs": cols, "clear": clear,
+        })
 
     def send_import(self, node, index, field, shard, rows=None, cols=None,
                     values=None, timestamps=None, clear=False):
-        body = json.dumps({
-            "kind": "field", "index": index, "field": field, "shard": shard,
-            "rowIDs": rows, "columnIDs": list(cols or []),
-            "values": values, "timestamps": timestamps, "clear": clear,
-        }).encode()
-        self._request(node, "POST", "/internal/import", body)
+        req = {"kind": "field", "index": index, "field": field,
+               "shard": shard, "rowIDs": rows,
+               "columnIDs": cols if cols is not None else [],
+               "values": values, "clear": clear}
+        if timestamps is not None:
+            # Per-element None sentinels don't fit a raw array; time
+            # imports keep the JSON body.
+            req["timestamps"] = timestamps
+        self._post_import(node, req, json_only=timestamps is not None)
 
     def send_message(self, node: Node, message: dict):
         self._request(node, "POST", "/internal/cluster/message",

@@ -432,7 +432,14 @@ def _build_routes(api: API):
         return 200, {"attrs": {str(i): a for i, a in data.items()}}
 
     def post_internal_import(pv, params, body):
-        req = jbody(body)
+        from pilosa_tpu.server import wire
+
+        # Binary import frames (wire.encode_import) or legacy JSON —
+        # sniffed by magic so mixed-version clusters interoperate.
+        if wire.is_import_frame(body):
+            req = wire.decode_import(body)
+        else:
+            req = jbody(body)
         server = getattr(api, "import_handler", None)
         if server is None:
             return 400, {"error": "no import handler"}

@@ -497,6 +497,92 @@ def test_wire_frames_roundtrip_and_size():
     assert back[2].id == 7 and back[2].count == 9
 
 
+def test_import_frames_roundtrip_and_size():
+    """Binary import bodies (VERDICT r4 #6, second half): a forwarded
+    1M-bit single-row import encodes as raw arrays (rowIDs collapsed to
+    a constant) much smaller than the JSON int-list body, decodes to
+    identical values, and the handler sniffs binary vs JSON by magic."""
+    import json as _json
+
+    import numpy as np
+
+    from pilosa_tpu.server import wire
+
+    rng = np.random.default_rng(6)
+    cols = rng.integers(0, 4_000_000, 1_000_000, dtype=np.uint64)
+    rows = np.full(len(cols), 3, dtype=np.uint64)
+    req = {"kind": "fragment", "index": "i", "field": "f",
+           "view": "standard", "shard": 0, "rowIDs": rows,
+           "columnIDs": cols, "clear": False}
+
+    body = wire.encode_import(req)
+    as_json = _json.dumps({**req, "rowIDs": rows.tolist(),
+                           "columnIDs": cols.tolist()}).encode()
+    # Raw u64 cols ~8 B/value vs JSON ~8-9 digits + comma; the constant
+    # rowIDs vanish entirely.
+    assert len(as_json) >= 2 * len(body), (len(as_json), len(body))
+
+    assert wire.is_import_frame(body)
+    assert not wire.is_import_frame(as_json)
+    back = wire.decode_import(body)
+    np.testing.assert_array_equal(back["columnIDs"], cols)
+    np.testing.assert_array_equal(back["rowIDs"], rows)
+    assert back["kind"] == "fragment" and back["view"] == "standard"
+    assert back["shard"] == 0 and back["clear"] is False
+
+    # Multi-row + BSI values variant keeps real arrays.
+    req2 = {"kind": "field", "index": "i", "field": "v", "shard": 1,
+            "rowIDs": None, "columnIDs": cols[:10],
+            "values": np.arange(10, dtype=np.int64) - 5, "clear": False}
+    back2 = wire.decode_import(wire.encode_import(req2))
+    np.testing.assert_array_equal(back2["values"],
+                                  np.arange(10, dtype=np.int64) - 5)
+
+
+def test_malformed_import_frame_raises_valueerror():
+    """Truncated/garbage frames must map to 400 (ValueError), not 500."""
+    from pilosa_tpu.server import wire
+
+    for bad in (b"PTI1", b"PTI1\xff\xff\xff\xff", b"PTI1\x04\x00\x00\x00{}",
+                wire.encode_import({"kind": "fragment", "rowIDs": [1],
+                                    "columnIDs": [2]})[:-1]):
+        with pytest.raises(ValueError):
+            wire.decode_import(bad)
+
+
+def test_import_falls_back_to_json_when_frame_rejected():
+    """Mixed-version interop: a peer that 400s the binary frame (an
+    old node) gets the same import as JSON; a dead peer does NOT
+    trigger the fallback (ConnectionError propagates for failover)."""
+    from pilosa_tpu.server.httpclient import HTTPInternalClient
+
+    client = HTTPInternalClient()
+    calls = []
+
+    def fake_request(node, method, path, body=None,
+                     content_type="application/json"):
+        calls.append((content_type, body))
+        if content_type == "application/octet-stream":
+            raise RuntimeError("node x HTTP 400: bad magic")
+        return {}
+
+    client._request = fake_request
+    client.import_bits(None, "i", "f", "standard", 0, [1, 1], [3, 9])
+    assert len(calls) == 2
+    assert calls[0][0] == "application/octet-stream"
+    assert calls[1][0] == "application/json"
+    body = json.loads(calls[1][1])
+    assert body["rowIDs"] == [1, 1] and body["columnIDs"] == [3, 9]
+
+    def dead_request(node, method, path, body=None,
+                     content_type="application/json"):
+        raise ConnectionError("unreachable")
+
+    client._request = dead_request
+    with pytest.raises(ConnectionError):
+        client.import_bits(None, "i", "f", "standard", 0, [1], [3])
+
+
 def test_distributed_row_uses_roaring_frames(tmp_path):
     """End-to-end: a distributed Row() over a 1M-bit remote fragment
     travels as roaring frames over real HTTP."""
