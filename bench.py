@@ -248,8 +248,12 @@ def bench_star_trace(extra):
     # so each ratio cancels the drift that a ratio-of-medians (or r3's
     # fully sequential measurement, which shipped a phantom 0.31x "gap")
     # soaks up. Within-pair order alternates to kill the residual bias.
+    # Full-size blocks: throughput scales with in-flight depth on this
+    # link (64-query bursts deliver ~½ of 256-query bursts — the wave
+    # pipeline amortizes the round-trip over everything in flight), so
+    # undersized blocks would understate both sides.
     ex_qps, kern_qps, ratios = [], [], []
-    block = max(32, N_QUERIES // 4)
+    block = N_QUERIES
     for i in range(8):
         if i % 2:
             k = run_kernel_block(block)

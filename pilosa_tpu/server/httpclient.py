@@ -17,6 +17,16 @@ import numpy as np
 from pilosa_tpu.cluster.node import Node
 
 
+class NodeHTTPError(RuntimeError):
+    """A live peer rejected the request (HTTP status attached). Stays a
+    RuntimeError so existing 'alive but refused' handling keeps working;
+    failover paths must keep catching ConnectionError only."""
+
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+
+
 class HTTPInternalClient:
     """Implements the InternalClient protocol against peer HTTP servers."""
 
@@ -79,7 +89,9 @@ class HTTPInternalClient:
             detail = e.read().decode(errors="replace")
             if e.code == 404:
                 raise LookupError(f"{node.id}: {detail}") from e
-            raise RuntimeError(f"node {node.id} HTTP {e.code}: {detail}") from e
+            raise NodeHTTPError(e.code,
+                                f"node {node.id} HTTP {e.code}: {detail}") \
+                from e
         except (urllib.error.URLError, OSError) as e:
             raise ConnectionError(f"node {node.id} unreachable: {e}") from e
 
@@ -106,8 +118,14 @@ class HTTPInternalClient:
                               wire.encode_import(req),
                               content_type="application/octet-stream")
                 return
-            except RuntimeError:
-                pass  # peer alive but rejected the frame: retry as JSON
+            except NodeHTTPError as e:
+                # Only a 400 can mean "peer doesn't speak the frame
+                # format" (an old node's JSON parse fails before any
+                # application logic). A 5xx may have PARTIALLY applied —
+                # re-sending silently would double-apply clears — and
+                # carries no hope that a different encoding succeeds.
+                if e.code != 400:
+                    raise
         body = dict(req)
         for k in ("rowIDs", "columnIDs", "values"):
             if body.get(k) is not None:

@@ -559,11 +559,13 @@ def test_import_falls_back_to_json_when_frame_rejected():
     client = HTTPInternalClient()
     calls = []
 
+    from pilosa_tpu.server.httpclient import NodeHTTPError
+
     def fake_request(node, method, path, body=None,
                      content_type="application/json"):
         calls.append((content_type, body))
         if content_type == "application/octet-stream":
-            raise RuntimeError("node x HTTP 400: bad magic")
+            raise NodeHTTPError(400, "node x HTTP 400: bad magic")
         return {}
 
     client._request = fake_request
@@ -581,6 +583,20 @@ def test_import_falls_back_to_json_when_frame_rejected():
     client._request = dead_request
     with pytest.raises(ConnectionError):
         client.import_bits(None, "i", "f", "standard", 0, [1], [3])
+
+    # A 5xx (peer understood the frame; the import itself blew up, and
+    # may be partially applied) must NOT trigger a silent JSON re-send.
+    calls.clear()
+
+    def flaky_request(node, method, path, body=None,
+                      content_type="application/json"):
+        calls.append(content_type)
+        raise NodeHTTPError(500, "node x HTTP 500: boom")
+
+    client._request = flaky_request
+    with pytest.raises(NodeHTTPError):
+        client.import_bits(None, "i", "f", "standard", 0, [1], [3])
+    assert calls == ["application/octet-stream"]
 
 
 def test_distributed_row_uses_roaring_frames(tmp_path):
