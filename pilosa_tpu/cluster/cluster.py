@@ -176,6 +176,12 @@ class Cluster:
     def _update_state(self) -> None:
         """cluster.go:571-582: tolerate < replicaN losses (DEGRADED);
         beyond that, data is unavailable (STARTING)."""
+        if self.state == STATE_RESIZING:
+            # The resize job owns this state: a liveness sweep landing
+            # mid-job must not flip the cluster back to NORMAL (which
+            # would reopen the API gate while fragments are moving).
+            # Commit/abort restore the steady state explicitly.
+            return
         down = sum(1 for n in self.nodes if n.state == "DOWN")
         if down == 0:
             self.state = STATE_NORMAL

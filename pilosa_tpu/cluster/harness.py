@@ -99,6 +99,9 @@ class ClusterNode:
                                  availability=message.get("availability"),
                                  version=message.get("version"))
             clean_holder(self.holder, self.cluster)
+        elif t == "cluster-state":
+            from pilosa_tpu.cluster.resize import apply_cluster_state
+            apply_cluster_state(self.cluster, message["state"])
         else:
             handle_cluster_message(self.holder, message)
 

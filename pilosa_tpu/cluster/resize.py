@@ -202,6 +202,11 @@ def apply_cluster_status(cluster: Cluster, nodes_json: list[dict],
                                    key=lambda n: n.id)
             if version is not None:
                 cluster.topology_version = int(version)
+            if cluster.state == STATE_RESIZING:
+                # The commit broadcast ends the resize on every peer:
+                # clear RESIZING so the recompute below can run (the
+                # _update_state guard defers to the resize owner).
+                cluster.set_state(STATE_NORMAL)
             cluster._update_state()
     if holder is not None and availability:
         for index, fields in availability.items():
@@ -212,6 +217,18 @@ def apply_cluster_status(cluster: Cluster, nodes_json: list[dict],
                 f = idx.field(field)
                 if f is not None:
                     f.add_remote_available_shards(shards)
+
+
+def apply_cluster_state(cluster: Cluster, state: str) -> None:
+    """Peer half of ResizeJob._broadcast_state: adopt a coordinator-
+    announced state transition. Entering RESIZING closes this node's API
+    gate; leaving it recomputes the steady state from node liveness."""
+    if state == STATE_RESIZING:
+        cluster.set_state(STATE_RESIZING)
+    else:
+        if cluster.state == STATE_RESIZING:
+            cluster.set_state(state)
+        cluster._update_state()
 
 
 def holder_availability(holder) -> dict:
@@ -288,6 +305,15 @@ class ResizeJob:
                            replica_n=self.cluster.replica_n,
                            partition_n=self.cluster.partition_n)
         self.cluster.set_state(STATE_RESIZING)
+        # The RESIZING state must reach EVERY node (old and new ring),
+        # not just the coordinator: each node's API gate refuses
+        # queries/imports/schema changes while fragments move, so a
+        # write can't land through a peer on a ring position the
+        # committed topology (and the holder GC) won't honor. Reference:
+        # setStateAndBroadcast(ClusterStateResizing), cluster.go:1470.
+        self._broadcast_state(STATE_RESIZING,
+                              {n.id: n for v in (old_view, new_view)
+                               for n in v.nodes}.values())
         # Per-target completion tracking (reference
         # ResizeInstructionComplete + per-node map, cluster.go:1315,
         # :1413-1438): the new topology is committed ONLY after every
@@ -397,7 +423,33 @@ class ResizeJob:
             with _JOBS_LOCK:
                 _JOBS.pop(self.job_id, None)
             if self.cluster.state == STATE_RESIZING:
+                # Non-commit exit (FAILED/ABORTED/exception): reopen the
+                # gate everywhere. set_state first (clears RESIZING so
+                # _update_state's guard disengages), then RECOMPUTE from
+                # node liveness — a peer that died mid-job must yield
+                # DEGRADED/STARTING here, not a blind NORMAL.
                 self.cluster.set_state(STATE_NORMAL)
+                self.cluster._update_state()
+                # Union of surviving ring + attempted targets: a FAILED
+                # join must reopen the joiner's gate too, even though it
+                # never made it into the committed ring.
+                self._broadcast_state(
+                    STATE_NORMAL,
+                    {n.id: n for n in
+                     list(self.cluster.nodes) + list(new_nodes)}.values())
+
+    def _broadcast_state(self, state: str, nodes) -> None:
+        """Push a cluster-state transition to peers (best-effort: an
+        unreachable peer is either dead — its gate is moot — or will
+        learn the steady state from the commit broadcast / sweeps)."""
+        msg = {"type": "cluster-state", "state": state}
+        for node in nodes:
+            if node.id == self.cluster.local_id:
+                continue
+            try:
+                self.client.send_message(node, msg)
+            except (ConnectionError, RuntimeError, LookupError):
+                pass
 
 
 def check_nodes(cluster: Cluster, client, retries: int = 2,

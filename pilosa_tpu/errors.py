@@ -67,6 +67,13 @@ class InvalidTimeQuantumError(PilosaError):
     message = "invalid time quantum"
 
 
+class ApiMethodNotAllowedError(PilosaError):
+    """Reference newAPIMethodNotAllowedError (api.go:124): the cluster's
+    state (STARTING / RESIZING) refuses this operation right now."""
+
+    message = "api method not allowed"
+
+
 class NameError_(PilosaError):
     message = "invalid name"
 

@@ -11,6 +11,11 @@ from __future__ import annotations
 import io
 from typing import Any, Iterable
 
+from pilosa_tpu.cluster.cluster import (
+    STATE_DEGRADED,
+    STATE_NORMAL,
+    STATE_RESIZING,
+)
 from pilosa_tpu.config import SHARD_WIDTH
 from pilosa_tpu.core import timequantum as tq
 from pilosa_tpu.core.field import FieldOptions
@@ -18,6 +23,7 @@ from pilosa_tpu.core.holder import Holder
 from pilosa_tpu.core.index import IndexOptions
 from pilosa_tpu.core.row import Row
 from pilosa_tpu.errors import (
+    ApiMethodNotAllowedError,
     FieldNotFoundError,
     FragmentNotFoundError,
     IndexNotFoundError,
@@ -40,6 +46,30 @@ class API:
         #: (ClusterKeyTranslator); None = allocate locally.
         self.translator = None
 
+    #: method-availability matrix per cluster state (reference
+    #: api.go:99-105 validAPIMethods + :1379-1411 method sets): during
+    #: STARTING only control-plane traffic flows; during RESIZING only
+    #: control plane + fragment streaming + abort. Everything else —
+    #: queries, imports, schema changes — is refused so a write can't
+    #: land on a ring position the committed topology (and the holder
+    #: GC) won't honor.
+    _METHODS_RESIZING = frozenset({"fragment-data", "resize-abort"})
+
+    def _validate(self, method: str) -> None:
+        if self.cluster is None:
+            return  # standalone node: always NORMAL
+        state = self.cluster.state
+        if state in (STATE_NORMAL, STATE_DEGRADED):
+            return
+        if state == STATE_RESIZING and method in self._METHODS_RESIZING:
+            return
+        raise ApiMethodNotAllowedError(
+            f"api method {method} not allowed in state {state}")
+
+    #: public alias for route handlers that serve holder state directly
+    #: (fragment streaming) rather than through an API method.
+    validate_method = _validate
+
     def _xlate_keys(self, idx, f, keys: Iterable[str]) -> list[int]:
         keys = list(keys)
         if self.translator is not None:
@@ -59,6 +89,7 @@ class API:
         ({"results": [...]} shape, handler.go:60-75) — or, for remote
         calls whose peer accepts them, binary frames (bytes) carrying
         Row results as roaring blobs (wire.encode_frames)."""
+        self._validate("query")
         opt = ExecOptions(remote=remote, column_attrs=column_attrs,
                           exclude_row_attrs=exclude_row_attrs,
                           exclude_columns=exclude_columns)
@@ -94,6 +125,7 @@ class API:
     # -- schema CRUD (api.go:162-467) --------------------------------------
 
     def create_index(self, name: str, options: dict | None = None):
+        self._validate("create-index")
         idx = self.holder.create_index(
             name, IndexOptions.from_json(options or {}))
         self._broadcast({"type": "create-index", "index": name,
@@ -101,11 +133,13 @@ class API:
         return idx
 
     def delete_index(self, name: str) -> None:
+        self._validate("delete-index")
         self.holder.delete_index(name)
         self._broadcast({"type": "delete-index", "index": name})
 
     def create_field(self, index: str, field: str,
                      options: dict | None = None):
+        self._validate("create-field")
         idx = self.holder.index_or_raise(index)
         f = idx.create_field(field, FieldOptions.from_json(options or {}))
         self._broadcast({"type": "create-field", "index": index,
@@ -113,6 +147,7 @@ class API:
         return f
 
     def delete_field(self, index: str, field: str) -> None:
+        self._validate("delete-field")
         idx = self.holder.index_or_raise(index)
         idx.delete_field(field)
         self._broadcast({"type": "delete-field", "index": index,
@@ -122,6 +157,7 @@ class API:
         return self.holder.schema()
 
     def apply_schema(self, schema: list[dict]) -> None:
+        self._validate("apply-schema")
         self.holder.apply_schema(schema)
 
     def index_info(self, index: str) -> dict:
@@ -137,6 +173,7 @@ class API:
                     clear: bool = False) -> None:
         """Batch bit import with key translation; routes each shard's
         batch to owning nodes when clustered."""
+        self._validate("import")
         idx = self.holder.index_or_raise(index)
         f = idx.field(field)
         if f is None:
@@ -161,6 +198,7 @@ class API:
                       column_ids: Iterable[int], values: Iterable[int],
                       column_keys: Iterable[str] | None = None,
                       clear: bool = False) -> None:
+        self._validate("import-value")
         idx = self.holder.index_or_raise(index)
         f = idx.field(field)
         if f is None:
@@ -211,6 +249,7 @@ class API:
     def import_roaring(self, index: str, field: str, shard: int,
                        data: bytes, clear: bool = False) -> None:
         """Reference API.ImportRoaring (api.go:368)."""
+        self._validate("import-roaring")
         idx = self.holder.index_or_raise(index)
         f = idx.field(field)
         if f is None:
@@ -229,6 +268,7 @@ class API:
 
     def export_csv(self, index: str, field: str, shard: int) -> str:
         """CSV of row,col (or keys) for one shard (reference exportShard)."""
+        self._validate("export-csv")
         idx = self.holder.index_or_raise(index)
         f = idx.field(field)
         if f is None:
@@ -287,6 +327,7 @@ class API:
                                shard: int) -> None:
         """Reference api.DeleteAvailableShard (api.go; DELETE
         /internal/index/{i}/field/{f}/remote-available-shards/{s})."""
+        self._validate("delete-available-shard")
         idx = self.holder.index_or_raise(index)
         f = idx.field(field)
         if f is None:
@@ -304,6 +345,7 @@ class API:
         cluster translator (coordinator allocates; on the coordinator
         itself this is a local allocation, so the internal RPC
         terminates here — no forwarding loop)."""
+        self._validate("translate-keys")
         idx = self.holder.index_or_raise(index)
         f = None
         if field:
@@ -327,6 +369,7 @@ class API:
     def recalculate_caches(self) -> None:
         """Row counts are maintained exactly; nothing to rebuild. Kept for
         route parity (api.go RecalculateCaches)."""
+        self._validate("recalculate-caches")
 
     # -- internals ---------------------------------------------------------
 

@@ -17,6 +17,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from pilosa_tpu.errors import (
+    ApiMethodNotAllowedError,
     FieldExistsError,
     FieldNotFoundError,
     FragmentNotFoundError,
@@ -138,6 +139,11 @@ def _make_handler(api: API):
                     status, payload = 409, {"error": str(e)}
                 except _NOT_FOUND as e:
                     status, payload = 404, {"error": str(e)}
+                except ApiMethodNotAllowedError as e:
+                    # 405, NOT 400: import clients treat a 400 as "peer
+                    # doesn't speak the binary frame format" and re-send
+                    # as JSON — a state-gated refusal must stay distinct.
+                    status, payload = 405, {"error": str(e)}
                 except (QueryError, ParseError, ValueError, PilosaError) as e:
                     status, payload = 400, {"error": str(e)}
                 except Exception as e:  # pragma: no cover
@@ -274,7 +280,7 @@ def _build_routes(api: API):
                 exclude_columns=params.get("excludeColumns") == "true",
                 remote=remote, accept_frames=frames,
                 cache=params.get("noCache") != "true")
-        except _NOT_FOUND:
+        except _NOT_FOUND + (ApiMethodNotAllowedError,):
             raise
         except (QueryError, ParseError, PilosaError, ValueError) as e:
             return 400, {"error": str(e)}
@@ -377,6 +383,9 @@ def _build_routes(api: API):
         return 200, {}
 
     def get_fragment_data(pv, params, body):
+        # Allowed during RESIZING (the resize streams fragments through
+        # this route, reference methodsResizing api.go:1384).
+        api.validate_method("fragment-data")
         frag = api.holder.fragment(params["index"], params["field"],
                                    params["view"], int(params["shard"]))
         if frag is None:

@@ -393,6 +393,9 @@ class ServerNode:
             # (holderCleaner, holder.go:1126) off the RPC thread.
             threading.Thread(target=self.clean_holder,
                              name="holder-cleaner", daemon=True).start()
+        elif t == "cluster-state" and self.cluster is not None:
+            from pilosa_tpu.cluster.resize import apply_cluster_state
+            apply_cluster_state(self.cluster, message["state"])
         elif t == "node-join" and self.cluster is not None:
             self.handle_join(message["addr"])
         else:

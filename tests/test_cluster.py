@@ -314,3 +314,93 @@ def test_dirty_broadcast_coalesces():
     lc[0].dirty.flush_now()
     # 200 writes in well under a window: first flush + trailing ones.
     assert len(sent) <= 8, len(sent)
+
+
+def test_api_gated_by_cluster_state():
+    """Reference api.go:99-125 validAPIMethods: queries, imports, and
+    schema changes are refused while the cluster is RESIZING (a write
+    accepted mid-resize could land on a ring position the committed
+    topology and the holder GC won't honor) and while STARTING."""
+    import pytest
+
+    from pilosa_tpu.cluster import STATE_NORMAL, STATE_RESIZING, STATE_STARTING
+    from pilosa_tpu.cluster.harness import LocalCluster
+    from pilosa_tpu.errors import ApiMethodNotAllowedError
+    from pilosa_tpu.server.api import API
+
+    lc = LocalCluster(2)
+    a = lc[0]
+    api = API(a.holder, a.executor, cluster=a.cluster)
+    api.create_index("gate")
+    api.create_field("gate", "f")
+
+    for state in (STATE_RESIZING, STATE_STARTING):
+        a.cluster.set_state(state)
+        for blocked in (
+                lambda: api.query("gate", "Count(Row(f=1))"),
+                lambda: api.create_index("gate2"),
+                lambda: api.delete_index("gate"),
+                lambda: api.create_field("gate", "g"),
+                lambda: api.import_bits("gate", "f", [1], [2]),
+                lambda: api.apply_schema([]),
+        ):
+            with pytest.raises(ApiMethodNotAllowedError):
+                blocked()
+        # Reads of cluster metadata stay up (operators must see status).
+        assert api.status()["state"] == state
+        api.schema()
+
+    a.cluster.set_state(STATE_NORMAL)
+    api.query("gate", "Count(Row(f=1))")  # flows again
+
+
+def test_liveness_sweep_cannot_reopen_resizing_gate():
+    """A check_nodes sweep landing mid-resize must not flip the state
+    back to NORMAL (reopening the API gate while fragments move); the
+    resize job restores the steady state itself on commit/abort."""
+    from pilosa_tpu.cluster import STATE_RESIZING
+    from pilosa_tpu.cluster.harness import LocalCluster
+    from pilosa_tpu.cluster.resize import check_nodes
+
+    lc = LocalCluster(3)
+    a = lc[0]
+    a.cluster.set_state(STATE_RESIZING)
+    check_nodes(a.cluster, lc.client)
+    assert a.cluster.state == STATE_RESIZING
+
+
+def test_resize_state_broadcast_closes_peer_gates():
+    """The RESIZING state reaches every node, not just the coordinator:
+    a peer's API must refuse writes mid-resize too (a write accepted
+    through a peer could land on a ring position the committed topology
+    and holder GC won't honor), and the commit broadcast reopens it."""
+    import pytest
+
+    from pilosa_tpu.cluster import STATE_NORMAL, STATE_RESIZING
+    from pilosa_tpu.cluster.harness import LocalCluster
+    from pilosa_tpu.errors import ApiMethodNotAllowedError
+    from pilosa_tpu.server.api import API
+
+    lc = LocalCluster(3)
+    coord, peer = lc[0], lc[1]
+    peer_api = API(peer.holder, peer.executor, cluster=peer.cluster)
+    peer_api.create_index("rs")
+
+    # Coordinator announces the transition (ResizeJob._broadcast_state).
+    msg = {"type": "cluster-state", "state": STATE_RESIZING}
+    for n in coord.cluster.nodes:
+        if n.id != coord.id:
+            lc.client.send_message(n, msg)
+    assert peer.cluster.state == STATE_RESIZING
+    with pytest.raises(ApiMethodNotAllowedError):
+        peer_api.import_bits("rs", "f", [1], [2])
+
+    # Commit broadcast (cluster-status) ends the resize on the peer.
+    status = {"type": "cluster-status",
+              "nodes": [n.to_json() for n in coord.cluster.nodes],
+              "version": coord.cluster.topology_version + 1}
+    for n in coord.cluster.nodes:
+        if n.id != coord.id:
+            lc.client.send_message(n, status)
+    assert peer.cluster.state == STATE_NORMAL
+    peer_api.create_field("rs", "f")  # flows again
