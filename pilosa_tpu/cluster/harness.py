@@ -44,6 +44,12 @@ def handle_cluster_message(holder: Holder, message: dict) -> None:
         idx = holder.index(message["index"])
         if idx is not None and idx.field(message["field"]) is not None:
             idx.delete_field(message["field"])
+    elif t == "delete-view":
+        f = holder.field(message["index"], message["field"])
+        if f is not None:
+            # DeleteViewMessage (server.go:618): drop our copy of the
+            # view; missing is fine — views don't exist on every node.
+            f.delete_view(message["view"])
 
 
 class ClusterNode:
@@ -175,7 +181,8 @@ class ClusterNode:
 
     def handle_nodes(self):
         return {"version": self.cluster.topology_version,
-                "nodes": [n.to_json() for n in self.cluster.nodes]}
+                "nodes": [n.to_json() for n in self.cluster.nodes],
+                "state": self.cluster.state}
 
     def apply_schema(self, schema) -> None:
         self.holder.apply_schema(schema)

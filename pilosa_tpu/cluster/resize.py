@@ -502,4 +502,36 @@ def check_nodes(cluster: Cluster, client, retries: int = 2,
             cluster._emit(EVENT_UPDATE, live.id, "DOWN")
     if changed:
         cluster._update_state()
+    _recover_stuck_resizing(cluster, client)
     return changed
+
+
+def _recover_stuck_resizing(cluster: Cluster, client) -> None:
+    """A non-coordinator stuck in RESIZING self-heals here: a removed
+    node never receives the commit broadcast (it isn't in the new
+    ring), and a coordinator crash mid-job kills the only thread that
+    would have restored the state. The coordinator's own view is
+    authoritative: if it reports any steady state — or is dead — the
+    resize no longer exists and the gate must reopen."""
+    if cluster.state != STATE_RESIZING:
+        return
+    local = cluster.node_by_id(cluster.local_id)
+    if local is not None and local.is_coordinator:
+        return  # the local ResizeJob owns this state
+    coord = next((n for n in cluster.nodes
+                  if n.is_coordinator and n.id != cluster.local_id), None)
+    over = False
+    if coord is None or coord.state == "DOWN":
+        over = True  # no live resize authority: the job died with it
+    else:
+        try:
+            resp = client.nodes(coord)
+            if isinstance(resp, dict):
+                over = (resp.get("state") is not None
+                        and resp["state"] != STATE_RESIZING)
+        except (ConnectionError, RuntimeError, LookupError,
+                AttributeError):
+            over = False  # transient: the DOWN path above is the backstop
+    if over:
+        cluster.set_state(STATE_NORMAL)
+        cluster._update_state()

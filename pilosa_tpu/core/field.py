@@ -253,6 +253,24 @@ class Field:
     def view_names(self) -> list[str]:
         return sorted(self.views)
 
+    def delete_view(self, name: str) -> list[int]:
+        """Drop one view and its fragments (reference Field.deleteView,
+        field.go:889; API.DeleteView api.go:779 — operator cleanup of
+        e.g. stale time views). Returns the shards the view held so the
+        caller can unlink their on-disk files; missing views are a
+        no-op (views don't exist on every node under shard
+        distribution, api.go:797)."""
+        with self._lock:
+            v = self.views.pop(name, None)
+            if v is None:
+                return []
+            shards = sorted(v.fragments)
+        if self.epoch is not None:
+            self.epoch.bump()
+        if self.schema_epoch is not None:
+            self.schema_epoch.bump()
+        return shards
+
     def create_view_if_not_exists(self, name: str) -> View:
         with self._lock:
             v = self.views.get(name)

@@ -42,6 +42,9 @@ class API:
         self.executor = executor
         self.cluster = cluster
         self.syncer = syncer
+        #: DiskStore (set by ServerNode when a data dir is configured)
+        #: so view/field deletions can unlink their on-disk fragments.
+        self.store = None
         #: cluster key-allocation hook: (index, field|None, keys) -> ids
         #: (ClusterKeyTranslator); None = allocate locally.
         self.translator = None
@@ -135,6 +138,10 @@ class API:
     def delete_index(self, name: str) -> None:
         self._validate("delete-index")
         self.holder.delete_index(name)
+        if self.store is not None:
+            # Unlink the on-disk tree too: recreating the name must not
+            # resurrect deleted data on the next restart.
+            self.store.delete_subtree_files(name)
         self._broadcast({"type": "delete-index", "index": name})
 
     def create_field(self, index: str, field: str,
@@ -150,8 +157,34 @@ class API:
         self._validate("delete-field")
         idx = self.holder.index_or_raise(index)
         idx.delete_field(field)
+        if self.store is not None:
+            self.store.delete_subtree_files(index, field)
         self._broadcast({"type": "delete-field", "index": index,
                          "field": field})
+
+    def views(self, index: str, field: str) -> list[str]:
+        """Reference API.Views (api.go:760)."""
+        f = self._field_or_raise(index, field)
+        return f.view_names()
+
+    def delete_view(self, index: str, field: str, view: str) -> None:
+        """Reference API.DeleteView (api.go:779): drop a view locally
+        and broadcast so every node holding its shards follows
+        (DeleteViewMessage, server.go:618)."""
+        self._validate("delete-view")
+        f = self._field_or_raise(index, field)
+        f.delete_view(view)
+        if self.store is not None:
+            self.store.delete_subtree_files(index, field, view)
+        self._broadcast({"type": "delete-view", "index": index,
+                         "field": field, "view": view})
+
+    def _field_or_raise(self, index: str, field: str):
+        idx = self.holder.index_or_raise(index)
+        f = idx.field(field)
+        if f is None:
+            raise FieldNotFoundError(field)
+        return f
 
     def schema(self) -> list[dict]:
         return self.holder.schema()
@@ -305,9 +338,10 @@ class API:
 
     def hosts(self) -> dict:
         if self.cluster is None:
-            return {"version": 0, "nodes": []}
+            return {"version": 0, "nodes": [], "state": STATE_NORMAL}
         return {"version": self.cluster.topology_version,
-                "nodes": [n.to_json() for n in self.cluster.nodes]}
+                "nodes": [n.to_json() for n in self.cluster.nodes],
+                "state": self.cluster.state}
 
     def info(self) -> dict:
         import pilosa_tpu

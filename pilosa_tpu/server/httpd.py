@@ -458,6 +458,13 @@ def _build_routes(api: API):
     def get_nodes(pv, params, body):
         return 200, api.hosts()
 
+    def get_views(pv, params, body):
+        return 200, {"views": api.views(pv["index"], pv["field"])}
+
+    def delete_view(pv, params, body):
+        api.delete_view(pv["index"], pv["field"], pv["view"])
+        return 200, {}
+
     def get_fragment_nodes(pv, params, body):
         index = params.get("index")
         shard = params.get("shard")
@@ -479,6 +486,11 @@ def _build_routes(api: API):
         (r"/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/import-roaring/"
          r"(?P<shard>[0-9]+)",
          {"POST": post_import_roaring}),
+        (r"/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/views",
+         {"GET": get_views}),
+        (r"/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/view/"
+         r"(?P<view>[^/]+)",
+         {"DELETE": delete_view}),
         (r"/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)",
          {"POST": post_field, "DELETE": delete_field}),
         (r"/index/(?P<index>[^/]+)",

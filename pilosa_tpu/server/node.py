@@ -160,6 +160,7 @@ class ServerNode:
             self.store.open()
         else:
             self.store = None
+        self.api.store = self.store
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -396,6 +397,19 @@ class ServerNode:
         elif t == "cluster-state" and self.cluster is not None:
             from pilosa_tpu.cluster.resize import apply_cluster_state
             apply_cluster_state(self.cluster, message["state"])
+        elif t in ("delete-index", "delete-field", "delete-view"):
+            # Apply to the holder (shared handler), then unlink the
+            # on-disk tree: a peer that kept the stale files would
+            # resurrect the deleted data into a recreated same-name
+            # index/field/view on restart.
+            handle_cluster_message(self.holder, message)
+            if self.store is not None:
+                prefix = [message["index"]]
+                if t != "delete-index":
+                    prefix.append(message["field"])
+                if t == "delete-view":
+                    prefix.append(message["view"])
+                self.store.delete_subtree_files(*prefix)
         elif t == "node-join" and self.cluster is not None:
             self.handle_join(message["addr"])
         else:
@@ -479,8 +493,12 @@ class ServerNode:
         return n
 
     def handle_internal_import(self, req: dict) -> None:
-        """JSON /internal/import payloads: fragment-level (anti-entropy
-        diff push) or field-level (routed import)."""
+        """/internal/import payloads: fragment-level (anti-entropy
+        diff push) or field-level (routed import). Gated by cluster
+        state like the public import surface (reference api.Import
+        validates on the RECEIVING node too): a forwarded write must
+        not land on a RESIZING owner whose fragments are mid-move."""
+        self.api._validate("import")
         index, field = req["index"], req["field"]
         f = self.holder.field(index, field)
         if f is None:

@@ -126,11 +126,17 @@ class DiskStore:
                     vdir = os.path.join(fdir, view_name)
                     if not os.path.isdir(vdir):
                         continue
-                    view = f.create_view_if_not_exists(view_name)
                     shards = set()
                     for fn in os.listdir(vdir):
                         if fn.endswith((".snap", ".wal")):
                             shards.add(int(fn.rsplit(".", 1)[0]))
+                    if not shards:
+                        # An EMPTY view dir is deletion debris (a racing
+                        # snapshot's makedirs after delete_subtree_files'
+                        # rmtree); recreating the view from it would
+                        # resurrect a deleted view in the schema.
+                        continue
+                    view = f.create_view_if_not_exists(view_name)
                     for shard in sorted(shards):
                         frag = view.create_fragment_if_not_exists(shard)
                         self._load_fragment(frag, (iname, fname, view_name,
@@ -223,6 +229,50 @@ class DiskStore:
             except OSError:
                 pass
 
+    def delete_subtree_files(self, index: str, field: str | None = None,
+                             view: str | None = None) -> None:
+        """Disk half of index/field/view deletion: tombstone and unlink
+        every fragment under the prefix, then remove its directory.
+        Without this, deleting a field and recreating the name would
+        RESURRECT the deleted data on the next restart (the reloader is
+        schema-driven and would find the stale .snap/.wal files).
+        Reference: Index.DeleteField/deleteView remove the path trees
+        (field.go:905, index.go:471)."""
+        import shutil
+
+        prefix = tuple(p for p in (index, field, view) if p is not None)
+        plen = len(prefix)
+        subdir = os.path.join(self.data_dir, *prefix)
+        # Enumerate on-disk keys OUTSIDE the lock (the walk can be
+        # slow); only the tombstone/writer bookkeeping needs mutual
+        # exclusion. The holder entries are already gone, so no new
+        # writers appear for the prefix while we walk — and any
+        # straggler is caught by the snapshot identity check.
+        disk_keys: set[tuple] = set()
+        if os.path.isdir(subdir):
+            for root, _dirs, files in os.walk(subdir):
+                rel = os.path.relpath(root, self.data_dir)
+                parts = tuple(rel.split(os.sep))
+                if len(parts) != 3:  # index/field/view level only
+                    continue
+                for fn in files:
+                    if fn.endswith((".snap", ".wal")):
+                        disk_keys.add(parts + (int(fn.rsplit(".", 1)[0]),))
+        with self._lock:
+            keys = {k for k in self._writers if k[:plen] == prefix}
+            keys |= {k for k in self._snap_pending if k[:plen] == prefix}
+            keys |= disk_keys
+            for key in keys:
+                self._deleted.add(key)
+                self._snap_pending.discard(key)
+                w = self._writers.pop(key, None)
+                if w is not None:
+                    w.close()
+        # rmtree + schema dump off the lock: deleting a large index must
+        # not stall every unrelated WAL append on the node.
+        shutil.rmtree(subdir, ignore_errors=True)
+        self.save_schema()
+
     # -- snapshots (fragment.go:187-239, :2337-2393) -----------------------
 
     def _enqueue_snapshot(self, key: tuple) -> None:
@@ -256,12 +306,9 @@ class DiskStore:
     def snapshot_fragment(self, key: tuple) -> None:
         """Write <shard>.snap.tmp, fsync-rename, truncate the WAL."""
         index, field, view, shard = key
-        with self._lock:
-            if key in self._deleted:
-                return  # cleaner removed it; don't resurrect files
         frag = self.holder.fragment(index, field, view, shard)
         if frag is None:
-            return
+            return  # deleted (cleaner / delete-field): nothing to write
         with frag._lock:
             snap_rows = frag.rows_snapshot()
             row_ids = np.asarray([r for r, _ in snap_rows], dtype=np.uint64)
@@ -280,15 +327,22 @@ class DiskStore:
                 fh.flush()
                 os.fsync(fh.fileno())
             # Publish + truncate under the store lock, mutually exclusive
-            # with delete_fragment_files' tombstone-and-unlink — a
-            # racing cleaner can then never see its deletion undone.
+            # with the deleters' tombstone-and-unlink. Abort on fragment
+            # IDENTITY, not just the tombstone: if the holder's current
+            # fragment is no longer the object we snapshotted, a
+            # deletion (and possibly a same-name recreation) happened
+            # mid-write and publishing would resurrect dead data. If it
+            # IS still the live object, any tombstone left from a prior
+            # same-key generation is stale — the recreated fragment is
+            # legitimately persisting — so clear it.
             with self._lock:
-                if key in self._deleted:
+                if self.holder.fragment(index, field, view, shard) is not frag:
                     try:
                         os.remove(tmp)
                     except OSError:
                         pass
                     return
+                self._deleted.discard(key)
                 os.replace(tmp, path)
                 _fsync_dir(os.path.dirname(path))
                 # Snapshot is durable; only now may the WAL be

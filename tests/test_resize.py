@@ -721,3 +721,43 @@ def test_stale_broadcast_cannot_roll_back_topology():
     newer = [n.to_json() for n in a.cluster.nodes]
     apply_cluster_status(a.cluster, newer, version=3)
     assert a.cluster.topology_version == 3
+
+
+def test_stuck_resizing_peer_self_heals():
+    """A node left in RESIZING with no commit broadcast coming (it was
+    removed by the shrink, or the coordinator crashed mid-job) reopens
+    its gate on the next sweep: the coordinator's view is authoritative,
+    and a dead coordinator means the job died with it."""
+    from pilosa_tpu.cluster import STATE_NORMAL, STATE_RESIZING
+    from pilosa_tpu.cluster.harness import LocalCluster
+    from pilosa_tpu.cluster.resize import check_nodes
+
+    # Case 1: coordinator reports the resize is over (removed node).
+    lc = LocalCluster(3)
+    peer = lc[1]
+    peer.cluster.set_state(STATE_RESIZING)
+    check_nodes(peer.cluster, lc.client)
+    assert peer.cluster.state == STATE_NORMAL
+
+    # Case 2: coordinator still mid-job -> the gate STAYS closed.
+    lc2 = LocalCluster(3)
+    lc2[0].cluster.set_state(STATE_RESIZING)  # coordinator's own view
+    lc2[1].cluster.set_state(STATE_RESIZING)
+    check_nodes(lc2[1].cluster, lc2.client)
+    assert lc2[1].cluster.state == STATE_RESIZING
+
+    # Case 3: coordinator dead -> the job died with it; the phantom
+    # RESIZING clears and liveness takes over (replica_n=1 with a dead
+    # node is STARTING — data genuinely unavailable, honest status).
+    lc3 = LocalCluster(3)
+    lc3[1].cluster.set_state(STATE_RESIZING)
+    lc3.client.down.add("node0")
+    check_nodes(lc3[1].cluster, lc3.client)
+    assert lc3[1].cluster.state == "STARTING"
+
+    # Case 4: the coordinator itself never self-clears mid-job (its
+    # ResizeJob owns the transition).
+    lc4 = LocalCluster(3)
+    lc4[0].cluster.set_state(STATE_RESIZING)
+    check_nodes(lc4[0].cluster, lc4.client)
+    assert lc4[0].cluster.state == STATE_RESIZING

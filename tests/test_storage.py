@@ -174,3 +174,100 @@ def test_server_node_with_data_dir(tmp_path):
         assert resp["results"][0]["columns"] == [123]
     finally:
         n2.close()
+
+
+def test_deleted_field_does_not_resurrect_on_reload(tmp_path):
+    """Delete a field, recreate the name, restart: the new field must be
+    EMPTY. The reloader is schema-driven, so stale .snap/.wal files from
+    the deleted generation would silently re-populate the recreated
+    field unless deletion unlinks the subtree."""
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.server.api import API
+    from pilosa_tpu.exec import Executor
+    from pilosa_tpu.storage.diskstore import DiskStore
+
+    d = str(tmp_path / "data")
+    h = Holder()
+    idx = h.create_index("i")
+    f = idx.create_field("f")
+    store = DiskStore(d, h)
+    store.open()
+    api = API(h, Executor(h))
+    api.store = store
+    f.set_bit(1, 42)
+    store.flush()
+    api.delete_field("i", "f")
+    idx.create_field("f").set_bit(2, 7)  # recreated, different data
+    store.flush()
+    store.close()
+
+    h2 = Holder()
+    store2 = DiskStore(d, h2)
+    store2.open()
+    f2 = h2.index("i").field("f")
+    assert list(f2.row(1).columns()) == [], "deleted data resurrected"
+    assert list(f2.row(2).columns()) == [7]
+    store2.close()
+
+
+def test_deleted_index_does_not_resurrect_on_reload(tmp_path):
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.server.api import API
+    from pilosa_tpu.exec import Executor
+    from pilosa_tpu.storage.diskstore import DiskStore
+
+    d = str(tmp_path / "data")
+    h = Holder()
+    h.create_index("i").create_field("f").set_bit(1, 42)
+    store = DiskStore(d, h)
+    store.open()
+    store.flush()
+    api = API(h, Executor(h))
+    api.store = store
+    api.delete_index("i")
+    h.create_index("i").create_field("f")  # recreated empty
+    store.flush()
+    store.close()
+
+    h2 = Holder()
+    store2 = DiskStore(d, h2)
+    store2.open()
+    assert list(h2.index("i").field("f").row(1).columns()) == []
+    store2.close()
+
+
+def test_delete_view_unlinks_files_and_survives_reload(tmp_path):
+    """API.DeleteView (api.go:779): the view disappears from memory AND
+    disk; a reload must not bring it back."""
+    import os
+
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.server.api import API
+    from pilosa_tpu.exec import Executor
+    from pilosa_tpu.storage.diskstore import DiskStore
+
+    d = str(tmp_path / "data")
+    h = Holder()
+    idx = h.create_index("i")
+    f = idx.create_field("f")
+    store = DiskStore(d, h)
+    store.open()
+    api = API(h, Executor(h))
+    api.store = store
+    f.set_bit(1, 3)  # standard view
+    v2 = f.create_view_if_not_exists("standard_2024")
+    v2.create_fragment_if_not_exists(0).set_bit(1, 9)
+    store.flush()
+    assert os.path.isdir(os.path.join(d, "i", "f", "standard_2024"))
+    api.delete_view("i", "f", "standard_2024")
+    assert f.view("standard_2024") is None
+    assert not os.path.isdir(os.path.join(d, "i", "f", "standard_2024"))
+    store.close()
+
+    h2 = Holder()
+    store2 = DiskStore(d, h2)
+    store2.open()
+    f2 = h2.index("i").field("f")
+    assert f2.view("standard_2024") is None
+    assert list(f2.row(1).columns()) == [3]  # standard view intact
+    store2.close()
