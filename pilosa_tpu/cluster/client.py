@@ -54,6 +54,11 @@ class InternalClient(Protocol):
         """Entry stream for replica catch-up (translate.go:93)."""
         ...
 
+    def post_schema(self, node: Node, schema: list[dict]) -> None:
+        """Push a whole schema to a peer (ApplySchema fan-out,
+        api.go:747)."""
+        ...
+
 
 class NopClient:
     """Standalone stub: remote calls are errors (clusters of one never
@@ -161,6 +166,9 @@ class LocalClient:
 
     def schema(self, node) -> list[dict]:
         return self._peer(node).handle_schema()
+
+    def post_schema(self, node, schema: list[dict]) -> None:
+        self._peer(node).apply_schema(schema)
 
     def nodes(self, node) -> list[dict]:
         return self._peer(node).handle_nodes()

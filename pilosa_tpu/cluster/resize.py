@@ -506,6 +506,11 @@ def check_nodes(cluster: Cluster, client, retries: int = 2,
     return changed
 
 
+#: consecutive failure-detector sweeps a coordinator must stay DOWN
+#: before a peer concludes a phantom RESIZING state died with it.
+RESIZING_COORD_DOWN_SWEEPS = 3
+
+
 def _recover_stuck_resizing(cluster: Cluster, client) -> None:
     """A non-coordinator stuck in RESIZING self-heals here: a removed
     node never receives the commit broadcast (it isn't in the new
@@ -521,17 +526,30 @@ def _recover_stuck_resizing(cluster: Cluster, client) -> None:
     coord = next((n for n in cluster.nodes
                   if n.is_coordinator and n.id != cluster.local_id), None)
     over = False
-    if coord is None or coord.state == "DOWN":
-        over = True  # no live resize authority: the job died with it
+    if coord is None:
+        over = True  # no resize authority exists at all
+    elif coord.state == "DOWN":
+        # A single failed sweep is a weak proxy for "the job died" — a
+        # GC pause or blip would reopen the gate while fragments still
+        # move, and a write accepted then could be GC'd at commit.
+        # Require several consecutive DOWN sweeps before concluding the
+        # coordinator (and its job) are gone.
+        down = getattr(cluster, "_resizing_coord_down_sweeps", 0) + 1
+        cluster._resizing_coord_down_sweeps = down
+        over = down >= RESIZING_COORD_DOWN_SWEEPS
     else:
+        cluster._resizing_coord_down_sweeps = 0
         try:
             resp = client.nodes(coord)
             if isinstance(resp, dict):
+                # Only an AFFIRMATIVE steady-state report clears the
+                # gate; errors/old peers keep it closed.
                 over = (resp.get("state") is not None
                         and resp["state"] != STATE_RESIZING)
         except (ConnectionError, RuntimeError, LookupError,
                 AttributeError):
-            over = False  # transient: the DOWN path above is the backstop
+            over = False
     if over:
+        cluster._resizing_coord_down_sweeps = 0
         cluster.set_state(STATE_NORMAL)
         cluster._update_state()

@@ -189,9 +189,24 @@ class API:
     def schema(self) -> list[dict]:
         return self.holder.schema()
 
-    def apply_schema(self, schema: list[dict]) -> None:
+    def apply_schema(self, schema: list[dict], remote: bool = False) -> None:
+        """Reference API.ApplySchema (api.go:738): replicate a whole
+        schema onto this cluster. remote=False fans the schema out to
+        every node first (each peer applies with remote=true); designed
+        for seeding an empty cluster from another one's schema."""
         self._validate("apply-schema")
+        # Local first, then best-effort fan-out: an unreachable peer
+        # must not leave the cluster half-applied with the ORIGIN node
+        # empty — stragglers converge via anti-entropy's schema pull.
         self.holder.apply_schema(schema)
+        if not remote and self.cluster is not None:
+            for node in self.cluster.nodes:
+                if node.id == self.cluster.local_id or node.state == "DOWN":
+                    continue
+                try:
+                    self.cluster.client.post_schema(node, schema)
+                except (ConnectionError, RuntimeError, LookupError):
+                    pass
 
     def index_info(self, index: str) -> dict:
         return self.holder.index_or_raise(index).info()

@@ -284,6 +284,12 @@ class HTTPInternalClient:
         gossip/gossip.go:295-443)."""
         return self._request(node, "GET", "/internal/nodes")
 
+    def post_schema(self, node, schema: list[dict]) -> None:
+        """Push a schema to one peer (reference PostSchema fan-out from
+        API.ApplySchema, api.go:747; remote=true stops re-fan-out)."""
+        self._request(node, "POST", "/schema?remote=true",
+                      json.dumps({"indexes": schema}).encode())
+
     def schema(self, node) -> list[dict]:
         """Peer schema pull (reference NodeStatus carries Schema;
         server.go:640 handles it on receive)."""

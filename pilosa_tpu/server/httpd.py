@@ -297,7 +297,8 @@ def _build_routes(api: API):
         return 200, {"indexes": api.schema()}
 
     def post_schema(pv, params, body):
-        api.apply_schema(jbody(body).get("indexes", []))
+        api.apply_schema(jbody(body).get("indexes", []),
+                         remote=params.get("remote") == "true")
         return 200, {}
 
     def get_status(pv, params, body):

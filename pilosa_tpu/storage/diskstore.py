@@ -86,6 +86,13 @@ class DiskStore:
 
     def open(self) -> None:
         self.holder.op_writer_factory = self._op_writer_factory
+        # Finish any deletion a crash interrupted: subtrees are detached
+        # by rename before their slow recursive unlink.
+        import shutil
+        for fn in os.listdir(self.data_dir):
+            if fn.startswith(".trash-"):
+                shutil.rmtree(os.path.join(self.data_dir, fn),
+                              ignore_errors=True)
         schema_path = os.path.join(self.data_dir, "schema.json")
         if os.path.exists(schema_path):
             with open(schema_path) as f:
@@ -239,6 +246,7 @@ class DiskStore:
         Reference: Index.DeleteField/deleteView remove the path trees
         (field.go:905, index.go:471)."""
         import shutil
+        import uuid
 
         prefix = tuple(p for p in (index, field, view) if p is not None)
         plen = len(prefix)
@@ -258,6 +266,7 @@ class DiskStore:
                 for fn in files:
                     if fn.endswith((".snap", ".wal")):
                         disk_keys.add(parts + (int(fn.rsplit(".", 1)[0]),))
+        trash = None
         with self._lock:
             keys = {k for k in self._writers if k[:plen] == prefix}
             keys |= {k for k in self._snap_pending if k[:plen] == prefix}
@@ -268,9 +277,23 @@ class DiskStore:
                 w = self._writers.pop(key, None)
                 if w is not None:
                     w.close()
-        # rmtree + schema dump off the lock: deleting a large index must
-        # not stall every unrelated WAL append on the node.
-        shutil.rmtree(subdir, ignore_errors=True)
+            # Atomically detach the subtree INSIDE the lock (a rename is
+            # O(1)); the slow recursive unlink happens outside it. A
+            # same-name recreation racing the deletion then lands in a
+            # FRESH directory instead of the doomed one — an rmtree of
+            # the live path could silently destroy the recreated
+            # field's brand-new WAL/snapshot files.
+            if os.path.isdir(subdir):
+                trash = os.path.join(
+                    self.data_dir, f".trash-{uuid.uuid4().hex}")
+                try:
+                    os.rename(subdir, trash)
+                except OSError:
+                    trash = None  # fall back to in-place rmtree below
+        if trash is not None:
+            shutil.rmtree(trash, ignore_errors=True)
+        else:
+            shutil.rmtree(subdir, ignore_errors=True)
         self.save_schema()
 
     # -- snapshots (fragment.go:187-239, :2337-2393) -----------------------
@@ -375,7 +398,10 @@ class DiskStore:
 
     def save_schema(self) -> None:
         path = os.path.join(self.data_dir, "schema.json")
-        tmp = path + ".tmp"
+        # Per-call unique tmp: concurrent savers (a local deletion and a
+        # delete broadcast on another handler thread) must not clobber
+        # each other's half-written file or race the os.replace.
+        tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
         with open(tmp, "w") as f:
             json.dump(self.holder.schema(), f)
         os.replace(tmp, path)

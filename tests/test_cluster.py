@@ -404,3 +404,28 @@ def test_resize_state_broadcast_closes_peer_gates():
             lc.client.send_message(n, status)
     assert peer.cluster.state == STATE_NORMAL
     peer_api.create_field("rs", "f")  # flows again
+
+
+def test_apply_schema_fans_out_cluster_wide():
+    """Reference API.ApplySchema (api.go:738): POST /schema on one node
+    replicates the schema to every node; remote=true applies locally
+    only (no re-fan-out)."""
+    from pilosa_tpu.cluster.harness import LocalCluster
+    from pilosa_tpu.server.api import API
+
+    lc = LocalCluster(3)
+    a = lc[0]
+    api = API(a.holder, a.executor, cluster=a.cluster)
+    schema = [{"name": "rep", "options": {},
+               "fields": [{"name": "f", "options": {"type": "set"}}]}]
+    api.apply_schema(schema)
+    for i in range(3):
+        idx = lc[i].holder.index("rep")
+        assert idx is not None and idx.field("f") is not None, f"node {i}"
+
+    # remote=true: local only.
+    api2 = API(lc[1].holder, lc[1].executor, cluster=lc[1].cluster)
+    api2.apply_schema([{"name": "solo", "options": {}, "fields": []}],
+                      remote=True)
+    assert lc[1].holder.index("solo") is not None
+    assert lc[0].holder.index("solo") is None

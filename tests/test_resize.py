@@ -747,11 +747,18 @@ def test_stuck_resizing_peer_self_heals():
     assert lc2[1].cluster.state == STATE_RESIZING
 
     # Case 3: coordinator dead -> the job died with it; the phantom
-    # RESIZING clears and liveness takes over (replica_n=1 with a dead
-    # node is STARTING — data genuinely unavailable, honest status).
+    # RESIZING clears only after several consecutive DOWN sweeps (a
+    # one-sweep blip must NOT reopen the gate mid-resize), then
+    # liveness takes over (replica_n=1 with a dead node is STARTING —
+    # data genuinely unavailable, honest status).
+    from pilosa_tpu.cluster.resize import RESIZING_COORD_DOWN_SWEEPS
+
     lc3 = LocalCluster(3)
     lc3[1].cluster.set_state(STATE_RESIZING)
     lc3.client.down.add("node0")
+    for i in range(RESIZING_COORD_DOWN_SWEEPS - 1):
+        check_nodes(lc3[1].cluster, lc3.client)
+        assert lc3[1].cluster.state == STATE_RESIZING, f"sweep {i}"
     check_nodes(lc3[1].cluster, lc3.client)
     assert lc3[1].cluster.state == "STARTING"
 
