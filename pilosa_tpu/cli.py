@@ -133,23 +133,64 @@ def _post(host: str, path: str, body: bytes) -> dict:
         return json.loads(resp.read() or b"{}")
 
 
+def _import_modes(host: str, index: str, field: str) -> tuple[bool, bool, bool]:
+    """(value_mode, row_keys, column_keys) from the server's schema —
+    the reference's bufferers pick the import mode the same way
+    (ctl/import.go:125-140: field.Options.Type / Keys)."""
+    # A failed schema fetch must ABORT the import, not guess the mode:
+    # posting an int field's (col,value) CSV as rowIDs/columnIDs would
+    # silently write garbage bits instead of BSI values.
+    with urllib.request.urlopen(f"http://{host}/schema",
+                                timeout=30) as resp:
+        schema = json.load(resp).get("indexes") or []
+    for idx in schema:
+        if idx.get("name") != index:
+            continue
+        col_keys = bool((idx.get("options") or {}).get("keys"))
+        for f in idx.get("fields") or []:
+            if f.get("name") == field:
+                opts = f.get("options") or {}
+                return (opts.get("type") == "int",
+                        bool(opts.get("keys")), col_keys)
+        return False, False, col_keys
+    return False, False, False
+
+
 def cmd_import(args) -> int:
-    """CSV (row,col[,timestamp]) -> batched imports, like ctl/import.go:
-    parse, buffer, send per batch."""
-    rows, cols, stamps = [], [], []
+    """CSV -> batched imports, like ctl/import.go: parse, buffer, send
+    per batch. The mode follows the target field's schema: set/time
+    fields take (row,col[,timestamp]) rows, int fields take
+    (col,value), and keyed indexes/fields accept string keys in place
+    of ids (reference ctl/import.go:125-140 + ImportK)."""
+    try:
+        value_mode, row_keys, col_keys = _import_modes(
+            args.host, args.index, args.field)
+    except Exception as e:
+        print(f"import: cannot read schema from {args.host}: {e}",
+              file=sys.stderr)
+        return 1
+    rows, cols, vals, stamps = [], [], [], []
     has_ts = False
 
     def flush():
-        nonlocal rows, cols, stamps
-        if not rows:
+        nonlocal rows, cols, vals, stamps
+        if not cols:
             return
-        body: dict = {"rowIDs": rows, "columnIDs": cols}
-        if has_ts:
-            body["timestamps"] = stamps
+        body: dict = {}
+        if value_mode:
+            body["values"] = vals
+        else:
+            body["rowKeys" if row_keys else "rowIDs"] = rows
+            if has_ts:
+                body["timestamps"] = stamps
+        body["columnKeys" if col_keys else "columnIDs"] = cols
         _post(args.host, f"/index/{args.index}/field/{args.field}/import"
                          + ("?clear=1" if args.clear else ""),
               json.dumps(body).encode())
-        rows, cols, stamps = [], [], []
+        rows, cols, vals, stamps = [], [], [], []
+
+    def parse_id(tok: str, keyed: bool):
+        return tok if keyed else int(tok)
 
     for path in args.files:
         f = sys.stdin if path == "-" else open(path)
@@ -159,14 +200,18 @@ def cmd_import(args) -> int:
                 if not line:
                     continue
                 parts = line.split(",")
-                rows.append(int(parts[0]))
-                cols.append(int(parts[1]))
-                if len(parts) > 2:
-                    has_ts = True
-                    stamps.append(parts[2])
+                if value_mode:
+                    cols.append(parse_id(parts[0], col_keys))
+                    vals.append(int(parts[1]))
                 else:
-                    stamps.append(None)
-                if len(rows) >= args.buffer_size:
+                    rows.append(parse_id(parts[0], row_keys))
+                    cols.append(parse_id(parts[1], col_keys))
+                    if len(parts) > 2:
+                        has_ts = True
+                        stamps.append(parts[2])
+                    else:
+                        stamps.append(None)
+                if len(cols) >= args.buffer_size:
                     flush()
         finally:
             if f is not sys.stdin:

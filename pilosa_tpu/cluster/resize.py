@@ -519,6 +519,9 @@ def _recover_stuck_resizing(cluster: Cluster, client) -> None:
     authoritative: if it reports any steady state — or is dead — the
     resize no longer exists and the gate must reopen."""
     if cluster.state != STATE_RESIZING:
+        # Not resizing: clear any debounce left by a PREVIOUS job so the
+        # next resize starts its DOWN count from zero.
+        cluster._resizing_coord_down_sweeps = 0
         return
     local = cluster.node_by_id(cluster.local_id)
     if local is not None and local.is_coordinator:

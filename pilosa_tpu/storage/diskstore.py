@@ -62,6 +62,7 @@ class DiskStore:
         #: via _op_writer_factory.
         self._deleted: set[tuple] = set()
         self._lock = threading.Lock()
+        self._schema_lock = threading.Lock()
         # Background snapshot queue (holder.go:163: depth 100, 2 workers).
         self._snap_q: "queue.Queue[tuple | None]" = queue.Queue(maxsize=100)
         self._snap_pending: set[tuple] = set()
@@ -93,6 +94,13 @@ class DiskStore:
             if fn.startswith(".trash-"):
                 shutil.rmtree(os.path.join(self.data_dir, fn),
                               ignore_errors=True)
+            elif fn.startswith("schema.json.") and fn.endswith(".tmp"):
+                # A crash between tmp write and replace strands a
+                # uniquely-named tmp; sweep them or they accumulate.
+                try:
+                    os.remove(os.path.join(self.data_dir, fn))
+                except OSError:
+                    pass
         schema_path = os.path.join(self.data_dir, "schema.json")
         if os.path.exists(schema_path):
             with open(schema_path) as f:
@@ -398,13 +406,16 @@ class DiskStore:
 
     def save_schema(self) -> None:
         path = os.path.join(self.data_dir, "schema.json")
-        # Per-call unique tmp: concurrent savers (a local deletion and a
-        # delete broadcast on another handler thread) must not clobber
-        # each other's half-written file or race the os.replace.
-        tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
-        with open(tmp, "w") as f:
-            json.dump(self.holder.schema(), f)
-        os.replace(tmp, path)
+        # Serialize snapshot+replace: two concurrent savers could
+        # otherwise interleave so the one holding the OLDER holder
+        # snapshot wins the replace, resurrecting a just-deleted field
+        # in schema.json. The unique tmp name guards a crashed saver's
+        # leftovers (swept at open) from being replaced mid-write.
+        with self._schema_lock:
+            tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
+            with open(tmp, "w") as f:
+                json.dump(self.holder.schema(), f)
+            os.replace(tmp, path)
 
     def flush(self) -> None:
         self.save_schema()

@@ -45,6 +45,39 @@ def test_import_export_roundtrip(node, tmp_path, capsys):
     assert sorted(out.strip().splitlines()) == ["1,3", "1,9", "2,4"]
 
 
+def test_import_int_field_values(node, tmp_path):
+    """Schema-aware CLI import (ctl/import.go:125): an int field's CSV
+    is (column, value) pairs routed through the value import path."""
+    base = node.address
+    host = base.removeprefix("http://")
+    _post(base, "/index/vi", "{}")
+    _post(base, "/index/vi/field/amount",
+          json.dumps({"options": {"type": "int", "min": -1000,
+                                  "max": 1000}}))
+    csv = tmp_path / "vals.csv"
+    csv.write_text("3,250\n9,-40\n")
+    assert cli.main(["import", "--host", host, "vi", "amount",
+                     str(csv)]) == 0
+    resp = json.loads(_post(base, "/index/vi/query", "Sum(field=amount)"))
+    assert resp["results"][0] == {"value": 210, "count": 2}
+
+
+def test_import_keyed_field(node, tmp_path):
+    """Keyed index + keyed field: CSV cells are string keys, translated
+    server-side (reference ImportK)."""
+    base = node.address
+    host = base.removeprefix("http://")
+    _post(base, "/index/ki", json.dumps({"options": {"keys": True}}))
+    _post(base, "/index/ki/field/tag",
+          json.dumps({"options": {"keys": True}}))
+    csv = tmp_path / "keys.csv"
+    csv.write_text("blue,alice\nblue,bob\nred,alice\n")
+    assert cli.main(["import", "--host", host, "ki", "tag",
+                     str(csv)]) == 0
+    resp = json.loads(_post(base, "/index/ki/query", 'Count(Row(tag="blue"))'))
+    assert resp["results"] == [2]
+
+
 def test_check_and_inspect(node, tmp_path, capsys):
     base = node.address
     _post(base, "/index/i", "{}")
