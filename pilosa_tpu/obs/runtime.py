@@ -58,6 +58,21 @@ def collect_runtime_gauges(stats, planner=None,
         except Exception:
             pass  # platform without memory stats / no device
 
+    # Import buffer-pool health (native recycled page pool): an
+    # operator watching freeBytes fall toward zero is watching imports
+    # head back to cold first-touch fault cost — the signal to raise
+    # import-pool-mb (the top-up loop covers steady drain).
+    try:
+        from pilosa_tpu import native
+        pool = native.pool_stats()
+        if pool is not None:
+            out["poolFreeBytes"] = float(pool["free_bytes"])
+            out["poolLimitBytes"] = float(pool["limit_bytes"])
+            out["poolFreshMmaps"] = float(pool["fresh_mmaps"])
+            out["poolRecycledAllocs"] = float(pool["recycled_allocs"])
+    except Exception:
+        pass
+
     for name, value in out.items():
         stats.gauge(f"runtime.{name}", value)
     return out

@@ -143,6 +143,11 @@ def test_runtime_gauges():
     assert out["plannerCacheBytes"] > 0
     assert stats.gauges[("runtime.plannerCacheBudgetBytes", ())] == \
         planner.max_cache_bytes
+    from pilosa_tpu import native
+    if native.available():
+        # Import buffer-pool gauges ride the same sweep.
+        assert "poolLimitBytes" in out
+        assert out["poolLimitBytes"] > 0
 
 
 def test_trace_propagates_across_nodes():
