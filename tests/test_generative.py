@@ -38,11 +38,19 @@ def _gen_tree(rng, depth):
         f = FIELDS[rng.integers(0, len(FIELDS))]
         r = ROWS[rng.integers(0, len(ROWS))]
         return f"Row({f}={r})", ("row", f, r)
-    op = ["Intersect", "Union", "Difference", "Xor", "Not"][
-        rng.integers(0, 5)]
+    op = ["Intersect", "Union", "Difference", "Xor", "Not", "Shift"][
+        rng.integers(0, 6)]
     if op == "Not":
         q, t = _gen_tree(rng, depth - 1)
         return f"Not({q})", ("not", t)
+    if op == "Shift":
+        # Mix tiny shifts (intra-word), word-crossing ones, and the
+        # occasional huge n (full-range device path; bits past a shard
+        # edge fall off — per-shard semantics, test_planner:349).
+        n = int([1, 7, 31, 32, 100, 4096, SHARD_WIDTH // 2][
+            rng.integers(0, 7)])
+        q, t = _gen_tree(rng, depth - 1)
+        return f"Shift({q}, n={n})", ("shift", t, n)
     k = 2 + int(rng.integers(0, 2))
     subs = [_gen_tree(rng, depth - 1) for _ in range(k)]
     qs = ", ".join(s[0] for s in subs)
@@ -55,6 +63,10 @@ def _eval_model(t, model, existing):
         return set(model.get((t[1], t[2]), set()))
     if kind == "not":
         return existing - _eval_model(t[1], model, existing)
+    if kind == "shift":
+        n = t[2]
+        return {c + n for c in _eval_model(t[1], model, existing)
+                if (c % SHARD_WIDTH) + n < SHARD_WIDTH}
     sets = [_eval_model(s, model, existing) for s in t[1]]
     acc = sets[0]
     for s in sets[1:]:
