@@ -768,3 +768,98 @@ def test_stuck_resizing_peer_self_heals():
     lc4[0].cluster.set_state(STATE_RESIZING)
     check_nodes(lc4[0].cluster, lc4.client)
     assert lc4[0].cluster.state == STATE_RESIZING
+
+
+def test_writes_racing_a_live_join_converge():
+    """A client writing through the cluster while a node joins: writes
+    refused by the resize gate (HTTP 405) are retried, and after the
+    join every accepted write is present — none silently dropped onto a
+    ring position the committed topology GC'd."""
+    import json
+    import threading
+    import time
+    import urllib.error
+    import urllib.request
+    from pilosa_tpu.server.node import ServerNode
+
+    ports = _free_ports(3)
+    addrs = [f"127.0.0.1:{p}" for p in ports]
+    nodes = [ServerNode(bind=a, peers=[x for x in addrs[:2] if x != a],
+                        replica_n=1, use_planner=False,
+                        anti_entropy_interval=0.0,
+                        check_nodes_interval=0.0)
+             for a in addrs[:2]]
+    for n in nodes:
+        n.open()
+    joiner = None
+    stop = threading.Event()
+    accepted: list[int] = []
+    errors: list[str] = []
+
+    def writer():
+        base = nodes[0].address
+        i = 0
+        while not stop.is_set():
+            col = i * SHARD_WIDTH // 4 + i  # spread over shards
+            i += 1
+            body = f"Set({col}, f=1)".encode()
+            for attempt in range(60):
+                req = urllib.request.Request(base + "/index/i/query",
+                                             data=body, method="POST")
+                try:
+                    urllib.request.urlopen(req, timeout=10).read()
+                    accepted.append(col)
+                    break
+                except urllib.error.HTTPError as e:
+                    e.read()
+                    if e.code == 405:  # resize gate: retry
+                        time.sleep(0.05)
+                        continue
+                    errors.append(f"HTTP {e.code} for {col}")
+                    return
+                except Exception as e:  # pragma: no cover
+                    errors.append(repr(e))
+                    return
+            else:
+                errors.append(f"write {col} starved past the resize")
+                return
+            time.sleep(0.01)
+
+    try:
+        base = nodes[0].address
+
+        def post(path, body):
+            r = urllib.request.Request(base + path, data=body.encode(),
+                                       method="POST")
+            return json.loads(urllib.request.urlopen(r, timeout=10).read()
+                              or b"{}")
+
+        post("/index/i", "{}")
+        post("/index/i/field/f", "{}")
+        t = threading.Thread(target=writer)
+        t.start()
+        time.sleep(0.3)  # some writes land pre-join
+        joiner = ServerNode(bind=addrs[2], join=addrs[1],
+                            use_planner=False, anti_entropy_interval=0.0,
+                            check_nodes_interval=0.0)
+        joiner.open()
+        deadline = time.time() + 15
+        while (len(nodes[0].cluster.nodes) < 3
+               and time.time() < deadline):
+            time.sleep(0.1)
+        assert len(nodes[0].cluster.nodes) == 3
+        time.sleep(0.5)  # a few post-join writes
+        stop.set()
+        t.join(timeout=30)
+        assert not errors, errors[:3]
+        assert accepted, "no writes ever accepted"
+        want = len(set(accepted))
+        got = post("/index/i/query", "Count(Row(f=1))")
+        assert got == {"results": [want]}, (want, got, len(accepted))
+    finally:
+        stop.set()
+        for n in nodes + ([joiner] if joiner else []):
+            try:
+                n.close()
+            except Exception:
+                pass
