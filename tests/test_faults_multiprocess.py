@@ -135,3 +135,68 @@ def test_sigkill_degraded_then_autonomous_recovery(tmp_path):
                 p.wait(timeout=10)
             except Exception:
                 pass
+
+
+@pytest.mark.slow
+def test_sigstop_pause_degraded_then_resume(tmp_path):
+    """The reference's pumba pause test (clustertests/cluster_test.go:28):
+    a node is PAUSED (SIGSTOP) mid-workload — unresponsive but not dead
+    — the cluster degrades, writes keep landing on the survivor, and
+    when the node RESUMES (SIGCONT) the cluster returns to NORMAL with
+    every write present on both nodes (anti-entropy repairs whatever
+    the paused replica missed)."""
+    ports = _free_ports(2)
+    addrs = [f"127.0.0.1:{p}" for p in ports]
+    dirs = [str(tmp_path / f"n{i}") for i in range(2)]
+    logs = [str(tmp_path / f"n{i}.log") for i in range(2)]
+    procs = [
+        _spawn(addrs[i], [addrs[1 - i]], dirs[i], log_path=logs[i])
+        for i in range(2)
+    ]
+    try:
+        for a in addrs:
+            _wait_up(a)
+        _post(addrs[0], "/index/i")
+        _post(addrs[0], "/index/i/field/f")
+        _post(addrs[0], "/index/i/query", "Set(1, f=1) Set(2, f=1)")
+        assert _post(addrs[0], "/index/i/query",
+                     "Count(Row(f=1))") == {"results": [2]}
+
+        # Pause (not kill): the process keeps its sockets, it just stops
+        # scheduling — the failure detector must still call it DOWN.
+        os.kill(procs[1].pid, signal.SIGSTOP)
+        deadline = time.time() + 30
+        while time.time() < deadline and _state(addrs[0]) != "DEGRADED":
+            time.sleep(0.3)
+        assert _state(addrs[0]) == "DEGRADED"
+
+        # Writes continue against the survivor while the peer is frozen.
+        _post(addrs[0], "/index/i/query", "Set(3, f=1) Set(4, f=1)")
+        assert _post(addrs[0], "/index/i/query",
+                     "Count(Row(f=1))") == {"results": [4]}
+
+        os.kill(procs[1].pid, signal.SIGCONT)
+        deadline = time.time() + 60
+        ok = False
+        while time.time() < deadline:
+            try:
+                if (_state(addrs[0]) == "NORMAL"
+                        and _post(addrs[1], "/index/i/query?noCache=true",
+                                  "Count(Row(f=1))") == {"results": [4]}):
+                    ok = True
+                    break
+            except Exception:
+                pass
+            time.sleep(0.5)
+        assert ok, "paused node did not converge after resume"
+    finally:
+        for p in procs:
+            try:
+                os.kill(p.pid, signal.SIGCONT)
+            except Exception:
+                pass
+            try:
+                p.kill()
+                p.wait(timeout=10)
+            except Exception:
+                pass
