@@ -25,6 +25,15 @@ def clean_holder(holder, cluster, store=None) -> int:
     """
     if cluster is None or len(cluster.nodes) <= 1:
         return 0
+    # NEVER GC mid-resize (or while membership is unsettled): ownership
+    # computed under the OLD ring would delete fragments a resize
+    # target just streamed in for its NEW-ring shards — permanent data
+    # loss once the old owner is removed. The commit path cleans after
+    # the state returns to steady (reference runs the cleaner from the
+    # normal-state ticker only, holder.go:1126).
+    from pilosa_tpu.cluster.cluster import STATE_DEGRADED, STATE_NORMAL
+    if cluster.state not in (STATE_NORMAL, STATE_DEGRADED):
+        return 0
     local = cluster.local_id
     removed = 0
     for iname in holder.index_names():

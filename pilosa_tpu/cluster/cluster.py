@@ -53,6 +53,13 @@ class Cluster:
         #: removed member (ghost re-add -> wrong placement -> the GC
         #: deleting live data).
         self.topology_version = 0
+        #: durable-topology hook (reference .topology file,
+        #: cluster.go:1657): called after every committed
+        #: nodes/version change so a restarted node resumes from the
+        #: committed ring and version instead of version 0 — a reborn
+        #: coordinator committing "version 1" again would be silently
+        #: rejected as stale by every peer, forking the ring.
+        self.save_hook: Callable | None = None
         self._lock = threading.RLock()
         #: NodeEvent consumers (cluster/event.py).
         self._listeners: list[Callable] = []
@@ -150,9 +157,21 @@ class Cluster:
             self.nodes = new_nodes
             self.topology_version = version
             self._update_state()
+        self.notify_topology()
         for nid in changed:
             self._emit(EVENT_UPDATE, nid, "MERGED")
         return changed
+
+    def notify_topology(self) -> None:
+        """Invoke the durable-topology hook (best-effort: persistence
+        failure must not fail the membership change itself)."""
+        hook = self.save_hook
+        if hook is None:
+            return
+        try:
+            hook()
+        except Exception:
+            pass
 
     def subscribe(self, listener: Callable) -> None:
         """Register a NodeEvent consumer (reference ReceiveEvent's

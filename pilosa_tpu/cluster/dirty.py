@@ -50,6 +50,8 @@ class DirtyBroadcaster:
             return
         now = time.monotonic()
         with self._lock:
+            if self._closed:  # re-check under the lock: close() races
+                return
             if index_name in self._pending:
                 return  # a flush is already scheduled
             last = self._last_sent.get(index_name, -1e9)
@@ -94,14 +96,15 @@ class DirtyBroadcaster:
         self._flush()
 
     def close(self) -> None:
-        # Flush anything pending FIRST: dropping the trailing broadcast
-        # would leave peers' caches stale past the promised bound.
+        # Refuse NEW marks first, THEN flush: the reverse order lets a
+        # mark racing close slip into _pending after the final flush
+        # snapshots it — accepted but never broadcast.
+        self._closed = True
         with self._lock:
             t, self._timer = self._timer, None
         if t is not None:
             t.cancel()
         self._flush()
-        self._closed = True
 
 
 def apply_index_dirty(holder, message: dict) -> None:

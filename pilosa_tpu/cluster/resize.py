@@ -208,6 +208,8 @@ def apply_cluster_status(cluster: Cluster, nodes_json: list[dict],
                 # _update_state guard defers to the resize owner).
                 cluster.set_state(STATE_NORMAL)
             cluster._update_state()
+    if not stale:
+        cluster.notify_topology()
     if holder is not None and availability:
         for index, fields in availability.items():
             idx = holder.index(index)

@@ -78,15 +78,21 @@ class HTTPServer:
         self._thread: threading.Thread | None = None
 
     def serve_background(self) -> None:
+        self._serving = True
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
 
     def serve_forever(self) -> None:
+        self._serving = True
         self._httpd.serve_forever()
 
     def close(self) -> None:
-        self._httpd.shutdown()
+        # socketserver.shutdown() BLOCKS forever if serve_forever never
+        # ran (it waits on the flag only the serve loop sets) — closing
+        # a constructed-but-never-opened server must not hang.
+        if getattr(self, "_serving", False):
+            self._httpd.shutdown()
         self._httpd.server_close()
 
     @property

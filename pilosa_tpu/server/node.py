@@ -161,6 +161,47 @@ class ServerNode:
         else:
             self.store = None
         self.api.store = self.store
+        if self.store is not None and self.cluster is not None:
+            self._wire_topology_persistence(data_dir)
+
+    def _wire_topology_persistence(self, data_dir: str) -> None:
+        """Durable topology (reference .topology file, cluster.go:1657):
+        every committed nodes/version change is written to
+        topology.json, and boot resumes from it. Without this, a
+        restarted coordinator's in-memory version resets to 0, its next
+        commit broadcasts "version 1", and every peer holding a higher
+        version silently rejects the committed ring as stale — a forked
+        cluster."""
+        import json as _json
+        import os as _os
+
+        path = _os.path.join(data_dir, "topology.json")
+
+        def save() -> None:
+            with self.cluster._lock:
+                doc = {"version": self.cluster.topology_version,
+                       "nodes": [n.to_json() for n in self.cluster.nodes]}
+            tmp = f"{path}.{_os.getpid()}.tmp"
+            with open(tmp, "w") as f:
+                _json.dump(doc, f)
+            _os.replace(tmp, path)
+
+        self.cluster.save_hook = save
+        try:
+            with open(path) as f:
+                doc = _json.load(f)
+        except (OSError, ValueError):
+            return
+        version = int(doc.get("version", 0))
+        saved = [Node.from_json(n) for n in doc.get("nodes", [])]
+        if version <= self.cluster.topology_version or not saved:
+            return
+        if not any(n.id == self.id for n in saved):
+            # The durable ring excludes US: we were removed while down.
+            # Keep the boot list; rejoining is the operator's call.
+            return
+        self.cluster.nodes = sorted(saved, key=lambda n: n.id)
+        self.cluster.topology_version = version
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -260,6 +301,29 @@ class ServerNode:
                 except Exception:
                     pass  # ticker retries
             threading.Thread(target=repair, name="event-repair",
+                             daemon=True).start()
+        if (ev.type == EVENT_UPDATE and ev.state == "READY"
+                and self.cluster is not None and not self._closed):
+            # A rejoined peer missed every index-dirty broadcast while
+            # it was (or merely LOOKED) down — its epoch-validated
+            # result caches would serve stale reads until the next
+            # write. Push it a full invalidation sweep; and flush our
+            # own caches too, since the asymmetric case (it was serving
+            # writes we never heard about) leaves OUR caches stale.
+            def invalidate(node_id=ev.node_id):
+                node = self.cluster.node_by_id(node_id)
+                for iname in self.holder.index_names():
+                    idx = self.holder.index(iname)
+                    if idx is not None:
+                        idx.epoch.bump(notify=False)
+                    if node is None:
+                        continue
+                    try:
+                        self.cluster.client.send_message(
+                            node, {"type": "index-dirty", "index": iname})
+                    except (ConnectionError, RuntimeError, LookupError):
+                        pass  # next sweep's READY flap retries
+            threading.Thread(target=invalidate, name="rejoin-invalidate",
                              daemon=True).start()
 
     def _sync_schema(self) -> None:
@@ -412,6 +476,17 @@ class ServerNode:
                 self.store.delete_subtree_files(*prefix)
         elif t == "node-join" and self.cluster is not None:
             self.handle_join(message["addr"])
+        elif t == "resize-remove-node" and self.cluster is not None:
+            # Forwarded from a non-coordinator's /cluster/resize/
+            # remove-node; run the job here (possibly long) off the
+            # RPC thread like a join.
+            def _run_remove(nid=message.get("id")):
+                try:
+                    self.resize("remove", node_id=nid)
+                except (RuntimeError, ConnectionError, ValueError):
+                    pass
+            threading.Thread(target=_run_remove, daemon=True,
+                             name="resize-remove").start()
         else:
             handle_cluster_message(self.holder, message)
 
@@ -455,6 +530,18 @@ class ServerNode:
         cluster.go:1447): a second request while one runs is rejected."""
         if self.cluster is None:
             raise RuntimeError("standalone node cannot resize")
+        # Resizes RUN on the flagged coordinator, like joins: the
+        # stuck-RESIZING recovery heuristic consults the coordinator's
+        # state as the resize authority, so a job running anywhere else
+        # would make that heuristic (a) never recover if this node died
+        # mid-job, or (b) falsely reopen peer gates while the job lives.
+        coord = self.cluster.coordinator()
+        if coord is not None and coord.id != self.id:
+            if action == "remove":
+                self.cluster.client.send_message(
+                    coord, {"type": "resize-remove-node", "id": node_id})
+                return "FORWARDED"
+            raise RuntimeError("resize must run on the coordinator")
         from pilosa_tpu.cluster.node import URI, Node
         from pilosa_tpu.cluster.resize import ResizeJob
         new_nodes = [Node(id=n.id, uri=n.uri, is_coordinator=n.is_coordinator)

@@ -357,8 +357,8 @@ class DiskStore:
                                     positions=positions)
                 fh.flush()
                 os.fsync(fh.fileno())
-            # Publish + truncate under the store lock, mutually exclusive
-            # with the deleters' tombstone-and-unlink. Abort on fragment
+            # Publish under the store lock, mutually exclusive with the
+            # deleters' tombstone-and-unlink. Abort on fragment
             # IDENTITY, not just the tombstone: if the holder's current
             # fragment is no longer the object we snapshotted, a
             # deletion (and possibly a same-name recreation) happened
@@ -375,7 +375,18 @@ class DiskStore:
                     return
                 self._deleted.discard(key)
                 os.replace(tmp, path)
-                _fsync_dir(os.path.dirname(path))
+            # The slow directory fsync runs OUTSIDE the store lock — it
+            # would otherwise stall every concurrent WAL append (all go
+            # through _writer() on the same lock) for a disk flush. The
+            # outer FRAGMENT lock is still held, so no append to THIS
+            # fragment can land before the truncate below.
+            _fsync_dir(os.path.dirname(path))
+            with self._lock:
+                if self.holder.fragment(index, field, view, shard) is not frag:
+                    # Deleted between publish and fsync: the subtree
+                    # rename already carried our file away; nothing to
+                    # truncate (the writer was closed by the deleter).
+                    return
                 # Snapshot is durable; only now may the WAL be
                 # discarded. The outer fragment lock keeps the WAL
                 # truncation atomic with the snapshot (no append may

@@ -145,8 +145,17 @@ def test_http_resize_remove_node():
         # ephemeral ports, sorted(addrs)[-1] is addrs[0] ~1/3 of the time.
         victim = sorted(a for a in addrs if a != addrs[0])[-1]
         post("/cluster/resize/remove-node", json.dumps({"id": victim}))
-        st = json.loads(urllib.request.urlopen(base + "/status",
-                                               timeout=10).read())
+        # Removal may have been FORWARDED to the flagged coordinator and
+        # run async there; poll for the committed 2-node ring.
+        import time as _time
+        deadline = _time.time() + 30
+        st = {}
+        while _time.time() < deadline:
+            st = json.loads(urllib.request.urlopen(base + "/status",
+                                                   timeout=10).read())
+            if len(st["nodes"]) == 2:
+                break
+            _time.sleep(0.3)
         assert len(st["nodes"]) == 2
         nodes[[i for i, a in enumerate(addrs) if a == victim][0]].close()
         assert post("/index/i/query", "Count(Row(f=1))") == \
@@ -863,3 +872,66 @@ def test_writes_racing_a_live_join_converge():
                 n.close()
             except Exception:
                 pass
+
+
+def test_cleaner_never_runs_mid_resize():
+    """The holder GC must not compute ownership under a mid-resize ring:
+    it would delete fragments a target just streamed in for its
+    NEW-ring shards (permanent loss once the old owner leaves)."""
+    from pilosa_tpu.cluster import STATE_NORMAL, STATE_RESIZING
+    from pilosa_tpu.cluster.cleaner import clean_holder
+    from pilosa_tpu.cluster.harness import LocalCluster
+
+    lc = LocalCluster(2)
+    seed(lc, n_shards=4)
+    b = lc[1]
+    # Give B a fragment it does NOT own so the cleaner would bite.
+    unowned = [s for s in range(4)
+               if not any(n.id == "node1"
+                          for n in b.cluster.shard_nodes("i", s))]
+    assert unowned
+    v = b.holder.index("i").field("f").create_view_if_not_exists("standard")
+    v.create_fragment_if_not_exists(unowned[0]).set_bit(1, 5)
+
+    b.cluster.set_state(STATE_RESIZING)
+    assert clean_holder(b.holder, b.cluster) == 0, \
+        "cleaner ran under a mid-resize ring"
+    assert v.fragment(unowned[0]) is not None
+    b.cluster.set_state(STATE_NORMAL)
+    assert clean_holder(b.holder, b.cluster) >= 1
+    assert v.fragment(unowned[0]) is None
+
+
+def test_topology_version_survives_restart(tmp_path):
+    """The committed ring + version persist (reference .topology file):
+    a restarted coordinator must not reset to version 0 — its next
+    commit would broadcast a version every peer rejects as stale,
+    forking the cluster."""
+    import json
+    import os
+
+    from pilosa_tpu.server.node import ServerNode
+
+    ports = _free_ports(2)
+    addrs = [f"127.0.0.1:{p}" for p in ports]
+    d0 = str(tmp_path / "n0")
+    n0 = ServerNode(bind=addrs[0], peers=[addrs[1]], data_dir=d0,
+                    use_planner=False, anti_entropy_interval=0.0,
+                    check_nodes_interval=0.0)
+    n0.open()
+    try:
+        n0.cluster.topology_version = 7
+        n0.cluster.notify_topology()
+        assert json.load(open(os.path.join(d0, "topology.json")))[
+            "version"] == 7
+    finally:
+        n0.close()
+
+    reborn = ServerNode(bind=addrs[0], peers=[addrs[1]], data_dir=d0,
+                        use_planner=False, anti_entropy_interval=0.0,
+                        check_nodes_interval=0.0)
+    reborn.open()
+    try:
+        assert reborn.cluster.topology_version == 7
+    finally:
+        reborn.close()
