@@ -422,6 +422,10 @@ def _build_routes(api: API):
         if api.cluster is not None:
             for n in api.cluster.nodes:
                 n.is_coordinator = (n.id == req.get("id"))
+            # Persist the handoff: a restart must not resurrect the OLD
+            # coordinator flag from topology.json (resizes would consult
+            # the wrong node as the resize authority).
+            api.cluster.notify_topology()
         return 200, {}
 
     def get_fragment_blocks(pv, params, body):

@@ -176,24 +176,47 @@ class ServerNode:
         import os as _os
 
         path = _os.path.join(data_dir, "topology.json")
+        save_lock = threading.Lock()
+        last_saved = [-1]
 
         def save() -> None:
             with self.cluster._lock:
                 doc = {"version": self.cluster.topology_version,
+                       "replicaN": self.cluster.replica_n,
+                       "partitionN": self.cluster.partition_n,
                        "nodes": [n.to_json() for n in self.cluster.nodes]}
-            tmp = f"{path}.{_os.getpid()}.tmp"
-            with open(tmp, "w") as f:
-                _json.dump(doc, f)
-            _os.replace(tmp, path)
+            # Serialize + version-guard the replace: two concurrent
+            # savers (a status RPC and a sweep) must not interleave
+            # writes in one tmp, and the one holding the OLDER snapshot
+            # must not win the replace — a restart would restore the
+            # older ring and fork the cluster (the bug this file
+            # exists to prevent). Same pattern as DiskStore.save_schema.
+            with save_lock:
+                if doc["version"] < last_saved[0]:
+                    return
+                tmp = f"{path}.{_os.getpid()}.{threading.get_ident()}.tmp"
+                with open(tmp, "w") as f:
+                    _json.dump(doc, f)
+                _os.replace(tmp, path)
+                last_saved[0] = doc["version"]
 
         self.cluster.save_hook = save
+        # Sweep tmps a crashed saver stranded (see DiskStore.open).
+        try:
+            for fn in _os.listdir(data_dir):
+                if fn.startswith("topology.json.") and fn.endswith(".tmp"):
+                    _os.remove(_os.path.join(data_dir, fn))
+        except OSError:
+            pass
         try:
             with open(path) as f:
                 doc = _json.load(f)
-        except (OSError, ValueError):
+            version = int(doc.get("version", 0))
+            saved = [Node.from_json(n) for n in doc.get("nodes", [])]
+        except Exception:
+            # Best-effort restore: a torn/hand-edited file must fall
+            # back to the boot peer list, never crash the boot.
             return
-        version = int(doc.get("version", 0))
-        saved = [Node.from_json(n) for n in doc.get("nodes", [])]
         if version <= self.cluster.topology_version or not saved:
             return
         if not any(n.id == self.id for n in saved):
@@ -202,6 +225,14 @@ class ServerNode:
             return
         self.cluster.nodes = sorted(saved, key=lambda n: n.id)
         self.cluster.topology_version = version
+        # Settings adopted from broadcasts are part of the ring: a
+        # restart that reverted to boot-config replicaN would compute
+        # different placement and the cleaner would GC live replicas.
+        if doc.get("replicaN"):
+            self.cluster.replica_n = int(doc["replicaN"])
+        if doc.get("partitionN"):
+            self.cluster.partition_n = int(doc["partitionN"])
+        last_saved[0] = version
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -476,17 +507,6 @@ class ServerNode:
                 self.store.delete_subtree_files(*prefix)
         elif t == "node-join" and self.cluster is not None:
             self.handle_join(message["addr"])
-        elif t == "resize-remove-node" and self.cluster is not None:
-            # Forwarded from a non-coordinator's /cluster/resize/
-            # remove-node; run the job here (possibly long) off the
-            # RPC thread like a join.
-            def _run_remove(nid=message.get("id")):
-                try:
-                    self.resize("remove", node_id=nid)
-                except (RuntimeError, ConnectionError, ValueError):
-                    pass
-            threading.Thread(target=_run_remove, daemon=True,
-                             name="resize-remove").start()
         else:
             handle_cluster_message(self.holder, message)
 
@@ -530,18 +550,20 @@ class ServerNode:
         cluster.go:1447): a second request while one runs is rejected."""
         if self.cluster is None:
             raise RuntimeError("standalone node cannot resize")
-        # Resizes RUN on the flagged coordinator, like joins: the
-        # stuck-RESIZING recovery heuristic consults the coordinator's
-        # state as the resize authority, so a job running anywhere else
-        # would make that heuristic (a) never recover if this node died
-        # mid-job, or (b) falsely reopen peer gates while the job lives.
+        # Resizes RUN on the flagged coordinator: the stuck-RESIZING
+        # recovery heuristic consults the coordinator's state as the
+        # resize authority, so a job running anywhere else would make
+        # that heuristic (a) never recover if this node died mid-job,
+        # or (b) falsely reopen peer gates while the job lives.
+        # Non-coordinators REFUSE with the coordinator's address, like
+        # the reference (cluster.go:1870) — forwarding fire-and-forget
+        # would hide failures from the operator, and divergent
+        # coordinator views could ping-pong the message forever.
         coord = self.cluster.coordinator()
         if coord is not None and coord.id != self.id:
-            if action == "remove":
-                self.cluster.client.send_message(
-                    coord, {"type": "resize-remove-node", "id": node_id})
-                return "FORWARDED"
-            raise RuntimeError("resize must run on the coordinator")
+            raise RuntimeError(
+                "node removal requests are only valid on the coordinator "
+                f"node: {coord.id}")
         from pilosa_tpu.cluster.node import URI, Node
         from pilosa_tpu.cluster.resize import ResizeJob
         new_nodes = [Node(id=n.id, uri=n.uri, is_coordinator=n.is_coordinator)

@@ -110,6 +110,7 @@ def test_http_resize_remove_node():
     resize route, data remains queryable."""
     import json
     import socket
+    import urllib.error
     import urllib.request
     from pilosa_tpu.server.node import ServerNode
 
@@ -141,21 +142,31 @@ def test_http_resize_remove_node():
         assert post("/index/i/query", "Count(Row(f=1))") == \
             {"results": [len(cols)]}
 
-        # Never remove the node we keep querying (addrs[0]): with random
-        # ephemeral ports, sorted(addrs)[-1] is addrs[0] ~1/3 of the time.
-        victim = sorted(a for a in addrs if a != addrs[0])[-1]
-        post("/cluster/resize/remove-node", json.dumps({"id": victim}))
-        # Removal may have been FORWARDED to the flagged coordinator and
-        # run async there; poll for the committed 2-node ring.
-        import time as _time
-        deadline = _time.time() + 30
-        st = {}
-        while _time.time() < deadline:
-            st = json.loads(urllib.request.urlopen(base + "/status",
-                                                   timeout=10).read())
-            if len(st["nodes"]) == 2:
-                break
-            _time.sleep(0.3)
+        # Removals only run on the coordinator (reference
+        # cluster.go:1870: non-coordinators refuse, naming it). Find it
+        # from /status and never remove it or the node we query.
+        st = json.loads(urllib.request.urlopen(base + "/status",
+                                               timeout=10).read())
+        coord_id = next(n["id"] for n in st["nodes"] if n["isCoordinator"])
+        coord_base = f"http://{coord_id}"
+        victim = next(a for a in sorted(addrs, reverse=True)
+                      if a != coord_id and a != addrs[0])
+        # A non-coordinator refuses with the coordinator's address.
+        non_coord = next(a for a in addrs if a != coord_id)
+        try:
+            r = urllib.request.Request(
+                f"http://{non_coord}/cluster/resize/remove-node",
+                data=json.dumps({"id": victim}).encode(), method="POST")
+            urllib.request.urlopen(r, timeout=10)
+            assert False, "non-coordinator accepted a removal"
+        except urllib.error.HTTPError as e:
+            assert coord_id in e.read().decode()
+        r = urllib.request.Request(
+            coord_base + "/cluster/resize/remove-node",
+            data=json.dumps({"id": victim}).encode(), method="POST")
+        urllib.request.urlopen(r, timeout=60).read()
+        st = json.loads(urllib.request.urlopen(base + "/status",
+                                               timeout=10).read())
         assert len(st["nodes"]) == 2
         nodes[[i for i, a in enumerate(addrs) if a == victim][0]].close()
         assert post("/index/i/query", "Count(Row(f=1))") == \
