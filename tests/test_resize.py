@@ -932,9 +932,17 @@ def test_topology_version_survives_restart(tmp_path):
     n0.open()
     try:
         n0.cluster.topology_version = 7
+        n0.cluster.replica_n = 2  # adopted from a broadcast, say
+        n0.cluster.notify_topology()
+        doc = json.load(open(os.path.join(d0, "topology.json")))
+        assert doc["version"] == 7 and doc["replicaN"] == 2
+        # Monotonic guard: a straggling saver holding an OLDER snapshot
+        # must not win the replace.
+        n0.cluster.topology_version = 5
         n0.cluster.notify_topology()
         assert json.load(open(os.path.join(d0, "topology.json")))[
             "version"] == 7
+        n0.cluster.topology_version = 7
     finally:
         n0.close()
 
@@ -944,5 +952,6 @@ def test_topology_version_survives_restart(tmp_path):
     reborn.open()
     try:
         assert reborn.cluster.topology_version == 7
+        assert reborn.cluster.replica_n == 2
     finally:
         reborn.close()
