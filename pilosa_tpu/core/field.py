@@ -293,7 +293,16 @@ class Field:
         return out
 
     def add_remote_available_shards(self, shards: Iterable[int]) -> None:
-        self.remote_available_shards |= set(shards)
+        new = set(shards) - self.remote_available_shards
+        if not new:
+            return
+        self.remote_available_shards |= new
+        # The shard set is part of query routing (and memoized on the
+        # index epoch): an advertisement must invalidate, or queries
+        # keep running against the pre-advert shard list. notify=False:
+        # this isn't a local write, so no dirty re-broadcast.
+        if self.epoch is not None:
+            self.epoch.bump(notify=False)
 
     def remove_remote_available_shard(self, shard: int) -> None:
         """Forget a remotely-advertised shard (reference
@@ -301,7 +310,10 @@ class Field:
         /internal/.../remote-available-shards/{shard}): used when the
         cluster learns a remote shard no longer exists, so queries stop
         fanning out to it."""
-        self.remote_available_shards.discard(int(shard))
+        if int(shard) in self.remote_available_shards:
+            self.remote_available_shards.discard(int(shard))
+            if self.epoch is not None:
+                self.epoch.bump(notify=False)
 
     # -- bit ops -----------------------------------------------------------
 

@@ -104,6 +104,8 @@ class Index:
         #: (e.g. how many bit planes a comparator reads), so they key on
         #: this, separately from the data epoch.
         self.schema_epoch = Epoch()
+        #: (epoch stamp, frozenset) memo for available_shards().
+        self._avail_shards_cache: tuple | None = None
         self.fields: dict[str, Field] = {}
         self.column_attr_store = AttrStore(epoch=self.epoch)
         self.translate_store = TranslateStore()
@@ -177,11 +179,21 @@ class Index:
     # -- shards ------------------------------------------------------------
 
     def available_shards(self) -> set[int]:
-        """Union over fields (reference index.go:292)."""
+        """Union over fields (reference index.go:292). Memoized on the
+        (data, schema) epoch pair: every query start calls this, and for
+        a time field the underlying walk visits hundreds of time views —
+        ~0.7 ms per call that turned sub-ms cached reads into
+        millisecond ones. Any write or schema change invalidates."""
+        stamp = (self.epoch.value, self.schema_epoch.value)
+        cached = self._avail_shards_cache
+        if cached is not None and cached[0] == stamp:
+            return set(cached[1])
         out: set[int] = set()
         for f in self.fields.values():
             out |= f.available_shards()
-        return out or {0}
+        out = out or {0}
+        self._avail_shards_cache = (stamp, frozenset(out))
+        return out
 
     # -- schema ------------------------------------------------------------
 

@@ -69,6 +69,13 @@ class View:
                                 stats=self.stats, op_writer=op_writer,
                                 mutex=self.mutex, epoch=self.epoch)
                 self.fragments[shard] = frag
+                # Registration changes the shard set even with zero bits
+                # (an empty roaring import still creates the fragment):
+                # the index-level available_shards() memo keys on the
+                # epoch, so it must see this. notify=False — not a data
+                # write.
+                if self.epoch is not None:
+                    self.epoch.bump(notify=False)
                 if self.fragment_listener:
                     self.fragment_listener(self.index, self.field, self.name, shard)
             return frag
@@ -81,7 +88,10 @@ class View:
         holder.go:1126). In-flight queries holding the object finish on
         the orphan; new lookups miss."""
         with self._lock:
-            return self.fragments.pop(shard, None) is not None
+            gone = self.fragments.pop(shard, None) is not None
+        if gone and self.epoch is not None:
+            self.epoch.bump(notify=False)  # shard-set memo must see it
+        return gone
 
     # -- bit ops -----------------------------------------------------------
 
