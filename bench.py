@@ -107,13 +107,26 @@ def bench_star_trace(extra):
     t0 = time.perf_counter()
     f.import_bits(row1, fpos)
     import_s = time.perf_counter() - t0
-    del fpos
     gpos = _rand_positions(rng, n_bits, N_COLS)
     t0 = time.perf_counter()
     g.import_bits(row2, gpos)
     import_s += time.perf_counter() - t0
     del gpos
-    extra["import_mbits_per_s"] = round(2 * n_bits / import_s / 1e6, 1)
+    # Median of 3 like the BSI metrics: identical imports on this
+    # shared vCPU swing 2x with scheduler luck, and a single-shot
+    # number inherits whatever minute the host was having (observed
+    # 57-122 Mbit/s for the same code). Extra trials land in throwaway
+    # fields re-importing fpos; the f/g fields above stay for the
+    # query benchmarks.
+    rates = [2 * n_bits / import_s / 1e6]
+    for t in range(2):
+        ft = idx.create_field(f"imp{t}")
+        t0 = time.perf_counter()
+        ft.import_bits(row1, fpos)
+        rates.append(n_bits / (time.perf_counter() - t0) / 1e6)
+        idx.delete_field(f"imp{t}")
+    del fpos
+    extra["import_mbits_per_s"] = round(statistics.median(rates), 1)
 
     # ---- CPU baselines over the same dense blocks ----
     blocks_f = [h.fragment("bench", "f", "standard", s) for s in range(n_shards)]
