@@ -1,0 +1,137 @@
+"""Hermetic CPU-pinned subprocess spawning for the multi-chip dryruns.
+
+The rig that drives this repo pins every Python process to its TPU tunnel
+three different ways (VERDICT r4 weak #1):
+
+- ``PYTHONPATH`` carries a directory whose ``sitecustomize.py``
+  force-registers the TPU PJRT plugin at interpreter startup, so
+  ``JAX_PLATFORMS=cpu`` in the *environment* does not keep the plugin
+  from loading; only an in-process ``jax.config.update`` does.  Any jax
+  op issued before that update dispatches onto the TPU backend — fatal
+  whenever the rig's libtpu client/terminal versions drift (the
+  MULTICHIP_r04 failure signature).
+- ``JAX_PLATFORMS`` / ``PALLAS_AXON_*`` / ``AXON_*`` select the plugin
+  by environment.
+- ``TPU_*`` / ``LIBTPU*`` configure the chip itself.
+
+The multi-chip correctness evidence (MULTICHIP_r*.json) must run on the
+virtual-device CPU backend, so every subprocess in the dryrun chain is
+spawned through this module:
+
+1. ``scrubbed_env`` drops every plugin-selecting variable **and** every
+   ``PYTHONPATH`` entry that carries a ``sitecustomize``/``usercustomize``;
+2. children run under ``python -I`` (isolated mode: ``PYTHONPATH`` and
+   user-site are never consulted, so no sitecustomize can load even if a
+   poisoned path survives the scrub);
+3. ``assert_cpu_backend`` hard-fails with a diagnostic naming the leak
+   before the first real jax op if a TPU backend still won.
+
+Kept import-light (os/sys only — no jax) so the driver process can import
+it without initializing a backend of its own.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+#: environment prefixes that select or configure an accelerator plugin.
+SCRUB_PREFIXES = ("TPU_", "LIBTPU", "AXON_", "PALLAS_AXON_", "JAX_",
+                  "PJRT_")
+
+#: module names whose presence in a PYTHONPATH entry marks it as a
+#: startup-hook directory (imported by ``site`` before any user code).
+_SITE_HOOKS = ("sitecustomize.py", "usercustomize.py")
+
+
+def _is_site_hook_dir(path: str) -> bool:
+    for hook in _SITE_HOOKS:
+        try:
+            os.stat(os.path.join(path, hook))
+            return True
+        except FileNotFoundError:
+            continue
+        except OSError:
+            return True  # unreadable — treat as hostile
+    return False
+
+
+
+def scrubbed_env(n_devices: int | None = None) -> dict[str, str]:
+    """A copy of ``os.environ`` safe for a CPU-pinned jax child.
+
+    Drops every ``SCRUB_PREFIXES`` variable, removes ``PYTHONPATH``
+    entries that contain a site-customization hook, pins
+    ``JAX_PLATFORMS=cpu``, and (when ``n_devices``) rewrites
+    ``XLA_FLAGS`` with the virtual-device count.
+    """
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(SCRUB_PREFIXES)}
+    parts = [p for p in env.pop("PYTHONPATH", "").split(os.pathsep)
+             if p and not _is_site_hook_dir(p)]
+    if parts:
+        env["PYTHONPATH"] = os.pathsep.join(parts)
+    env["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+        env["XLA_FLAGS"] = " ".join(flags)
+    return env
+
+
+def pin_preamble(n_devices: int, repo_dir: str,
+                 assert_backend: bool = True) -> str:
+    """Source prefix for a ``python -I -c`` child: re-pins the CPU
+    backend *inside* the process (a surviving startup hook may have
+    rewritten the environment between exec and user code), restores the
+    repo on ``sys.path`` (isolated mode cleared it), and optionally
+    asserts the backend before any caller op.
+
+    Callers that must run ``jax.distributed.initialize`` pass
+    ``assert_backend=False`` and place ``assert_cpu_backend()``
+    themselves *after* the initialize (backend init must not precede
+    it).
+    """
+    code = (
+        "import os, sys\n"
+        f"sys.path.insert(0, {repo_dir!r})\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "_flags = [f for f in os.environ.get('XLA_FLAGS', '').split()\n"
+        "          if 'xla_force_host_platform_device_count' not in f]\n"
+        f"_flags.append('--xla_force_host_platform_device_count"
+        f"={n_devices}')\n"
+        "os.environ['XLA_FLAGS'] = ' '.join(_flags)\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+    )
+    if assert_backend:
+        code += ("from pilosa_tpu.cleanspawn import assert_cpu_backend\n"
+                 "assert_cpu_backend()\n")
+    return code
+
+
+def command(body: str) -> list[str]:
+    """argv for an isolated-mode child running ``body``."""
+    return [sys.executable, "-I", "-c", body]
+
+
+def assert_cpu_backend() -> None:
+    """Initialize jax's backend and die loudly if it is not CPU.
+
+    Called as the first backend-touching statement of every dryrun
+    child: a non-CPU default backend here means an accelerator plugin
+    leaked through the scrub, and every subsequent op would ride the
+    TPU tunnel — the exact failure MULTICHIP_r04 recorded.  The
+    diagnostic names the surviving environment so the leak is
+    actionable, not mysterious.
+    """
+    import jax
+    backend = jax.default_backend()
+    if backend != "cpu":
+        leaks = {k: v for k, v in os.environ.items()
+                 if k.startswith(SCRUB_PREFIXES) or k == "PYTHONPATH"}
+        raise SystemExit(
+            f"dryrun child initialised jax backend {backend!r}, not 'cpu'. "
+            f"An accelerator plugin leaked past the scrub "
+            f"(isolated={sys.flags.isolated}). Surviving env: {leaks}")

@@ -203,41 +203,31 @@ def run_multiprocess_dryrun(n_procs: int = 2, devs_per_proc: int = 4,
     s.close()
     coord = f"127.0.0.1:{port}"
 
+    from pilosa_tpu import cleanspawn
+
     procs = []
     for pid in range(n_procs):
-        env = {k: v for k, v in os.environ.items()
-               if not k.startswith(("TPU_", "LIBTPU"))}
-        flags = [fl for fl in env.get("XLA_FLAGS", "").split()
-                 if "xla_force_host_platform_device_count" not in fl]
-        flags.append(
-            f"--xla_force_host_platform_device_count={devs_per_proc}")
-        env["XLA_FLAGS"] = " ".join(flags)
-        env["JAX_PLATFORMS"] = "cpu"
-        # Backend pinning happens INSIDE the child before the jax
-        # import (a sitecustomize may rewrite env on startup — same
-        # defence as __graft_entry__.dryrun_multichip), and
-        # jax.distributed.initialize runs before importing pilosa_tpu,
-        # whose module-level jnp constants would initialise the backend.
+        env = cleanspawn.scrubbed_env(devs_per_proc)
+        # Backend pinning happens INSIDE the hermetic child (cleanspawn:
+        # python -I, scrubbed env — no sitecustomize can re-register the
+        # TPU plugin).  jax.distributed.initialize runs before the
+        # backend assertion (backend init must not precede it) and
+        # before importing pilosa_tpu, whose module-level jnp constants
+        # would initialise the backend.
         code = (
-            "import os, sys\n"
-            "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
-            "flags = [f for f in os.environ.get('XLA_FLAGS', '').split()\n"
-            "         if 'xla_force_host_platform_device_count' not in f]\n"
-            f"flags.append('--xla_force_host_platform_device_count="
-            f"{devs_per_proc}')\n"
-            "os.environ['XLA_FLAGS'] = ' '.join(flags)\n"
-            f"sys.path.insert(0, {_REPO_DIR!r})\n"
-            "import jax\n"
-            "jax.config.update('jax_platforms', 'cpu')\n"
-            "jax.distributed.initialize(coordinator_address=sys.argv[1],\n"
+            cleanspawn.pin_preamble(devs_per_proc, _REPO_DIR,
+                                    assert_backend=False)
+            + "jax.distributed.initialize(coordinator_address=sys.argv[1],\n"
             "                           num_processes=int(sys.argv[2]),\n"
             "                           process_id=int(sys.argv[3]))\n"
+            "from pilosa_tpu.cleanspawn import assert_cpu_backend\n"
+            "assert_cpu_backend()\n"
             "from pilosa_tpu.parallel import multihost\n"
             "sys.exit(multihost._worker_main(sys.argv[1:]))\n"
         )
         procs.append(subprocess.Popen(
-            [sys.executable, "-c", code, coord, str(n_procs), str(pid),
-             str(devs_per_proc)],
+            cleanspawn.command(code) + [coord, str(n_procs), str(pid),
+                                        str(devs_per_proc)],
             env=env, cwd=_REPO_DIR, stdout=subprocess.PIPE,
             stderr=subprocess.PIPE, text=True))
     outs = []

@@ -1,0 +1,85 @@
+"""Hermetic-spawn tests (VERDICT r4 round-5 task #1).
+
+The rig's ``PYTHONPATH`` sitecustomize force-registers the TPU plugin in
+every Python process, so the multi-chip dryrun chain must survive a
+hostile startup hook.  These tests *inject* a poisoned sitecustomize
+(one that kills any interpreter importing it) plus fake plugin-selector
+env vars, prove a plain child dies from it, and prove every spawn path
+of the dryrun chain does not.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from pilosa_tpu import cleanspawn
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def poisoned_env(tmp_path, monkeypatch):
+    """A sitecustomize dir that exits 86 on import, wired into
+    PYTHONPATH alongside fake plugin-selector vars."""
+    site = tmp_path / "poison_site"
+    site.mkdir()
+    (site / "sitecustomize.py").write_text(
+        "import sys\nsys.exit(86)  # poisoned: import means non-isolation\n")
+    monkeypatch.setenv("PYTHONPATH", str(site))
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+    monkeypatch.setenv("AXON_LOOPBACK_RELAY", "1")
+    monkeypatch.setenv("TPU_SKIP_MDS_QUERY", "1")
+    monkeypatch.setenv("JAX_PLATFORMS", "no_such_platform")
+    return site
+
+
+def test_poison_control_kills_plain_child(poisoned_env):
+    # Control: a NON-hermetic child imports the sitecustomize and dies —
+    # proving the poison is live and the survival tests below mean
+    # something.
+    proc = subprocess.run([sys.executable, "-c", "print('alive')"],
+                          env=dict(os.environ), capture_output=True,
+                          text=True, timeout=60)
+    # CPython surfaces the sitecustomize SystemExit as a fatal
+    # site-import error; any nonzero exit without our payload proves
+    # the hook ran.
+    assert proc.returncode != 0, (proc.returncode, proc.stderr)
+    assert "poisoned" in proc.stderr
+    assert "alive" not in proc.stdout
+
+
+def test_scrubbed_env_drops_selectors_and_hook_paths(poisoned_env):
+    env = cleanspawn.scrubbed_env(4)
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert "--xla_force_host_platform_device_count=4" in env["XLA_FLAGS"]
+    for k in env:
+        assert not k.startswith(("TPU_", "AXON_", "PALLAS_AXON_", "LIBTPU"))
+    assert str(poisoned_env) not in env.get("PYTHONPATH", "")
+
+
+def test_hermetic_child_survives_poison(poisoned_env):
+    code = (cleanspawn.pin_preamble(2, REPO)
+            + "import jax\n"
+            "assert jax.default_backend() == 'cpu'\n"
+            "assert len(jax.devices()) == 2, jax.devices()\n"
+            "print('hermetic-ok')\n")
+    proc = subprocess.run(cleanspawn.command(code),
+                          env=cleanspawn.scrubbed_env(2),
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "hermetic-ok" in proc.stdout
+
+
+@pytest.mark.slow
+def test_dryrun_chain_survives_poison(poisoned_env):
+    # The artifact-of-record path end to end: dryrun_multichip spawns the
+    # single-process mesh body AND the multi-process jax.distributed leg,
+    # each through cleanspawn, with the poison armed in os.environ.
+    sys.path.insert(0, REPO)
+    try:
+        import __graft_entry__
+        __graft_entry__.dryrun_multichip(2)
+    finally:
+        sys.path.remove(REPO)
