@@ -2,26 +2,27 @@
 
 The HTTP scatter-gather cluster path (cluster.map_reduce) mirrors the
 reference's architecture: one planner mesh per node, JSON/frames between
-nodes. This module is the TPU-NATIVE alternative SURVEY planned: N
+nodes.  This module is the TPU-NATIVE alternative SURVEY planned: N
 processes (hosts) × M chips form ONE ``jax.sharding.Mesh`` via
-``jax.distributed``; the planner's shard axis spans processes, and the
-cross-shard reduction runs as an XLA collective over ICI/DCN instead of
-an HTTP reduce at a coordinator.
+``jax.distributed``, and the REAL executor + planner
+(parallel.distributed.DistributedExecutor / DistributedMeshPlanner) run
+the full PQL surface over it — leaf stacks assembled per process with
+``jax.make_array_from_single_device_arrays``, cross-shard reductions as
+XLA collectives over ICI/DCN, host metadata merges as pickle-allgathers
+on the distributed runtime.
 
-Layout contract: global shard s lives on global mesh position
-``s % (P*M)``'s process (round-robin by stack row, exactly how
-``make_mesh``'s single-host planner lays out its stacks), i.e. each
-process imports and stacks ONLY the shard rows its addressable devices
-own; ``assemble_global`` stitches the per-process slices into one global
-array with ``jax.make_array_from_single_device_arrays`` — no host ever
-materializes the whole index.
+Layout contract: the global sorted shard list, laid out over the mesh's
+``shard`` axis, must place each process's owned shards on that process's
+devices — here (and in any contiguous-partition deployment) process p of
+P owns shards ``[p*S/P, (p+1)*S/P)``.  DistributedMeshPlanner checks the
+contract on every stack build.
 
 Validated on CPU (``--xla_force_host_platform_device_count``) like every
 other multi-device path here; on real hardware the same code drives
 multi-host TPU pods (jax.distributed over the pod's coordinator).
 
-Reference analog: the NCCL/MPI multi-node execution the reference
-delegates to its cluster layer; here the compiler owns the collectives.
+Reference analog: executor.go:2455 mapReduce + remoteExec :2414 — the
+per-node HTTP fan-out this replaces with compiler-scheduled collectives.
 """
 
 from __future__ import annotations
@@ -53,148 +54,191 @@ def global_mesh(axis: str = "shard"):
     return Mesh(np.asarray(jax.devices()), (axis,))
 
 
-def assemble_global(mesh, local_rows: np.ndarray, axis: str = "shard"):
-    """Build a global [S_global, W] array from THIS process's rows.
-
-    ``local_rows`` is [S_local, W] where S_local = S_global / num
-    processes — the rows for this process's addressable devices, in
-    mesh order. Every process calls this with its own slice; the result
-    is one logical array sharded over the whole mesh."""
-    import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    sharding = NamedSharding(mesh, P(axis))
-    n_dev_global = len(mesh.devices.reshape(-1))
-    s_global = local_rows.shape[0] * jax.process_count()
-    assert s_global % n_dev_global == 0
-    per_dev = s_global // n_dev_global
-    local_devs = [d for d in mesh.devices.reshape(-1).tolist()
-                  if d.process_index == jax.process_index()]
-    shards = []
-    for i, d in enumerate(local_devs):
-        shards.append(jax.device_put(
-            local_rows[i * per_dev:(i + 1) * per_dev], d))
-    return jax.make_array_from_single_device_arrays(
-        (s_global,) + local_rows.shape[1:], sharding, shards)
-
-
-def count_intersect_program(mesh, axis: str = "shard"):
-    """The flagship fused kernel compiled over the GLOBAL mesh: popcount
-    of the intersection with the cross-shard (cross-HOST) reduction as
-    one XLA collective. Every process receives the replicated total."""
-    import jax
-    import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    in_s = NamedSharding(mesh, P(axis))
-    out_s = NamedSharding(mesh, P())  # replicated scalar
-
-    @jax.jit
-    def fn(a, b):
-        pc = jax.lax.population_count(jnp.bitwise_and(a, b))
-        return jnp.sum(pc.astype(jnp.int64))
-
-    return jax.jit(fn, in_shardings=(in_s, in_s), out_shardings=out_s)
-
-
 # ---------------------------------------------------------------------------
 # dryrun harness: N local processes emulate N hosts on the CPU backend.
 # ---------------------------------------------------------------------------
 
 
+def _canon(result):
+    """Comparable form of an executor result (host-only values)."""
+    from pilosa_tpu.core.row import Row
+    from pilosa_tpu.exec.result import (
+        GroupCount, Pair, RowIdentifiers, ValCount,
+    )
+    if isinstance(result, Row):
+        return ("row", tuple(int(c) for c in result.columns()))
+    if isinstance(result, ValCount):
+        return ("valcount", int(result.val), int(result.count))
+    if isinstance(result, Pair):
+        return ("pair", int(result.id), int(result.count))
+    if isinstance(result, RowIdentifiers):
+        return ("rowids", tuple(result.rows), tuple(result.keys))
+    if isinstance(result, list):
+        if result and isinstance(result[0], Pair):
+            return tuple((int(p.id), int(p.count)) for p in result)
+        if result and isinstance(result[0], GroupCount):
+            return tuple(
+                (tuple((fr.field, int(fr.row_id)) for fr in gc.group),
+                 int(gc.count))
+                for gc in result)
+        return tuple(result)
+    return result
+
+
+#: the read surface both executors answer each phase — Count over fused
+#: bitmap algebra (incl. Not/existence), BSI comparators, aggregates,
+#: TopN (plain + filtered + threshold), GroupBy, Rows, and a raw Row
+#: materialization.
+_READ_QUERIES = (
+    "Count(Intersect(Row(f=1), Not(Row(g=2))))",
+    "Count(Union(Row(f=0), Row(g=0), Row(f=2)))",
+    "Count(Xor(Row(f=1), Row(g=1)))",
+    "Count(Row(v >= 0))",
+    "Count(Row(v < -50))",
+    "Count(Row(v == 7))",
+    "Sum(field=v)",
+    "Sum(Row(f=1), field=v)",
+    "Min(field=v)",
+    "Max(field=v)",
+    "TopN(f, n=2)",
+    "TopN(f, Row(g=1), n=3)",
+    "TopN(g, threshold=2)",
+    "GroupBy(Rows(f), Rows(g))",
+    "GroupBy(Rows(f), Rows(g), filter=Row(v > 0))",
+    "Rows(f)",
+    "Row(f=2)",
+)
+
+
 def _worker_main(argv: Sequence[str]) -> int:
-    """Body of one emulated host. jax.distributed.initialize must have
-    ALREADY run (the spawn stub calls it before importing pilosa_tpu,
-    whose module-level jnp constants would otherwise initialise the
-    backend first)."""
+    """Body of one emulated host: a partitioned Holder owning only this
+    process's shards, the REAL DistributedExecutor over the global mesh,
+    and a full-dataset scalar oracle cross-checked on THIS process for
+    every query and every write phase (VERDICT r4 weak #3: visibility
+    asserted on every process, not just the owner)."""
     _, n_procs, pid, devs = (argv[0], int(argv[1]), int(argv[2]),
                              int(argv[3]))
     import jax
     assert jax.process_count() == n_procs
     assert jax.device_count() == n_procs * devs, jax.device_count()
 
-    from pilosa_tpu.config import SHARD_WIDTH, WORDS_PER_SHARD
-    from pilosa_tpu.core import Holder
+    from pilosa_tpu.config import SHARD_WIDTH
+    from pilosa_tpu.core import FieldOptions, Holder
+    from pilosa_tpu.core.field import FIELD_TYPE_INT
+    from pilosa_tpu.exec import Executor
+    from pilosa_tpu.parallel.distributed import (
+        DistributedExecutor,
+        DistributedMeshPlanner,
+    )
 
     mesh = global_mesh()
     n_shards = 2 * n_procs * devs  # 2 stack rows per device
     per_proc = n_shards // n_procs
+    my_shards = set(range(pid * per_proc, (pid + 1) * per_proc))
 
-    # Deterministic global dataset; each process IMPORTS ONLY ITS OWN
-    # shards (the cluster-node discipline) but can compute the global
-    # expected count host-side for the assertion.
+    # Deterministic global dataset; every process can generate it, but
+    # the distributed holder imports ONLY the owned slice (the
+    # cluster-node discipline); the oracle holder imports everything.
+    # The LAST shard starts empty: a later write into it exercises the
+    # first-fragment-in-a-new-shard metadata sync (every process's
+    # default shard list must grow identically).
     rng = np.random.default_rng(42)
     n_bits = 20_000
-    rows = np.ones(n_bits, dtype=np.uint64)
-    f_cols = rng.integers(0, n_shards * SHARD_WIDTH, n_bits,
-                          dtype=np.uint64)
-    g_cols = rng.integers(0, n_shards * SHARD_WIDTH, n_bits,
-                          dtype=np.uint64)
+    total_cols = (n_shards - 1) * SHARD_WIDTH
+    f_rows = rng.integers(0, 3, n_bits, dtype=np.uint64)
+    f_cols = rng.integers(0, total_cols, n_bits, dtype=np.uint64)
+    g_rows = rng.integers(0, 3, n_bits, dtype=np.uint64)
+    g_cols = rng.integers(0, total_cols, n_bits, dtype=np.uint64)
+    v_cols = rng.choice(total_cols, 4000, replace=False).astype(np.uint64)
+    v_vals = rng.integers(-100, 100, len(v_cols))
+    exist_cols = np.arange(0, total_cols, 3, dtype=np.uint64)
 
-    my_shards = list(range(pid * per_proc, (pid + 1) * per_proc))
-    lo_col = my_shards[0] * SHARD_WIDTH
-    hi_col = (my_shards[-1] + 1) * SHARD_WIDTH
+    def build_holder(owned: set[int] | None):
+        holder = Holder()
+        idx = holder.create_index("mh")
+        f = idx.create_field("f")
+        g = idx.create_field("g")
+        v = idx.create_field("v", FieldOptions(type=FIELD_TYPE_INT,
+                                               min=-100, max=100))
 
-    holder = Holder()
-    idx = holder.create_index("mh")
-    f = idx.create_field("f")
-    g = idx.create_field("g")
-    fm = (f_cols >= lo_col) & (f_cols < hi_col)
-    gm = (g_cols >= lo_col) & (g_cols < hi_col)
-    f.import_bits(rows[fm], f_cols[fm])
-    g.import_bits(rows[gm], g_cols[gm])
+        def mask(cols):
+            if owned is None:
+                return np.ones(len(cols), dtype=bool)
+            return np.isin((cols // SHARD_WIDTH).astype(np.int64),
+                           sorted(owned))
 
-    def stack_local(field):
-        out = np.zeros((len(my_shards), WORDS_PER_SHARD), dtype=np.uint32)
-        for i, s in enumerate(my_shards):
-            frag = holder.fragment("mh", field, "standard", s)
-            if frag is not None:
-                out[i] = np.asarray(frag.row_words(1))
-        return out
+        m = mask(f_cols)
+        f.import_bits(f_rows[m], f_cols[m])
+        m = mask(g_cols)
+        g.import_bits(g_rows[m], g_cols[m])
+        m = mask(v_cols)
+        v.import_values(v_cols[m].tolist(), v_vals[m].tolist())
+        idx.add_existence(exist_cols[mask(exist_cols)])
+        if owned is not None:
+            remote = set(range(n_shards)) - owned
+            for fld in (f, g, v, idx.existence_field()):
+                fld.add_remote_available_shards(remote)
+        return holder, idx
 
-    a = assemble_global(mesh, stack_local("f"))
-    b = assemble_global(mesh, stack_local("g"))
-    prog = count_intersect_program(mesh)
-    got = int(prog(a, b))
+    holder, idx = build_holder(my_shards)
+    planner = DistributedMeshPlanner(holder, mesh, my_shards)
+    executor = DistributedExecutor(holder, planner)
 
-    # Host-side oracle over the FULL dataset (any process can compute
-    # it: the generator is deterministic).
-    f_set = np.zeros(n_shards * SHARD_WIDTH, dtype=bool)
-    g_set = np.zeros(n_shards * SHARD_WIDTH, dtype=bool)
-    f_set[f_cols] = True
-    g_set[g_cols] = True
-    want = int(np.sum(f_set & g_set))
-    assert got == want, (got, want)
+    oracle_holder, _ = build_holder(None)
+    oracle = Executor(oracle_holder)  # scalar: no planner, no mesh
 
-    # Write step: process 0 flips a bit IN ITS OWN shard; every process
-    # re-runs the global program and sees the new total (the re-stack is
-    # local to the owner, the collective is global).
-    target_col = 5  # shard 0 → process 0
-    newly_set = not (f_set[target_col] and g_set[target_col])
-    if pid == 0:
-        f.set_bit(1, target_col)
-        g.set_bit(1, target_col)
-        a = assemble_global(mesh, stack_local("f"))
-        b = assemble_global(mesh, stack_local("g"))
-    got2 = int(prog(a, b))
-    want2 = want + (1 if newly_set else 0)
-    # Only the owner re-stacked; peers' arrays still produce the OLD
-    # value for their copy — but the shard axis partitions data, so the
-    # owner's contribution is authoritative: non-owners re-assemble from
-    # their (unchanged) local rows and join the same collective.
-    if pid == 0:
-        assert got2 == want2, (got2, want2)
-    print(f"multihost worker {pid}: ok count={got} -> "
-          f"{got2 if pid == 0 else want} mesh={mesh.shape} "
-          f"procs={n_procs}", flush=True)
+    def check_phase(phase: str):
+        for q in _READ_QUERIES:
+            (got,) = executor.execute("mh", q)
+            (want,) = oracle.execute("mh", q)
+            assert _canon(got) == _canon(want), (
+                f"pid {pid} phase {phase}: {q!r}: "
+                f"{_canon(got)!r} != {_canon(want)!r}")
+
+    check_phase("initial")
+
+    # Write phase: single-bit writes into a shard owned by EACH process
+    # (visibility must cross the process boundary both ways), BSI write,
+    # clear, and the multi-shard write paths (Store / ClearRow).  Both
+    # executors run the same PQL; the distributed one gates application
+    # to the owner and bumps epochs everywhere.
+    col_p0 = 5                            # shard 0 → process 0
+    col_p1 = (n_shards - 2) * SHARD_WIDTH + 7   # late shard → last process
+    col_new = (n_shards - 1) * SHARD_WIDTH + 11  # EMPTY shard → last proc
+    writes = (
+        f"Set({col_p0}, f=1)",
+        f"Set({col_p1}, f=1)",
+        f"Set({col_p1}, g=2)",
+        f"Set({col_new}, f=1)",   # first fragment in a fresh shard
+        f"Set({col_p0 + 2}, v=-3)",
+        f"Clear({col_p1}, g=2)",
+        "Store(Row(f=1), f=9)",
+    )
+    for w in writes:
+        (got,) = executor.execute("mh", w)
+        (want,) = oracle.execute("mh", w)
+        assert got == want, (pid, w, got, want)
+    # Oracle sanity: the cross-process bits actually changed something.
+    (after_f1,) = oracle.execute("mh", "Count(Row(f=1))")
+    assert after_f1 > 0
+    check_phase("after-writes")
+
+    executor.execute("mh", "ClearRow(f=9)")
+    oracle.execute("mh", "ClearRow(f=9)")
+    check_phase("after-clearrow")
+
+    print(f"multihost worker {pid}: ok "
+          f"queries={len(_READ_QUERIES)}x3phases writes={len(writes) + 1} "
+          f"mesh={mesh.shape} procs={n_procs} owned={sorted(my_shards)}",
+          flush=True)
     return 0
 
 
 def run_multiprocess_dryrun(n_procs: int = 2, devs_per_proc: int = 4,
                             timeout: float = 600.0) -> None:
     """Spawn n_procs fresh processes that form ONE jax.distributed mesh
-    on the CPU backend and run the sharded count + write step. Raises on
-    any worker failure."""
+    on the CPU backend and run the full executor surface + write phases
+    over it.  Raises on any worker failure."""
     import socket
 
     s = socket.socket()

@@ -236,7 +236,8 @@ class MeshPlanner:
         if not shards:
             return 0, 0
         _, exists, sign, stack, filt, depth = self._bsi_inputs(idx, c, shards)
-        cnt, pos, neg = bsi_ops.sum_counts(exists, sign, stack, filt, depth)
+        cnt, pos, neg = self._replicate_small(
+            *bsi_ops.sum_counts(exists, sign, stack, filt, depth))
         # Start all three device->host copies before reading any: the
         # copies pipeline, so total latency is ~one transfer round-trip
         # instead of three sequential ones (r2's 3x sum latency).
@@ -258,6 +259,9 @@ class MeshPlanner:
         _, exists, sign, stack, filt, depth = self._bsi_inputs(idx, c, shards)
         cons_cnt, alt_cnt, a, b = _agg_min_max(exists, sign, stack, filt,
                                                depth, is_min)
+        cons_cnt, alt_cnt, *flat = self._replicate_small(
+            cons_cnt, alt_cnt, *a, *b)
+        a, b = tuple(flat[:len(a)]), tuple(flat[len(a):])
         # One pipelined transfer wave for all eight outputs (r2 paid ~8
         # sequential round-trips here: Min was 2.5x slower than Sum).
         _copy_async(cons_cnt, alt_cnt, *a, *b)
@@ -415,10 +419,10 @@ class MeshPlanner:
         def rec(level: int, acc, prefix: tuple):
             for r in cands[level]:
                 stack = stacks[level][r]
-                nxt = stack if acc is None else _jit_and(acc, stack)
+                nxt = stack if acc is None else self._and(acc, stack)
                 if level == k - 1:
-                    cnt = _jit_and_count(nxt, filt) if filt is not None \
-                        else _jit_count(nxt)
+                    cnt = self._and_count(nxt, filt) if filt is not None \
+                        else self._count_arr(nxt)
                     pending.append(
                         (prefix + (r,),
                          self.batcher.submit(cnt, lambda h: h)))
@@ -620,14 +624,7 @@ class MeshPlanner:
         # to build the same stack; the second insert simply wins.
         if gens is None:
             gens = self._gens(idx.name, field_name, view, shards)
-        s_pad = self._pad(len(shards))
-        mat = np.zeros((s_pad, WORDS_PER_SHARD), dtype=np.uint32)
-        for i, shard in enumerate(shards):
-            frag = self.holder.fragment(idx.name, field_name, view, shard)
-            if frag is not None:
-                mat[i] = frag.row_words(row_id)
-        arr = jax.device_put(mat, shard_spec(self.mesh))
-        nbytes = mat.nbytes
+        arr, nbytes = self._build_stack(idx, field_name, view, row_id, shards)
         with self._cache_lock:
             old = self._stack_cache.pop(key, None)
             if old is not None:
@@ -640,13 +637,45 @@ class MeshPlanner:
             self._cache_bytes += nbytes
         return arr
 
+    def _build_stack(self, idx: Index, field_name: str, view: str,
+                     row_id: int, shards: tuple) -> tuple[jax.Array, int]:
+        """Materialize one row across ``shards`` as a device-put
+        ``[S_pad, W]`` stack. Overridden by the distributed planner to
+        assemble a global array from each process's local fragment rows
+        (jax.make_array_from_single_device_arrays)."""
+        s_pad = self._pad(len(shards))
+        mat = np.zeros((s_pad, WORDS_PER_SHARD), dtype=np.uint32)
+        for i, shard in enumerate(shards):
+            frag = self.holder.fragment(idx.name, field_name, view, shard)
+            if frag is not None:
+                mat[i] = frag.row_words(row_id)
+        return jax.device_put(mat, shard_spec(self.mesh)), mat.nbytes
+
+    def _zeros_stack(self, n_shards: int) -> jax.Array:
+        s_pad = self._pad(n_shards)
+        return jax.device_put(
+            np.zeros((s_pad, WORDS_PER_SHARD), dtype=np.uint32),
+            shard_spec(self.mesh))
+
+    # small-output hooks: the distributed planner re-shards device
+    # outputs to fully-replicated before any host read, so every process
+    # of the mesh can resolve them locally.
+    def _replicate_small(self, *arrays):
+        return arrays
+
+    def _and(self, a, b):
+        return _jit_and(a, b)
+
+    def _count_arr(self, a):
+        return _jit_count(a)
+
+    def _and_count(self, a, b):
+        return _jit_and_count(a, b)
+
     def _fetch_leaf(self, idx: Index, leaf: tuple, shards: tuple):
         kind = leaf[0]
         if kind == "zero":
-            s_pad = self._pad(len(shards))
-            return jax.device_put(
-                np.zeros((s_pad, WORDS_PER_SHARD), dtype=np.uint32),
-                shard_spec(self.mesh))
+            return self._zeros_stack(len(shards))
         if kind == "pred":
             lo, hi = bsi_ops.split_u64(leaf[1])
             return (np.uint32(lo), np.uint32(hi))
@@ -713,9 +742,14 @@ class MeshPlanner:
             def program(*args):
                 return evaluate(args)
 
-        fn = jax.jit(program)
+        fn = self._jit_program(program, reduce)
         self._fn_cache[full_sig] = fn
         return fn
+
+    def _jit_program(self, program: Callable, reduce: str | None) -> Callable:
+        """jit hook: the distributed planner replicates ``per_shard``
+        count outputs across the mesh so any process can host-read."""
+        return jax.jit(program)
 
 
 def _eval_node(sig: tuple, args) -> jax.Array:
