@@ -101,6 +101,9 @@ std::mutex g_pool_mu;
 std::vector<PoolChunk> g_pool_free;       // recycled, fault-warm chunks
 size_t g_pool_free_bytes = 0;
 size_t g_pool_limit = size_t(3) << 30;    // retained-bytes cap (3 GiB)
+bool g_pool_limit_explicit = false;       // set via pool_set_limit: an
+// operator-stated cap is a hard upper bound — pool_reserve must clamp
+// to it, never raise it (ADVICE r4 #4).
 int64_t g_pool_fresh_mmaps = 0;           // stats: cold allocations
 int64_t g_pool_recycled = 0;              // stats: warm allocations
 
@@ -425,15 +428,48 @@ int64_t pool_reserve(int64_t bytes) {
 #else
   if (bytes <= 0) return 0;
   size_t sz = pool_round(static_cast<size_t>(bytes));
+  {
+    // Size the reserve under the lock BEFORE faulting pages: with an
+    // operator-set cap (pool_set_limit) the cap is a hard bound — we
+    // clamp the reserve to the remaining headroom instead of raising
+    // the cap, and report the clamped size so the caller's top-up loop
+    // sees the truth.
+    std::lock_guard<std::mutex> g(g_pool_mu);
+    if (g_pool_limit_explicit) {
+      size_t headroom = g_pool_limit > g_pool_free_bytes
+                            ? g_pool_limit - g_pool_free_bytes : 0;
+      headroom &= ~(kPoolAlign - 1);
+      if (headroom == 0) return 0;
+      if (sz > headroom) sz = headroom;
+    }
+  }
   uint8_t* p = pool_mmap(sz);
   if (p == nullptr) return 0;
   std::memset(p, 0, sz);  // fault every page now, off the import path
   std::lock_guard<std::mutex> g(g_pool_mu);
-  // An explicit reserve states operator intent: the retained cap must
-  // cover it, or the eviction below would silently unmap the chunk we
-  // just faulted and report success anyway.
-  if (g_pool_limit < g_pool_free_bytes + sz)
-    g_pool_limit = g_pool_free_bytes + sz;
+  if (!g_pool_limit_explicit) {
+    // Without an operator cap, a reserve states intent and may grow
+    // the default cap to cover itself — but only now that the chunk
+    // exists (growing before a failed mmap would permanently inflate
+    // the cap with nothing to show for it).
+    if (g_pool_limit < g_pool_free_bytes + sz)
+      g_pool_limit = g_pool_free_bytes + sz;
+  } else if (g_pool_free_bytes + sz > g_pool_limit) {
+    // Headroom moved between the clamp and here (a concurrent
+    // pool_free refilled the pool): re-clamp by trimming the tail of
+    // the chunk we just faulted, so the return value never overstates
+    // what the pool retained.
+    size_t keep = g_pool_limit > g_pool_free_bytes
+                      ? (g_pool_limit - g_pool_free_bytes)
+                            & ~(kPoolAlign - 1)
+                      : 0;
+    if (keep == 0) {
+      pool_munmap(p, sz);
+      return 0;
+    }
+    pool_munmap(p + keep, sz - keep);
+    sz = keep;
+  }
   g_pool_free.push_back({p, sz});
   g_pool_free_bytes += sz;
   g_pool_fresh_mmaps++;
@@ -445,6 +481,7 @@ int64_t pool_reserve(int64_t bytes) {
 void pool_set_limit(int64_t bytes) {
   std::lock_guard<std::mutex> g(g_pool_mu);
   g_pool_limit = bytes < 0 ? 0 : static_cast<size_t>(bytes);
+  g_pool_limit_explicit = true;
   pool_enforce_limit_locked();
 }
 

@@ -126,22 +126,52 @@ def cmd_server(args) -> int:
     return 0
 
 
-def _post(host: str, path: str, body: bytes) -> dict:
-    req = urllib.request.Request(f"http://{host}{path}", data=body,
+def _base_url(host: str, tls: bool = False) -> str:
+    """Client base URL: honor an explicit scheme in --host, else pick
+    one from --tls (ADVICE r4 #3: a TLS-enabled server aborted imports
+    at the schema fetch because the scheme was hardcoded http)."""
+    if "://" in host:
+        return host.rstrip("/")
+    return ("https://" if tls else "http://") + host
+
+
+def _ssl_ctx(args):
+    if getattr(args, "tls_skip_verify", False):
+        import ssl
+        ctx = ssl.create_default_context()
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        return ctx
+    return None
+
+
+def _tls_args(args) -> tuple[bool, object]:
+    """(tls, ssl_context) for a client command; --tls-skip-verify
+    unambiguously signals TLS intent, so it implies --tls rather than
+    silently degrading the connection to plaintext."""
+    tls = bool(getattr(args, "tls", False)
+               or getattr(args, "tls_skip_verify", False))
+    return tls, _ssl_ctx(args)
+
+
+def _post(host: str, path: str, body: bytes, tls: bool = False,
+          ctx=None) -> dict:
+    req = urllib.request.Request(f"{_base_url(host, tls)}{path}", data=body,
                                  method="POST")
-    with urllib.request.urlopen(req, timeout=60) as resp:
+    with urllib.request.urlopen(req, timeout=60, context=ctx) as resp:
         return json.loads(resp.read() or b"{}")
 
 
-def _import_modes(host: str, index: str, field: str) -> tuple[bool, bool, bool]:
+def _import_modes(host: str, index: str, field: str, tls: bool = False,
+                  ctx=None) -> tuple[bool, bool, bool]:
     """(value_mode, row_keys, column_keys) from the server's schema —
     the reference's bufferers pick the import mode the same way
     (ctl/import.go:125-140: field.Options.Type / Keys)."""
     # A failed schema fetch must ABORT the import, not guess the mode:
     # posting an int field's (col,value) CSV as rowIDs/columnIDs would
     # silently write garbage bits instead of BSI values.
-    with urllib.request.urlopen(f"http://{host}/schema",
-                                timeout=30) as resp:
+    with urllib.request.urlopen(f"{_base_url(host, tls)}/schema",
+                                timeout=30, context=ctx) as resp:
         schema = json.load(resp).get("indexes") or []
     for idx in schema:
         if idx.get("name") != index:
@@ -162,9 +192,10 @@ def cmd_import(args) -> int:
     fields take (row,col[,timestamp]) rows, int fields take
     (col,value), and keyed indexes/fields accept string keys in place
     of ids (reference ctl/import.go:125-140 + ImportK)."""
+    tls, ctx = _tls_args(args)
     try:
         value_mode, row_keys, col_keys = _import_modes(
-            args.host, args.index, args.field)
+            args.host, args.index, args.field, tls=tls, ctx=ctx)
     except Exception as e:
         print(f"import: cannot read schema from {args.host}: {e}",
               file=sys.stderr)
@@ -186,7 +217,7 @@ def cmd_import(args) -> int:
         body["columnKeys" if col_keys else "columnIDs"] = cols
         _post(args.host, f"/index/{args.index}/field/{args.field}/import"
                          + ("?clear=1" if args.clear else ""),
-              json.dumps(body).encode())
+              json.dumps(body).encode(), tls=tls, ctx=ctx)
         rows, cols, vals, stamps = [], [], [], []
 
     def parse_id(tok: str, keyed: bool):
@@ -221,17 +252,19 @@ def cmd_import(args) -> int:
 
 
 def cmd_export(args) -> int:
+    tls, ctx = _tls_args(args)
+    base = _base_url(args.host, tls)
     shards = [args.shard] if args.shard is not None else None
     if shards is None:
         with urllib.request.urlopen(
-                f"http://{args.host}/internal/shards/max", timeout=60) as r:
+                f"{base}/internal/shards/max", timeout=60, context=ctx) as r:
             mx = json.loads(r.read())["standard"].get(args.index, 0)
         shards = list(range(mx + 1))
     for shard in shards:
-        url = (f"http://{args.host}/export?index={args.index}"
+        url = (f"{base}/export?index={args.index}"
                f"&field={args.field}&shard={shard}")
         try:
-            with urllib.request.urlopen(url, timeout=60) as r:
+            with urllib.request.urlopen(url, timeout=60, context=ctx) as r:
                 sys.stdout.write(r.read().decode())
         except urllib.error.HTTPError as e:
             if e.code == 404:
@@ -326,7 +359,11 @@ def main(argv: list[str] | None = None) -> int:
     s.set_defaults(fn=cmd_server)
 
     s = sub.add_parser("import", help="bulk import CSV")
-    s.add_argument("--host", default="127.0.0.1:10101")
+    s.add_argument("--host", default="127.0.0.1:10101",
+                   help="host:port, or a full http(s)://host:port URL")
+    s.add_argument("--tls", action="store_true",
+                   help="use https (implied by an https:// --host)")
+    s.add_argument("--tls-skip-verify", action="store_true")
     s.add_argument("--buffer-size", type=int, default=100_000)
     s.add_argument("--clear", action="store_true")
     s.add_argument("index")
@@ -335,7 +372,11 @@ def main(argv: list[str] | None = None) -> int:
     s.set_defaults(fn=cmd_import)
 
     s = sub.add_parser("export", help="export CSV")
-    s.add_argument("--host", default="127.0.0.1:10101")
+    s.add_argument("--host", default="127.0.0.1:10101",
+                   help="host:port, or a full http(s)://host:port URL")
+    s.add_argument("--tls", action="store_true",
+                   help="use https (implied by an https:// --host)")
+    s.add_argument("--tls-skip-verify", action="store_true")
     s.add_argument("--shard", type=int, default=None)
     s.add_argument("index")
     s.add_argument("field")

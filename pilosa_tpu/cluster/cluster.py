@@ -25,6 +25,11 @@ from pilosa_tpu.cluster.placement import jump_hash, partition
 from pilosa_tpu.errors import PilosaError
 
 STATE_STARTING = "STARTING"
+#: terminal state of a node removed from the ring by a committed resize:
+#: its topology is stale by construction, so the API gate stays closed
+#: until an operator re-joins or retires it (reference analog: a removed
+#: node exits the memberlist and never re-enters NORMAL on its own).
+STATE_REMOVED = "REMOVED"
 STATE_NORMAL = "NORMAL"
 STATE_DEGRADED = "DEGRADED"
 STATE_RESIZING = "RESIZING"
@@ -201,6 +206,8 @@ class Cluster:
             # would reopen the API gate while fragments are moving).
             # Commit/abort restore the steady state explicitly.
             return
+        if self.state == STATE_REMOVED:
+            return  # terminal: only operator action re-opens this node
         down = sum(1 for n in self.nodes if n.state == "DOWN")
         if down == 0:
             self.state = STATE_NORMAL

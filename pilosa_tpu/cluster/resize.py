@@ -202,12 +202,26 @@ def apply_cluster_status(cluster: Cluster, nodes_json: list[dict],
                                    key=lambda n: n.id)
             if version is not None:
                 cluster.topology_version = int(version)
-            if cluster.state == STATE_RESIZING:
-                # The commit broadcast ends the resize on every peer:
-                # clear RESIZING so the recompute below can run (the
-                # _update_state guard defers to the resize owner).
-                cluster.set_state(STATE_NORMAL)
-            cluster._update_state()
+            if not any(n.id == cluster.local_id for n in cluster.nodes):
+                # A committed topology that excludes THIS node is a
+                # removal notice: enter the terminal REMOVED state so
+                # the API gate stays closed — serving reads/writes under
+                # a ring we are no longer part of would make them
+                # invisible to the rest of the cluster (ADVICE r4 #1).
+                from pilosa_tpu.cluster.cluster import STATE_REMOVED
+                cluster.set_state(STATE_REMOVED)
+            else:
+                from pilosa_tpu.cluster.cluster import STATE_REMOVED
+                if cluster.state in (STATE_RESIZING, STATE_REMOVED):
+                    # The commit broadcast ends the resize on every
+                    # peer: clear RESIZING so the recompute below can
+                    # run (the _update_state guard defers to the resize
+                    # owner). A REMOVED node that appears in a NEWER
+                    # committed ring has been re-added by the operator —
+                    # the terminal state ends with this commit, not with
+                    # a process restart.
+                    cluster.set_state(STATE_NORMAL)
+                cluster._update_state()
     if not stale:
         cluster.notify_topology()
     if holder is not None and availability:
@@ -225,6 +239,10 @@ def apply_cluster_state(cluster: Cluster, state: str) -> None:
     """Peer half of ResizeJob._broadcast_state: adopt a coordinator-
     announced state transition. Entering RESIZING closes this node's API
     gate; leaving it recomputes the steady state from node liveness."""
+    from pilosa_tpu.cluster.cluster import STATE_REMOVED
+    if cluster.state == STATE_REMOVED:
+        return  # terminal: a stray steady-state broadcast (e.g. the
+        # abort path's union fan-out) must not reopen a removed node.
     if state == STATE_RESIZING:
         cluster.set_state(STATE_RESIZING)
     else:
@@ -404,7 +422,15 @@ class ResizeJob:
                       "partitionN": self.cluster.partition_n,
                       "version": self.cluster.topology_version + 1,
                       "availability": holder_availability(self.holder)}
-            for node in new_nodes:
+            # Removed nodes get the commit too (ADVICE r4: they are not
+            # in new_nodes, so without this they sit in RESIZING until
+            # _recover_stuck_resizing reopens their gate under the stale
+            # pre-resize ring — a zombie accepting invisible writes).
+            # Receiving a committed status that excludes them flips them
+            # to the terminal REMOVED state (apply_cluster_status).
+            new_ids = {node.id for node in new_nodes}
+            removed = [n for n in self.cluster.nodes if n.id not in new_ids]
+            for node in list(new_nodes) + removed:
                 if node.id != self.cluster.local_id:
                     try:
                         self.client.send_message(node, status)
@@ -531,6 +557,7 @@ def _recover_stuck_resizing(cluster: Cluster, client) -> None:
     coord = next((n for n in cluster.nodes
                   if n.is_coordinator and n.id != cluster.local_id), None)
     over = False
+    removed = False
     if coord is None:
         over = True  # no resize authority exists at all
     elif coord.state == "DOWN":
@@ -551,10 +578,23 @@ def _recover_stuck_resizing(cluster: Cluster, client) -> None:
                 # gate; errors/old peers keep it closed.
                 over = (resp.get("state") is not None
                         and resp["state"] != STATE_RESIZING)
+                # A steady-state ring that no longer contains this node
+                # means the commit (whose broadcast we evidently missed)
+                # removed us: terminal REMOVED, not a reopened zombie
+                # serving the stale pre-resize ring (ADVICE r4 #1).
+                peer_nodes = resp.get("nodes")
+                if over and isinstance(peer_nodes, list) and peer_nodes:
+                    removed = not any(
+                        isinstance(n, dict) and n.get("id") == cluster.local_id
+                        for n in peer_nodes)
         except (ConnectionError, RuntimeError, LookupError,
                 AttributeError):
             over = False
     if over:
+        from pilosa_tpu.cluster.cluster import STATE_REMOVED
         cluster._resizing_coord_down_sweeps = 0
-        cluster.set_state(STATE_NORMAL)
-        cluster._update_state()
+        if removed:
+            cluster.set_state(STATE_REMOVED)
+        else:
+            cluster.set_state(STATE_NORMAL)
+            cluster._update_state()

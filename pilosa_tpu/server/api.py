@@ -48,6 +48,10 @@ class API:
         #: cluster key-allocation hook: (index, field|None, keys) -> ids
         #: (ClusterKeyTranslator); None = allocate locally.
         self.translator = None
+        #: this server's own ring entry (cluster.node.Node), set by
+        #: ServerNode — used to answer routing queries on a standalone
+        #: node, where there is no cluster to consult.
+        self.local_node = None
 
     #: method-availability matrix per cluster state (reference
     #: api.go:99-105 validAPIMethods + :1379-1411 method sets): during
@@ -369,6 +373,11 @@ class API:
         handleGetFragmentNodes): clients use it to route direct
         fragment reads/writes."""
         if self.cluster is None:
+            if self.local_node is not None:
+                # Standalone: every shard routes to THIS node — return
+                # its real id/URI so clients can actually dial it
+                # (ADVICE r4 #2; the reference returns the actual node).
+                return [self.local_node.to_json()]
             return [{"id": "standalone", "uri": {}, "isCoordinator": True}]
         return [n.to_json() for n in self.cluster.shard_nodes(index, shard)]
 

@@ -126,6 +126,13 @@ class ServerNode:
         self.http = HTTPServer(self.api, self.host, self.port,
                                tls_cert=tls_cert, tls_key=tls_key)
         self.port = self.http.port
+        # Built AFTER the listener resolves an ephemeral bind port —
+        # fragment_nodes on a standalone node must advertise an address
+        # a client can actually dial (ADVICE r4 #2).
+        self.api.local_node = Node(id=f"{self.host}:{self.port}",
+                                   uri=URI(scheme=scheme, host=self.host,
+                                           port=self.port),
+                                   is_coordinator=True)
 
         self._import_pool_mb = int(import_pool_mb)
         self._pool_stop = threading.Event()

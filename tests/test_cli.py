@@ -108,3 +108,13 @@ def test_config_precedence(tmp_path, capsys, monkeypatch):
 def test_generate_config(capsys):
     assert cli.main(["generate-config"]) == 0
     assert "bind" in capsys.readouterr().out
+
+
+def test_base_url_scheme_handling():
+    # ADVICE r4 #3: imports against a TLS server must be able to reach
+    # it — scheme from --tls or an explicit scheme in --host.
+    assert cli._base_url("127.0.0.1:10101") == "http://127.0.0.1:10101"
+    assert cli._base_url("127.0.0.1:10101", tls=True) == \
+        "https://127.0.0.1:10101"
+    assert cli._base_url("https://h:1/", tls=False) == "https://h:1"
+    assert cli._base_url("http://h:1") == "http://h:1"

@@ -91,20 +91,24 @@ def test_reserve_prefaults_and_scatter_recycles():
     assert _stats()["free_bytes"] >= before["free_bytes"]
 
 
-def test_reserve_raises_cap_to_cover_itself():
-    """An explicit reserve above the retained cap must raise the cap,
-    not silently evict the chunk it just faulted while reporting
-    success."""
+def test_reserve_clamped_by_operator_limit():
+    """An operator-set cap (pool_set_limit) is a hard upper bound:
+    pool_reserve must clamp to the remaining headroom and report the
+    clamped size, never raise the cap behind the operator's back
+    (ADVICE r4 #4 — the background top-up loop used to inflate it)."""
     base = _stats()
     native.pool_set_limit(4 << 20)
     try:
         got = native.pool_reserve(32 << 20)
-        assert got >= 32 << 20
         s = _stats()
-        assert s["free_bytes"] >= 32 << 20
-        assert s["limit_bytes"] >= s["free_bytes"]
+        assert s["limit_bytes"] == 4 << 20          # cap untouched
+        assert got <= 4 << 20                        # truthfully clamped
+        assert s["free_bytes"] <= 4 << 20
+        # Headroom exhausted: further reserves report zero.
+        assert native.pool_reserve(32 << 20) == 0 or \
+            _stats()["free_bytes"] <= 4 << 20
     finally:
-        native.pool_set_limit(max(base["limit_bytes"], _stats()["limit_bytes"]))
+        native.pool_set_limit(max(base["limit_bytes"], 4 << 20))
 
 
 def test_limit_evicts_excess():

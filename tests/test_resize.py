@@ -168,6 +168,20 @@ def test_http_resize_remove_node():
         st = json.loads(urllib.request.urlopen(base + "/status",
                                                timeout=10).read())
         assert len(st["nodes"]) == 2
+        # The removed node received the commit too (ADVICE r4 #1): it
+        # must sit in the terminal REMOVED state with its API gate
+        # closed — not reopen as a zombie serving the stale ring.
+        vst = json.loads(urllib.request.urlopen(
+            f"http://{victim}/status", timeout=10).read())
+        assert vst["state"] == "REMOVED"
+        try:
+            r = urllib.request.Request(
+                f"http://{victim}/index/i/query",
+                data=b"Count(Row(f=1))", method="POST")
+            urllib.request.urlopen(r, timeout=10)
+            assert False, "removed node still serves queries"
+        except urllib.error.HTTPError as e:
+            assert e.code in (400, 405, 409, 503)
         nodes[[i for i, a in enumerate(addrs) if a == victim][0]].close()
         assert post("/index/i/query", "Count(Row(f=1))") == \
             {"results": [len(cols)]}
