@@ -362,6 +362,17 @@ def _build_routes(api: API):
                            "Content-Disposition":
                                'attachment; filename="profile.pstats"'}
 
+    def get_debug_heap(pv, params, body):
+        """One-stop memory accounting: tracemalloc top sites + native
+        pool + planner HBM cache + per-index host-row bytes (reference
+        /debug/pprof heap, http/handler.go:281; VERDICT r4 #3)."""
+        from pilosa_tpu.obs.heap import heap_stats
+        top_n = min(max(int(params.get("top", 25)), 1), 200)
+        return 200, heap_stats(api.holder,
+                               planner=getattr(api.executor, "planner",
+                                               None),
+                               top_n=top_n)
+
     def post_recalculate(pv, params, body):
         api.recalculate_caches()
         return 200, {}
@@ -469,6 +480,31 @@ def _build_routes(api: API):
     def get_nodes(pv, params, body):
         return 200, api.hosts()
 
+    def get_internal_probe(pv, params, body):
+        """Probe a third node on a caller's behalf (memberlist indirect
+        ping, gossip/gossip.go:43-443): an asymmetric partition between
+        the caller and the target must not read as target-down when
+        THIS node can still reach it. The target must be a known
+        cluster member — probing arbitrary caller-supplied addresses
+        would make this node a reachability oracle for its network
+        position (memberlist likewise only pings members)."""
+        cluster = getattr(api, "cluster", None)
+        client = getattr(cluster, "client", None)
+        host = params.get("host", "")
+        port = str(params.get("port", ""))
+        target = None
+        if cluster is not None:
+            target = next(
+                (n for n in cluster.nodes
+                 if n.uri.host == host and str(n.uri.port) == port), None)
+        if client is None or target is None:
+            return 200, {"ok": False}
+        try:
+            client.probe(target)
+            return 200, {"ok": True}
+        except (ConnectionError, OSError, RuntimeError):
+            return 200, {"ok": False}
+
     def get_views(pv, params, body):
         return 200, {"views": api.views(pv["index"], pv["field"])}
 
@@ -515,6 +551,7 @@ def _build_routes(api: API):
         (r"/debug/vars", {"GET": get_debug_vars}),
         (r"/debug/threads", {"GET": get_debug_threads}),
         (r"/debug/profile", {"GET": get_debug_profile}),
+        (r"/debug/heap", {"GET": get_debug_heap}),
         (r"/recalculate-caches", {"POST": post_recalculate}),
         (r"/internal/shards/max", {"GET": get_shards_max}),
         (r"/internal/translate/keys", {"POST": post_translate_keys}),
@@ -534,5 +571,6 @@ def _build_routes(api: API):
         (r"/internal/attr/data", {"GET": get_attr_block_data}),
         (r"/internal/import", {"POST": post_internal_import}),
         (r"/internal/nodes", {"GET": get_nodes}),
+        (r"/internal/probe", {"GET": get_internal_probe}),
     ]
     return [(re.compile("^" + p + "$"), methods) for p, methods in table]
