@@ -480,11 +480,20 @@ class ResizeJob:
                 pass
 
 
+#: intermediaries asked to confirm an unreachable peer before DOWN
+#: (memberlist IndirectChecks analog).
+INDIRECT_PROBES = 2
+
+
 def check_nodes(cluster: Cluster, client, retries: int = 2,
                 discover: bool = True) -> list[str]:
     """Failure detector sweep: probe every peer, confirm before marking
     down (reference confirmNodeDown cluster.go:1724-1751: /version probe
-    with retry). Returns ids whose state changed. ``discover`` adds the
+    with retry), and — SWIM-style (gossip/gossip.go:43-443) — ask up to
+    INDIRECT_PROBES other live members to probe an unreachable peer
+    before declaring it down, so an asymmetric partition between THIS
+    node and one member doesn't false-positive into node-down repair
+    churn. Returns ids whose state changed. ``discover`` adds the
     membership push/pull (one GET per live peer) — callers on a tight
     sweep cadence can run it every few sweeps."""
     changed = []
@@ -499,7 +508,28 @@ def check_nodes(cluster: Cluster, client, retries: int = 2,
                 break
             except ConnectionError:
                 continue
-        if alive and discover:
+        direct_alive = alive
+        # Indirect confirmation only for a SUSPECT transition (a peer
+        # we thought was up going unreachable) — confirming an
+        # already-DOWN corpse every sweep would put constant probe load
+        # on the intermediaries (memberlist also scopes indirect checks
+        # to suspicion).
+        if (not alive and node.state != "DOWN"
+                and hasattr(client, "indirect_probe")):
+            intermediaries = [n for n in cluster.nodes
+                              if n.id not in (cluster.local_id, node.id)
+                              and n.state != "DOWN"]
+            for via in intermediaries[:INDIRECT_PROBES]:
+                try:
+                    if client.indirect_probe(via, node):
+                        alive = True
+                        break
+                except (ConnectionError, OSError, RuntimeError):
+                    continue
+        # Membership push/pull only over a DIRECTLY-reachable link: a
+        # peer alive only via indirect probe is unreachable from here,
+        # and a full-timeout GET at it would stall the whole sweep.
+        if direct_alive and discover:
             # Transitive membership exchange rides the liveness sweep
             # (memberlist's push/pull, gossip.go:295): a peer holding a
             # STRICTLY NEWER committed topology hands us the whole ring,

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import json
 import urllib.error
+import urllib.parse
 import urllib.request
 from typing import Any
 
@@ -263,6 +264,23 @@ class HTTPInternalClient:
             pass  # alive but unhappy still counts as alive
         except (urllib.error.URLError, OSError) as e:
             raise ConnectionError(f"node {node.id} unreachable: {e}") from e
+
+    def indirect_probe(self, via, target) -> bool:
+        """Ask ``via`` to probe ``target`` on our behalf (memberlist's
+        indirect ping, gossip/gossip.go:43-443): distinguishes "target
+        is dead" from "the link between US and target is down".  True
+        iff the intermediary reached the target."""
+        q = urllib.parse.urlencode({"scheme": target.uri.scheme,
+                                    "host": target.uri.host,
+                                    "port": target.uri.port})
+        url = self._url(via, f"/internal/probe?{q}")
+        try:
+            with urllib.request.urlopen(
+                    url, timeout=min(2 * self.PROBE_TIMEOUT, self.timeout),
+                    context=self._ctx(url)) as resp:
+                return bool(json.loads(resp.read() or b"{}").get("ok"))
+        except (OSError, ValueError):
+            return False
 
     def translate_keys(self, node, index, field, keys):
         body = json.dumps({"index": index, "field": field,

@@ -429,3 +429,56 @@ def test_apply_schema_fans_out_cluster_wide():
                       remote=True)
     assert lc[1].holder.index("solo") is not None
     assert lc[0].holder.index("solo") is None
+
+
+def test_asymmetric_partition_does_not_mark_node_down():
+    """SWIM-style indirect probes (VERDICT r4 #6): when THIS node cannot
+    reach a peer but other members can, the peer is partitioned from
+    us, not dead — the sweep must not emit node-down (which would
+    trigger repair churn and DEGRADED)."""
+    from pilosa_tpu.cluster import STATE_NORMAL
+    from pilosa_tpu.cluster.resize import check_nodes
+
+    lc = LocalCluster(3, replica_n=2)
+    a = lc[0]
+
+    class AsymClient:
+        """node0 -> node2 link down; node1 -> node2 still up."""
+
+        def __init__(self, inner, blocked_targets):
+            self._inner = inner
+            self._blocked = set(blocked_targets)
+
+        def __getattr__(self, k):
+            return getattr(self._inner, k)
+
+        def probe(self, node):
+            if node.id in self._blocked:
+                raise ConnectionError("asymmetric link down")
+            return self._inner.probe(node)
+
+        def indirect_probe(self, via, target):
+            # The intermediary's own link to the target (LocalClient
+            # honors the true down set, not our blocked links).
+            try:
+                self._inner.probe(target)
+                return True
+            except ConnectionError:
+                return False
+
+    client = AsymClient(lc.client, {"node2"})
+    events = []
+    a.cluster.subscribe(lambda ev: events.append(ev))
+
+    changed = check_nodes(a.cluster, client, discover=False)
+    assert changed == []                       # no transition emitted
+    assert a.cluster.node_by_id("node2").state != "DOWN"
+    assert a.cluster.state == STATE_NORMAL     # no DEGRADED flap
+    assert events == []                        # no repair trigger
+
+    # Control: when the peer is REALLY dead, indirect probes fail too
+    # and the sweep converges on DOWN as before.
+    lc.client.down.add("node2")
+    changed = check_nodes(a.cluster, client, discover=False)
+    assert "node2" in changed
+    assert a.cluster.node_by_id("node2").state == "DOWN"
