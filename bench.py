@@ -393,6 +393,70 @@ def _bench_http(extra, expected):
 # ---------------------------------------------------------------------------
 
 
+def bench_oversubscribed(extra):
+    """QPS when the leaf working set EXCEEDS the planner's HBM stack
+    budget (VERDICT r4 #3): the same query mix runs once fully resident
+    and once with a budget holding half the leaves, so every sweep
+    evicts and re-uploads under LRU churn — the two-tier hot-dense /
+    cold-host story's cost, measured. Reference role: roaring mmap
+    paging (roaring/roaring.go:1437 RemapRoaringStorage)."""
+    from pilosa_tpu.config import SHARD_WIDTH, WORDS_PER_SHARD
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.exec import Executor
+    from pilosa_tpu.parallel import MeshPlanner, make_mesh
+
+    n_shards, n_rows = 64, 16
+    total = n_shards * SHARD_WIDTH
+    rng = np.random.default_rng(11)
+    h = Holder()
+    idx = h.create_index("over")
+    f = idx.create_field("f")
+    for r in range(n_rows):
+        cols = rng.integers(0, total, 20_000)
+        f.import_bits(np.full(len(cols), r, dtype=np.uint64), cols)
+    shards = list(range(n_shards))
+    mesh = make_mesh()
+    s_pad = ((n_shards + len(mesh.devices.reshape(-1)) - 1)
+             // len(mesh.devices.reshape(-1))) * len(mesh.devices.reshape(-1))
+    stack_bytes = s_pad * WORDS_PER_SHARD * 4
+    extra["oversub_stack_mb"] = round(stack_bytes / 1e6, 1)
+    extra["oversub_working_set_mb"] = round(n_rows * stack_bytes / 1e6, 1)
+
+    oracle = {}
+    scalar = Executor(h)
+    for r in range(n_rows):
+        (oracle[r],) = scalar.execute("over", f"Count(Row(f={r}))",
+                                      shards=shards)
+
+    def sweep_qps(budget_bytes, sweeps):
+        planner = MeshPlanner(h, mesh, max_cache_bytes=budget_bytes)
+        ex = Executor(h, planner=planner, result_cache=False)
+        for r in range(n_rows):  # warm compile + (maybe) cache
+            (got,) = ex.execute("over", f"Count(Row(f={r}))", shards=shards)
+            assert got == oracle[r], (r, got, oracle[r])
+        t0 = time.perf_counter()
+        n = 0
+        for _ in range(sweeps):
+            futs = [ex.execute_async("over", f"Count(Row(f={r}))",
+                                     shards=shards)
+                    for r in range(n_rows)]
+            for r, fut in enumerate(futs):
+                assert fut.result() == [oracle[r]]
+            n += n_rows
+        dt = time.perf_counter() - t0
+        st = planner.cache_stats()
+        planner.close()
+        return n / dt, st
+
+    resident_qps, st_res = sweep_qps(2 * n_rows * stack_bytes, sweeps=3)
+    churn_qps, st_churn = sweep_qps((n_rows // 2) * stack_bytes, sweeps=3)
+    assert st_churn["bytes"] <= st_churn["budget_bytes"]
+    assert st_churn["entries"] <= n_rows // 2
+    extra["resident_count_qps"] = round(resident_qps, 1)
+    extra["oversubscribed_count_qps"] = round(churn_qps, 1)
+    extra["oversubscribed_vs_resident"] = round(churn_qps / resident_qps, 3)
+
+
 def bench_topn(extra):
     from pilosa_tpu.config import SHARD_WIDTH
     from pilosa_tpu.core import Holder
@@ -614,7 +678,7 @@ def main() -> None:
 
     want = (set(c.strip() for c in CONFIGS.split(","))
             if CONFIGS != "all"
-            else {"star", "topn", "bsi", "time", "cluster"})
+            else {"star", "topn", "bsi", "time", "cluster", "oversub"})
     extra: dict = {"backend": jax.default_backend(),
                    "devices": len(jax.devices())}
 
@@ -631,7 +695,8 @@ def main() -> None:
     if "star" in want:
         qps, cpu_qps = bench_star_trace(extra)
     for name, fn in (("topn", bench_topn), ("bsi", bench_bsi),
-                     ("time", bench_time), ("cluster", bench_cluster)):
+                     ("time", bench_time), ("cluster", bench_cluster),
+                     ("oversub", bench_oversubscribed)):
         if name in want:
             t0 = time.perf_counter()
             try:

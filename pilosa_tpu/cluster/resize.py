@@ -516,16 +516,32 @@ def check_nodes(cluster: Cluster, client, retries: int = 2,
         # to suspicion).
         if (not alive and node.state != "DOWN"
                 and hasattr(client, "indirect_probe")):
+            import random
             intermediaries = [n for n in cluster.nodes
                               if n.id not in (cluster.local_id, node.id)
                               and n.state != "DOWN"]
-            for via in intermediaries[:INDIRECT_PROBES]:
+            # Random sample (memberlist's k-random-members): fixed
+            # ring-order picks would concentrate confirm load on two
+            # nodes and correlate their failure with the suspect's.
+            picked = random.sample(intermediaries,
+                                   min(INDIRECT_PROBES, len(intermediaries)))
+            if len(picked) > 1:
+                # Concurrent confirms: serialized probes would add their
+                # timeouts to the sweep and delay detecting OTHER
+                # failures behind this suspect.
+                from concurrent.futures import ThreadPoolExecutor
+                with ThreadPoolExecutor(len(picked)) as pool:
+                    def ask(via):
+                        try:
+                            return client.indirect_probe(via, node)
+                        except (ConnectionError, OSError, RuntimeError):
+                            return False
+                    alive = any(pool.map(ask, picked))
+            elif picked:
                 try:
-                    if client.indirect_probe(via, node):
-                        alive = True
-                        break
+                    alive = client.indirect_probe(picked[0], node)
                 except (ConnectionError, OSError, RuntimeError):
-                    continue
+                    pass
         # Membership push/pull only over a DIRECTLY-reachable link: a
         # peer alive only via indirect probe is unreachable from here,
         # and a full-timeout GET at it would stall the whole sweep.

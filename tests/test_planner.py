@@ -379,3 +379,90 @@ def test_shift_full_range_device_vs_oracle(mesh):
         host = Executor(h)  # no planner
         (hgot,) = host.execute("sh", q, cache=False)
         assert hgot == expected, (n, hgot, expected)
+
+
+# -- stack-cache eviction under an over-subscribed HBM budget (VERDICT
+# r4 missing #2 / weak #4): fill past max_cache_bytes and prove LRU
+# order, byte accounting, correctness after evict, and that in-flight
+# strong refs never go stale.
+
+
+def _stack_key_rows(planner):
+    """row ids currently resident, in LRU order (oldest first)."""
+    return [k[4] for k in planner._stack_cache]
+
+
+def test_stack_cache_evicts_lru_and_accounts_bytes(mesh, rng):
+    h = Holder()
+    idx = h.create_index("ev")
+    f = idx.create_field("f")
+    n_shards = 8
+    total = n_shards * SHARD_WIDTH
+    for r in range(6):
+        cols = rng.integers(0, total, 2000)
+        f.import_bits(np.full(len(cols), r), cols)
+    # One leaf stack = S_pad(8) * W * 4 bytes; budget fits exactly 3.
+    stack_bytes = 8 * (SHARD_WIDTH // 32) * 4
+    planner = MeshPlanner(h, mesh, max_cache_bytes=3 * stack_bytes)
+    e = Executor(h, planner=planner, result_cache=False)
+    shards = list(range(n_shards))
+
+    counts = {}
+    for r in range(6):  # 6 distinct leaves through a 3-stack budget
+        (counts[r],) = e.execute("ev", f"Count(Row(f={r}))", shards=shards)
+    st = planner.cache_stats()
+    assert st["entries"] == 3
+    assert st["bytes"] == 3 * stack_bytes          # exact accounting
+    assert st["bytes"] <= st["budget_bytes"]
+    assert _stack_key_rows(planner) == [3, 4, 5]   # LRU order: oldest out
+
+    # Touch the LRU entry; it must move to MRU and survive the next
+    # insert, which evicts row 4 instead.
+    (again,) = e.execute("ev", "Count(Row(f=3))", shards=shards)
+    assert again == counts[3]
+    (c0,) = e.execute("ev", "Count(Row(f=0))", shards=shards)  # re-upload
+    assert c0 == counts[0]                          # correct after evict
+    assert _stack_key_rows(planner) == [5, 3, 0]
+    assert planner.cache_stats()["bytes"] == 3 * stack_bytes
+
+    # Full sweep again: every answer identical under eviction churn.
+    for r in range(6):
+        (c,) = e.execute("ev", f"Count(Row(f={r}))", shards=shards)
+        assert c == counts[r]
+
+
+def test_stack_cache_eviction_does_not_break_inflight_refs(mesh, rng):
+    """An evicted entry's device array may still be referenced by an
+    in-flight prepared plan; eviction only drops the cache's ref, so
+    the dispatch must keep returning correct results (planner.py notes
+    strong refs pin entries mid-query)."""
+    h = Holder()
+    idx = h.create_index("ev2")
+    f = idx.create_field("f")
+    n_shards = 8
+    total = n_shards * SHARD_WIDTH
+    for r in range(4):
+        cols = rng.integers(0, total, 2000)
+        f.import_bits(np.full(len(cols), r), cols)
+    stack_bytes = 8 * (SHARD_WIDTH // 32) * 4
+    planner = MeshPlanner(h, mesh, max_cache_bytes=2 * stack_bytes)
+    e = Executor(h, planner=planner, result_cache=False)
+    shards = list(range(n_shards))
+
+    from pilosa_tpu.pql import parse
+    call = parse("Count(Row(f=0))").calls[0].children[0]
+    fn, arrays = planner.prepare_count(idx, call, shards)
+    want = planner._sum_host(np.asarray(fn(*arrays)))
+
+    # Evict row 0's stack by churning three other leaves through the
+    # 2-stack budget.
+    for r in range(1, 4):
+        e.execute("ev2", f"Count(Row(f={r}))", shards=shards)
+    assert 0 not in _stack_key_rows(planner)
+
+    # The held arrays still dispatch correctly post-evict...
+    got = planner._sum_host(np.asarray(fn(*arrays)))
+    assert got == want
+    # ...and a fresh prepare re-resolves leaves through the cache.
+    fn2, arrays2 = planner.prepare_count(idx, call, shards)
+    assert planner._sum_host(np.asarray(fn2(*arrays2))) == want

@@ -688,12 +688,14 @@ def test_internal_probe_route():
     for n in nodes:
         n.open()
     try:
-        # node0 asks node1 to probe node0 (alive) and a dead port.
+        # node0 asks node1 to probe node0 (alive).
         base = nodes[1].address
         with urllib.request.urlopen(
                 f"{base}/internal/probe?host=127.0.0.1&port={ports[0]}"
                 f"&scheme=http", timeout=10) as r:
             assert json.loads(r.read())["ok"] is True
+        # Non-member target: rejected without probing (the node must not
+        # be a reachability oracle for arbitrary addresses).
         s = socket.socket()
         s.bind(("127.0.0.1", 0))
         dead = s.getsockname()[1]
@@ -701,6 +703,13 @@ def test_internal_probe_route():
         with urllib.request.urlopen(
                 f"{base}/internal/probe?host=127.0.0.1&port={dead}"
                 f"&scheme=http", timeout=10) as r:
+            assert json.loads(r.read())["ok"] is False
+        # A REGISTERED member that is down exercises the failed-probe
+        # branch itself (not just the membership guard).
+        nodes[0].close()
+        with urllib.request.urlopen(
+                f"{base}/internal/probe?host=127.0.0.1&port={ports[0]}"
+                f"&scheme=http", timeout=15) as r:
             assert json.loads(r.read())["ok"] is False
     finally:
         for n in nodes:
