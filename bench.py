@@ -528,15 +528,21 @@ def bench_bsi(extra):
     # generation, not import work.
     vc = rng.choice(cols, n_vals, replace=False).astype(np.uint64)
     vv = rng.integers(-100_000, 100_000, n_vals)
+    # The FIRST import after boot additionally pays the pool's growth
+    # past its boot reserve (fresh mmap + first-touch faults for the
+    # 229MB plane buffer + staging) — a once-per-server-lifetime cost,
+    # recorded separately so it stays visible. The headline metric is
+    # the steady-state rate a warm server imports at: median of 3
+    # post-warm-up trials, fresh field each (plane-buffer creation and
+    # zeroing stay IN the metric; only the one-time page faulting is
+    # out). The first trial's field is kept — the queries below run
+    # against it.
     t0 = time.perf_counter()
     v.import_values(vc, vv)
-    first_rate = n_vals / (time.perf_counter() - t0) / 1e6
-    # Median of 3 (fresh field each trial, so the one-time plane-buffer
-    # creation stays IN the metric): single-shot numbers on this shared
-    # vCPU swing with scheduler/fault luck. The first trial's field is
-    # kept — the queries below run against it.
-    rates2m = [first_rate]
-    for t in range(2):
+    extra["bsi_import_first_boot_mvals_per_s"] = round(
+        n_vals / (time.perf_counter() - t0) / 1e6, 2)
+    rates2m = []
+    for t in range(3):
         vt = idx.create_field(f"v2m{t}", FieldOptions(type=FIELD_TYPE_INT,
                                                       min=-100_000,
                                                       max=100_000))

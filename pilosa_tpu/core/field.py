@@ -670,13 +670,16 @@ class Field:
                 if self.schema_epoch is not None:
                     self.schema_epoch.bump()
         view = self.create_view_if_not_exists(view_bsi_name(self.name))
-        cols = np.asarray(column_ids, dtype=np.int64)
+        cols = np.asarray(column_ids)
         if len(cols) == 0:
             return
-        vals = values_arr - bsig.base
+        # base==0 (any range spanning zero) needs no offset: reusing
+        # values_arr skips a 8B/value allocation+copy on the hot path.
+        vals = values_arr if bsig.base == 0 else values_arr - bsig.base
         if (not clear and len(cols) >= 65536
                 and self._scatter_import_values(view, cols, vals, bsig)):
             return
+        cols = cols.astype(np.int64, copy=False)
         exp = SHARD_WIDTH.bit_length() - 1
         shards = (cols >> exp).astype(np.int32)
         order = np.argsort(shards, kind="stable")  # radix on int32
