@@ -150,6 +150,14 @@ def bench_star_trace(extra):
     extra["cpu_1thread_qps"] = round(1.0 / cpu1_dt, 2)
     extra["cpu_threaded_qps"] = round(cpu_qps, 2)
     extra["cpu_threads"] = n_cpu
+    # Falsifiability (VERDICT r4 weak #5): this rig's CPU is a single
+    # shared vCPU, so vs_baseline is honest for THIS host but is NOT
+    # "10x a many-core server running the Go reference". The
+    # load-bearing comparisons are the paired same-run ratios below
+    # (executor_vs_kernel_delivered, pallas_vs_xla).
+    extra["cpu_note"] = (
+        f"baseline = native C++ popcount kernel on this rig's "
+        f"{n_cpu}-thread shared vCPU; not a many-core reference host")
 
     # ---- device link characterization ----
     # On this deployment the TPU sits behind a tunnel: ONE synchronous
@@ -236,9 +244,66 @@ def bench_star_trace(extra):
     jax.block_until_ready(outs)
     extra["raw_kernel_qps"] = round(N_QUERIES / (time.perf_counter() - t0), 1)
 
-    # Enqueue-rate only (above) is NOT a query rate: nothing forces each
-    # call's result off the device, and the tunnel pipelines/elides, so
-    # the number is unstable run to run. The honest kernel ceiling is
+    # ---- Pallas-vs-XLA A/B on chip (VERDICT r4 weak #8) ----
+    # The kernel layer's own contribution, measured: the SAME fused
+    # popcount(a & b) through the Pallas grid kernel and through plain
+    # XLA, device-rate (block_until_ready, no host pull), fresh jit
+    # wrappers per side so neither inherits the other's trace. Runs
+    # only where the Pallas path is real (TPU backend); CPU interpret
+    # mode would measure the interpreter, not the kernel.
+    from pilosa_tpu.ops import pallas_kernels as pk
+    if pk._DISABLED:
+        # Operator forced the XLA path (PILOSA_TPU_NO_PALLAS=1, the
+        # documented escape hatch for a broken Pallas build); never
+        # override that — record why the A/B is absent instead.
+        extra["pallas_ab_note"] = "skipped: PILOSA_TPU_NO_PALLAS=1"
+    elif pk._HAVE_PALLAS and jax.default_backend() == "tpu":
+        # _DISABLED is read at TRACE time: compile each side once under
+        # its own setting (fresh lambdas = separate jit caches), restore
+        # the flag, then alternate measurement blocks with the prebuilt
+        # executables.
+        old = pk._DISABLED
+        try:
+            pk._DISABLED = False
+            pallas_fn = jax.jit(lambda x, y: pk.pair_count(x, y, "and"))
+            ref = jax.block_until_ready(pallas_fn(a, b))
+            assert int(np.asarray(ref).astype(np.int64).sum()) == expected
+            pk._DISABLED = True
+            xla_fn = jax.jit(lambda x, y: pk.pair_count(x, y, "and"))
+            ref = jax.block_until_ready(xla_fn(a, b))
+            assert int(np.asarray(ref).astype(np.int64).sum()) == expected
+        finally:
+            pk._DISABLED = old
+
+        def rate(fn) -> float:
+            t0 = time.perf_counter()
+            outs = [fn(a, b) for _ in range(N_QUERIES)]
+            jax.block_until_ready(outs)
+            return N_QUERIES / (time.perf_counter() - t0)
+
+        # Alternate sides so link weather cancels in the ratio.
+        ps, xs = [], []
+        for i in range(4):
+            if i % 2:
+                xs.append(rate(xla_fn))
+                ps.append(rate(pallas_fn))
+            else:
+                ps.append(rate(pallas_fn))
+                xs.append(rate(xla_fn))
+        # Device rates share raw_kernel_qps's caveat (see the note after
+        # this block): the RATIO is the load-bearing number — paired
+        # blocks ride the same link weather, so drift cancels.
+        extra["pallas_pair_count_device_qps"] = round(
+            statistics.median(ps), 1)
+        extra["xla_pair_count_device_qps"] = round(
+            statistics.median(xs), 1)
+        extra["pallas_vs_xla"] = round(
+            statistics.median(ps) / statistics.median(xs), 3)
+
+    # raw_kernel_qps and the *_device_qps A/B above are NOT query rates:
+    # nothing forces each call's result off the device, and the tunnel
+    # pipelines/elides, so absolute values drift run to run (ratios of
+    # paired blocks stay meaningful). The honest kernel ceiling is
     # "counts delivered to the host" through the same batcher the
     # executor uses — bare kernel + transfer, zero executor logic.
     from pilosa_tpu.parallel.batcher import TransferBatcher
@@ -374,7 +439,7 @@ def _bench_http(extra, expected):
         assert run() == warm
         qps, p50 = _timer(run, 256, threads=8)
         extra["http_count_qps_32m"] = round(qps, 1)
-        extra["http_count_p50_ms_32m"] = round(p50, 2)
+        extra["http_count_p50_ms_32m"] = round(p50, 3)
 
         # Cold REST path (VERDICT r4 #10): cache bypassed server-side,
         # so every request runs its device program through the full
@@ -382,7 +447,7 @@ def _bench_http(extra, expected):
         run_cold = make_runner("/index/b/query?noCache=true")
         assert run_cold() == warm
         _, p50c = _timer(run_cold, 12)
-        extra["http_count_cold_p50_ms"] = round(p50c, 2)
+        extra["http_count_cold_p50_ms"] = round(p50c, 3)
     finally:
         proc.terminate()
         proc.wait(timeout=15)
@@ -483,11 +548,11 @@ def bench_topn(extra):
     assert len(warm) == 10
 
     qps, p50 = _timer(lambda: ex.execute("topn", "TopN(f, n=10)"), N_LAT)
-    extra["topn_1m_rows_p50_ms"] = round(p50, 2)
+    extra["topn_1m_rows_p50_ms"] = round(p50, 3)
     extra["topn_1m_rows_qps"] = round(qps, 1)
     _, p50c = _timer(lambda: ex.execute("topn", "TopN(f, n=10)",
                                         cache=False), N_LAT)
-    extra["topn_1m_rows_cold_p50_ms"] = round(p50c, 2)
+    extra["topn_1m_rows_cold_p50_ms"] = round(p50c, 3)
 
     # Filtered TopN at 20k rows: the streamed exact device path.
     f2 = idx.create_field("f2")
@@ -496,10 +561,10 @@ def bench_topn(extra):
     ex.execute("topn", "TopN(f2, Row(g=0), n=10)")  # warm
     _, p50f = _timer(lambda: ex.execute("topn", "TopN(f2, Row(g=0), n=10)"),
                      max(5, N_LAT // 3))
-    extra["topn_filtered_20k_rows_p50_ms"] = round(p50f, 2)
+    extra["topn_filtered_20k_rows_p50_ms"] = round(p50f, 3)
     _, p50fc = _timer(lambda: ex.execute("topn", "TopN(f2, Row(g=0), n=10)",
                                          cache=False), max(5, N_LAT // 3))
-    extra["topn_filtered_20k_rows_cold_p50_ms"] = round(p50fc, 2)
+    extra["topn_filtered_20k_rows_cold_p50_ms"] = round(p50fc, 3)
 
 
 # ---------------------------------------------------------------------------
@@ -579,10 +644,10 @@ def bench_bsi(extra):
                    ("Count(Row(v > 50000))", "bsi_range_count_p50_ms")):
         ex.execute("bsi", q)  # warm/compile
         _, p50 = _timer(lambda q=q: ex.execute("bsi", q), N_LAT)
-        extra[key] = round(p50, 2)
+        extra[key] = round(p50, 3)
         _, p50c = _timer(lambda q=q: ex.execute("bsi", q, cache=False),
                          max(5, N_LAT // 3))
-        extra[key.replace("_p50_ms", "_cold_p50_ms")] = round(p50c, 2)
+        extra[key.replace("_p50_ms", "_cold_p50_ms")] = round(p50c, 3)
 
 
 # ---------------------------------------------------------------------------
@@ -614,7 +679,7 @@ def bench_time(extra):
     q = ("Count(Row(f=1, from='2019-01-15T00:00', to='2019-03-15T00:00'))")
     ex.execute("t", q)
     _, p50 = _timer(lambda: ex.execute("t", q), N_LAT)
-    extra["time_range_count_p50_ms"] = round(p50, 2)
+    extra["time_range_count_p50_ms"] = round(p50, 3)
 
 
 # ---------------------------------------------------------------------------
@@ -664,15 +729,15 @@ def bench_cluster(extra):
     # in production — only the measured query is forced cold).
     qps, p50 = _timer(lambda: lc.query("c", q_count), N_LAT, threads=8)
     extra["cluster4_count_qps"] = round(qps, 1)
-    extra["cluster4_count_p50_ms"] = round(p50, 2)
+    extra["cluster4_count_p50_ms"] = round(p50, 3)
     _, p50c = _timer(lambda: lc.query("c", q_count, cache=False),
                      max(5, N_LAT // 3))
-    extra["cluster4_count_cold_p50_ms"] = round(p50c, 2)
+    extra["cluster4_count_cold_p50_ms"] = round(p50c, 3)
     _, p50g = _timer(lambda: lc.query("c", q_group), max(5, N_LAT // 3))
-    extra["cluster4_groupby_p50_ms"] = round(p50g, 2)
+    extra["cluster4_groupby_p50_ms"] = round(p50g, 3)
     _, p50gc = _timer(lambda: lc.query("c", q_group, cache=False),
                       max(5, N_LAT // 3))
-    extra["cluster4_groupby_cold_p50_ms"] = round(p50gc, 2)
+    extra["cluster4_groupby_cold_p50_ms"] = round(p50gc, 3)
     extra["cluster4_cols"] = cols
 
 
