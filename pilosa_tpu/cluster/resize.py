@@ -17,6 +17,7 @@ fragment.go:2436).
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 from dataclasses import asdict, dataclass
 
@@ -270,7 +271,21 @@ class ResizeJob:
     #: how long the coordinator waits for every target's completion ACK.
     #: Generous by design: fragment streaming is bounded by data volume,
     #: not RPC timeouts, now that apply runs off the dispatch request.
-    ACK_TIMEOUT = 600.0
+    #: A DOWN event fails a pending target's ACK immediately; the
+    #: deadline covers the blind spot where a target restarts so fast
+    #: the failure detector never sees it down (its in-flight apply is
+    #: simply gone, and the job must fail and release the gate rather
+    #: than hold it — found by the chaos soak). Operators on flappy
+    #: fleets tune it down via PILOSA_TPU_RESIZE_ACK_TIMEOUT.
+    try:
+        ACK_TIMEOUT = float(
+            os.environ.get("PILOSA_TPU_RESIZE_ACK_TIMEOUT", 600.0))
+    except ValueError:  # malformed env must not make this module (and
+        # with it the whole membership control plane) unimportable
+        import sys as _sys
+        print("PILOSA_TPU_RESIZE_ACK_TIMEOUT is not a number; "
+              "using 600s", file=_sys.stderr)
+        ACK_TIMEOUT = 600.0
 
     def __init__(self, cluster: Cluster, holder, client, store=None):
         self.cluster = cluster

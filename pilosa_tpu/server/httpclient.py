@@ -302,6 +302,11 @@ class HTTPInternalClient:
         gossip/gossip.go:295-443)."""
         return self._request(node, "GET", "/internal/nodes")
 
+    def availability(self, node) -> dict:
+        """Peer per-field shard availability ({index: {field: [shards]}}
+        — the additive NodeStatus half, server.go:640)."""
+        return self._request(node, "GET", "/internal/availability")
+
     def post_schema(self, node, schema: list[dict]) -> None:
         """Push a schema to one peer (reference PostSchema fan-out from
         API.ApplySchema, api.go:747; remote=true stops re-fan-out)."""

@@ -380,6 +380,12 @@ def _build_routes(api: API):
     def get_shards_max(pv, params, body):
         return 200, {"standard": api.max_shards()}
 
+    def get_availability(pv, params, body):
+        """Per-field shard availability for anti-entropy merge (the
+        additive NodeStatus half, reference server.go:640)."""
+        from pilosa_tpu.cluster.resize import holder_availability
+        return 200, holder_availability(api.holder)
+
     def post_translate_keys(pv, params, body):
         req = jbody(body)
         ids = api.translate_keys(req["index"], req.get("field"),
@@ -554,6 +560,7 @@ def _build_routes(api: API):
         (r"/debug/heap", {"GET": get_debug_heap}),
         (r"/recalculate-caches", {"POST": post_recalculate}),
         (r"/internal/shards/max", {"GET": get_shards_max}),
+        (r"/internal/availability", {"GET": get_availability}),
         (r"/internal/translate/keys", {"POST": post_translate_keys}),
         (r"/internal/translate/entries", {"GET": get_translate_entries}),
         (r"/internal/cluster/message", {"POST": post_cluster_message}),

@@ -87,6 +87,17 @@ class ServerNode:
                                        ca_cert=tls_ca_cert,
                                        skip_verify=tls_skip_verify))
             self.cluster.set_state(STATE_NORMAL)
+            if join is not None:
+                # A fresh joiner owns NO topology: start BELOW version 0
+                # so even a cluster still on its boot ring (version 0 —
+                # no resize ever committed) can hand us its status
+                # through the strictly-newer adoption gate. Found by the
+                # chaos soak: a joiner whose address was already in the
+                # boot ring wedged solo because the re-admission status
+                # carried version 0 and 0 <= 0 read as stale. A
+                # persisted topology (restart of an admitted joiner)
+                # overrides this below.
+                self.cluster.topology_version = -1
         self._scheme = scheme
 
         from pilosa_tpu.obs import MemoryStats
@@ -280,9 +291,12 @@ class ServerNode:
                                               self.executor.planner)
         self.runtime_monitor.start()
 
-    #: join announcement retry schedule (seconds between attempts).
+    #: join announcement retry schedule (seconds between attempts);
+    #: after JOIN_RETRIES fast attempts the announcer drops to the slow
+    #: cadence but never stops (a solo joiner has no other path in).
     JOIN_RETRY_DELAY = 1.0
     JOIN_RETRIES = 30
+    JOIN_SLOW_RETRY_DELAY = 5.0
 
     def _send_join(self) -> None:
         """Announce to a running member in the background, retrying —
@@ -295,10 +309,10 @@ class ServerNode:
                     uri=URI(scheme=self._scheme, host=h, port=int(p)))
 
         def announce():
+            import sys
             import time
-            for _ in range(self.JOIN_RETRIES):
-                if self._closed:
-                    return
+            attempts = 0
+            while not self._closed:
                 # Success = this node appears in the ring (the topology
                 # broadcast landed), NOT merely a delivered announce —
                 # the coordinator's resize runs asynchronously and can
@@ -308,14 +322,27 @@ class ServerNode:
                 try:
                     self.cluster.client.send_message(
                         seed, {"type": "node-join", "addr": self.id})
-                except (ConnectionError, RuntimeError):
+                except Exception:
+                    # A paused/overloaded seed times out (OSError, not
+                    # ConnectionError); ANY failure here must not kill
+                    # the announce thread — it is a solo joiner's only
+                    # path into the ring.
                     pass
-                time.sleep(self.JOIN_RETRY_DELAY)
-            if len(self.cluster.nodes) > 1:
-                return
-            import sys
-            print(f"join: cluster at {self.join_addr} did not admit us "
-                  f"after {self.JOIN_RETRIES} attempts", file=sys.stderr)
+                attempts += 1
+                if attempts == self.JOIN_RETRIES:
+                    # Never give up outright: a solo joiner has no peers
+                    # to discover the ring through, so announcing IS its
+                    # only path in (the seed may be mid-resize, paused,
+                    # or restarting for minutes). Drop to a slow cadence
+                    # and warn.
+                    print(f"join: cluster at {self.join_addr} did not "
+                          f"admit us after {self.JOIN_RETRIES} attempts; "
+                          f"retrying every "
+                          f"{self.JOIN_SLOW_RETRY_DELAY:.0f}s",
+                          file=sys.stderr)
+                time.sleep(self.JOIN_RETRY_DELAY
+                           if attempts < self.JOIN_RETRIES
+                           else self.JOIN_SLOW_RETRY_DELAY)
 
         t = threading.Thread(target=announce, name="join-announce",
                              daemon=True)
@@ -367,14 +394,31 @@ class ServerNode:
     def _sync_schema(self) -> None:
         """Adopt any peer schema this node is missing (a restarted
         member without its data dir re-learns indexes/fields before the
-        fragment syncer can repair their bits; reference NodeStatus
-        schema merge, server.go:640)."""
+        fragment syncer can repair their bits) AND merge peers' shard
+        availability — the additive half of the reference's NodeStatus
+        merge (server.go:640: schema + availableShards). Without the
+        availability half, a node that missed create-shard broadcasts
+        while down answers queries without those shards forever (found
+        by the chaos soak: permanent undercounts after rejoin)."""
         for node in self.cluster.nodes:
             if node.id == self.id or node.state == "DOWN":
                 continue
             try:
                 self.holder.apply_schema(self.cluster.client.schema(node))
             except (ConnectionError, RuntimeError, LookupError, KeyError):
+                continue
+            try:
+                avail = self.cluster.client.availability(node)
+                for index, fields in (avail or {}).items():
+                    idx = self.holder.index(index)
+                    if idx is None:
+                        continue
+                    for field, shards in fields.items():
+                        f = idx.field(field)
+                        if f is not None and shards:
+                            f.add_remote_available_shards(shards)
+            except (ConnectionError, RuntimeError, LookupError, KeyError,
+                    AttributeError):
                 continue
 
     def _schedule_sync(self) -> None:
@@ -524,13 +568,72 @@ class ServerNode:
         cluster-status broadcast). Reference: eventReceiver -> nodeJoin
         -> resize job (gossip/gossip.go:364, cluster.go:1796)."""
         coord = self.cluster.coordinator()
+        if coord is not None and coord.id == addr:
+            # The flagged coordinator is announcing itself as a JOINER:
+            # its process restarted without cluster state, so the node
+            # every peer would forward this join to is precisely the one
+            # that cannot handle it (found by the chaos soak — a
+            # leaderless wedge where the solo ex-coordinator announced
+            # into a ring that kept forwarding the announce back to it).
+            # Deterministic handover: the first surviving member acts,
+            # takes the flag, and the commit broadcast carries it.
+            survivors = sorted(
+                (n for n in self.cluster.nodes if n.id != addr),
+                key=lambda n: (n.state == "DOWN", n.id))  # live first
+            if not survivors:
+                raise RuntimeError(
+                    "no surviving member to take over the join")
+            coord = survivors[0]
+            if coord.id == self.id:
+                # The handover is a TOPOLOGY CHANGE, not a local note:
+                # bump the version, persist, and broadcast, or peers
+                # (whose strictly-newer gate rejects same-version
+                # views) would keep forwarding joins to the stateless
+                # ex-coordinator and a restart would restore its flag
+                # from the old topology.json.
+                with self.cluster._lock:
+                    for n in self.cluster.nodes:
+                        n.is_coordinator = (n.id == self.id)
+                    self.cluster.topology_version += 1
+                    status = {"type": "cluster-status",
+                              "nodes": [n.to_json()
+                                        for n in self.cluster.nodes],
+                              "replicaN": self.cluster.replica_n,
+                              "partitionN": self.cluster.partition_n,
+                              "version": self.cluster.topology_version}
+                self.cluster.notify_topology()
+                for n in self.cluster.nodes:
+                    if n.id != self.id and n.state != "DOWN":
+                        try:
+                            self.cluster.client.send_message(n, status)
+                        except Exception:
+                            pass  # discovery pulls converge them later
+                coord = self.cluster.node_by_id(self.id)
         if coord is None:
             raise RuntimeError("no coordinator to handle join")
         if coord.id != self.id:
             self.cluster.client.send_message(
                 coord, {"type": "node-join", "addr": addr})
             return "FORWARDED"
-        if self.cluster.node_by_id(addr) is not None:
+        member = self.cluster.node_by_id(addr)
+        if member is not None:
+            # Idempotent re-admission: a joiner that is already in OUR
+            # ring but keeps announcing missed the commit broadcast (it
+            # is sitting solo, and a solo node has no peers to discover
+            # the ring through). Re-send the committed topology so a
+            # lost commit can never wedge a member outside the ring it
+            # belongs to (found by the chaos soak, seed 104).
+            from pilosa_tpu.cluster.resize import holder_availability
+            status = {"type": "cluster-status",
+                      "nodes": [n.to_json() for n in self.cluster.nodes],
+                      "replicaN": self.cluster.replica_n,
+                      "partitionN": self.cluster.partition_n,
+                      "version": self.cluster.topology_version,
+                      "availability": holder_availability(self.holder)}
+            try:
+                self.cluster.client.send_message(member, status)
+            except (ConnectionError, RuntimeError):
+                pass
             return "ALREADY_MEMBER"
         # Run the (possibly long) data-moving resize OFF the request
         # thread: the joiner's announce would otherwise time out on big
@@ -577,6 +680,17 @@ class ServerNode:
                      for n in self.cluster.nodes]
         if action == "remove":
             new_nodes = [n for n in new_nodes if n.id != node_id]
+            if new_nodes and not any(n.is_coordinator for n in new_nodes):
+                # Never commit a leaderless ring (joins would have no
+                # authority to land on): hand the flag to this node —
+                # the one running the job — else the first LIVE
+                # survivor (a dead coordinator would route every
+                # join/resize at a corpse).
+                keep = next(
+                    (n for n in new_nodes if n.id == self.id),
+                    min(new_nodes,
+                        key=lambda n: (n.state == "DOWN", n.id)))
+                keep.is_coordinator = True
         elif action == "add":
             h, _, p = (addr or "").partition(":")
             new_nodes.append(Node(id=addr,
