@@ -244,6 +244,13 @@ def bench_star_trace(extra):
     jax.block_until_ready(outs)
     extra["raw_kernel_qps"] = round(N_QUERIES / (time.perf_counter() - t0), 1)
 
+    # Shared delivered-rate plumbing for the Pallas A/B and the
+    # kernel-delivered baseline below.
+    from pilosa_tpu.parallel.batcher import TransferBatcher
+
+    bt = TransferBatcher()
+    post = lambda host: int(host.astype(np.int64).sum())  # noqa: E731
+
     # ---- Pallas-vs-XLA A/B on chip (VERDICT r4 weak #8) ----
     # The kernel layer's own contribution, measured: the SAME fused
     # popcount(a & b) through the Pallas grid kernel and through plain
@@ -275,10 +282,18 @@ def bench_star_trace(extra):
         finally:
             pk._DISABLED = old
 
+        # DELIVERED rate through the shared batcher below (the same
+        # plumbing the kernel-delivered baseline and the executor use):
+        # the enqueue+block form drifts wildly with link weather
+        # (recorded 1.43x and 0.53x for identical code on this rig);
+        # counts-on-host is the stable, falsifiable comparison and
+        # matches how the kernel is consumed in production.
         def rate(fn) -> float:
             t0 = time.perf_counter()
-            outs = [fn(a, b) for _ in range(N_QUERIES)]
-            jax.block_until_ready(outs)
+            futs = [bt.submit(fn(a, b), post)
+                    for _ in range(N_QUERIES)]
+            vals = [f.result() for f in futs]
+            assert vals[0] == expected
             return N_QUERIES / (time.perf_counter() - t0)
 
         # Alternate sides so link weather cancels in the ratio.
@@ -290,26 +305,21 @@ def bench_star_trace(extra):
             else:
                 ps.append(rate(pallas_fn))
                 xs.append(rate(xla_fn))
-        # Device rates share raw_kernel_qps's caveat (see the note after
-        # this block): the RATIO is the load-bearing number — paired
-        # blocks ride the same link weather, so drift cancels.
-        extra["pallas_pair_count_device_qps"] = round(
+        # The RATIO is the load-bearing number — paired blocks ride the
+        # same link weather, so drift cancels.
+        extra["pallas_pair_count_delivered_qps"] = round(
             statistics.median(ps), 1)
-        extra["xla_pair_count_device_qps"] = round(
+        extra["xla_pair_count_delivered_qps"] = round(
             statistics.median(xs), 1)
         extra["pallas_vs_xla"] = round(
             statistics.median(ps) / statistics.median(xs), 3)
 
-    # raw_kernel_qps and the *_device_qps A/B above are NOT query rates:
+    # raw_kernel_qps (enqueue-only, above the A/B) is NOT a query rate:
     # nothing forces each call's result off the device, and the tunnel
-    # pipelines/elides, so absolute values drift run to run (ratios of
-    # paired blocks stay meaningful). The honest kernel ceiling is
-    # "counts delivered to the host" through the same batcher the
-    # executor uses — bare kernel + transfer, zero executor logic.
-    from pilosa_tpu.parallel.batcher import TransferBatcher
-
-    bt = TransferBatcher()
-    post = lambda host: int(host.astype(np.int64).sum())  # noqa: E731
+    # pipelines/elides, so its absolute value drifts run to run. The
+    # honest kernel ceiling is "counts delivered to the host" through
+    # the same batcher the executor uses — bare kernel + transfer, zero
+    # executor logic — which the Pallas A/B above also measures through.
     bt.submit(kernel(a, b), post).result()  # warm stacker
 
     def run_kernel_block(n):

@@ -748,23 +748,35 @@ class MeshPlanner:
         self._fn_cache[full_sig] = fn
         return fn
 
+    def _pallas_count_enabled(self) -> bool:
+        import os as _os
+
+        import jax as _jax
+
+        from pilosa_tpu.ops import pallas_kernels as pk
+        return (_os.environ.get("PILOSA_TPU_PALLAS_COUNT", "") == "1"
+                and pk.available() and _jax.default_backend() == "tpu"
+                and self.n_devices == 1)
+
     def _pallas_count_program(self, sig: tuple):
         """Fused Pallas count for the hottest shapes — a bare row and a
         2-leaf binary op (the headline Count(Intersect(Row,Row))): the
-        VMEM-tiled op+popcount+rowsum kernel measured 1.14x the plain
-        XLA popcount reduce through the full executor at the headline
-        954-shard shape (paired on-chip A/B). Gated to a SINGLE-device
-        TPU mesh: off-TPU pallas runs in interpret mode (every CPU-mesh
-        test's Count would become an interpreter loop), and on a
-        multi-device mesh a pallas_call has no partitioning rule, so
-        GSPMD would all-gather the sharded leaf stacks to every device
-        instead of counting shard-locally (a shard_map wrapping is the
+        VMEM-tiled op+popcount+rowsum kernel. OPT-IN
+        (PILOSA_TPU_PALLAS_COUNT=1): paired on-chip A/Bs on this rig
+        are ambivalent — executor-level 1.09-1.14x at the 954-shard
+        headline shape, but the kernel-isolated delivered comparison
+        has recorded anywhere from 1.36x to 0.61x for identical code
+        across link-weather windows (bench pallas_vs_xla tracks it per
+        run), so the default stays with XLA's own fusion. Also gated to
+        a SINGLE-device TPU mesh: off-TPU pallas runs in interpret mode
+        (every CPU-mesh test's Count would become an interpreter loop),
+        and on a multi-device mesh a pallas_call has no partitioning
+        rule, so GSPMD would all-gather the sharded leaf stacks instead
+        of counting shard-locally (a shard_map wrapping is the
         multi-chip path once real multi-chip hardware is available to
         measure)."""
         from pilosa_tpu.ops import pallas_kernels as pk
-        import jax as _jax
-        if (not pk.available() or _jax.default_backend() != "tpu"
-                or self.n_devices != 1):
+        if not self._pallas_count_enabled():
             return None
         if sig[0] == "leaf":
             slot = sig[1]
