@@ -281,7 +281,7 @@ class Soak:
         # old data dir after a membership removal correctly parks in
         # terminal REMOVED — recycle it through the operator flow
         # (kill + fresh join).
-        deadline = time.time() + 240
+        deadline = time.time() + 360
         while time.time() < deadline:
             try:
                 sts = {i: _status(self.addrs[i])
@@ -354,7 +354,7 @@ class Soak:
         s1 = {c for (r, c), v in self.intent.items() if v and r == 1}
         queries["Count(Intersect(Row(f=0), Row(f=1)))"] = len(s0 & s1)
         queries["Count(Union(Row(f=0), Row(f=1)))"] = len(s0 | s1)
-        deadline = time.time() + 150
+        deadline = time.time() + 240
         last = None
         while time.time() < deadline:
             try:
@@ -395,8 +395,23 @@ def test_chaos_soak(tmp_path, seed):
         _post(soak.addrs[0], "/index/i/field/f")
         soak.act_write_batch()
         soak.run_chaos(CHAOS_SECONDS)
-        soak.heal()
-        soak.reapply_intent()
-        soak.assert_converged()
+        # On this 1-vCPU rig five consecutive soaks contend hard enough
+        # that heal occasionally needs more runway than one deadline
+        # window — retry the PRE-assert stages once. The convergence
+        # retry deliberately does NOT re-apply intent: a write the
+        # first reapply lost must stay lost and fail the assert, or an
+        # intermittent lost-write bug (the class this test exists to
+        # catch) could hide behind the retry.
+        try:
+            soak.heal()
+            soak.reapply_intent()
+        except AssertionError:
+            soak.heal()
+            soak.reapply_intent()
+        try:
+            soak.assert_converged()
+        except AssertionError:
+            soak.heal()  # contention: one more settle window, no rewrite
+            soak.assert_converged()
     finally:
         soak.close()
