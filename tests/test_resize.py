@@ -969,3 +969,96 @@ def test_topology_version_survives_restart(tmp_path):
         assert reborn.cluster.replica_n == 2
     finally:
         reborn.close()
+
+
+def test_removed_state_cleared_by_newer_ring_including_node():
+    """A REMOVED node re-added by a NEWER committed topology exits the
+    terminal state (operator re-add flow; round-5 REMOVED semantics)."""
+    from pilosa_tpu.cluster.cluster import (
+        STATE_NORMAL, STATE_REMOVED, Cluster,
+    )
+    from pilosa_tpu.cluster.node import Node, URI
+    from pilosa_tpu.cluster.resize import apply_cluster_status
+
+    nodes = [Node(id=f"n{i}", uri=URI(host="h", port=1 + i),
+                  is_coordinator=(i == 0)) for i in range(3)]
+    c = Cluster(local_id="n2", nodes=[Node(id=n.id, uri=n.uri,
+                                           is_coordinator=n.is_coordinator)
+                                      for n in nodes])
+    c.set_state(STATE_NORMAL)
+
+    # Commit v1 excludes n2: terminal REMOVED, gate logic elsewhere.
+    apply_cluster_status(c, [n.to_json() for n in nodes[:2]], version=1)
+    assert c.state == STATE_REMOVED
+    # A STALE broadcast can't resurrect us...
+    apply_cluster_status(c, [n.to_json() for n in nodes], version=1)
+    assert c.state == STATE_REMOVED
+    # ...but a NEWER committed ring that includes us ends the exile.
+    apply_cluster_status(c, [n.to_json() for n in nodes], version=2)
+    assert c.state == STATE_NORMAL
+    assert any(n.id == "n2" for n in c.nodes)
+
+
+@pytest.mark.slow
+def test_stateless_ex_coordinator_rejoin_hands_over_flag(tmp_path):
+    """The leaderless wedge the chaos soak found: the flagged
+    coordinator's process restarts without cluster state and announces
+    as a joiner — peers must hand the flag to a live survivor and admit
+    it instead of forwarding its own announce back to it forever."""
+    import json
+    import time
+    import urllib.request
+
+    from pilosa_tpu.server.node import ServerNode
+
+    addrs = [f"127.0.0.1:{p}" for p in _free_ports(3)]
+    # Boot ring: the flag lands on the sorted-first address.
+    coord_addr = sorted(addrs)[0]
+    nodes = {a: ServerNode(bind=a, peers=[x for x in addrs if x != a],
+                           replica_n=2, use_planner=False,
+                           check_nodes_interval=0.5,
+                           anti_entropy_interval=1.0)
+             for a in addrs}
+    for n in nodes.values():
+        n.open()
+    try:
+        survivor = next(a for a in addrs if a != coord_addr)
+        # Kill the coordinator, then bring it back STATELESS (fresh
+        # dir, join via a survivor).
+        nodes[coord_addr].close()
+        nodes[coord_addr] = ServerNode(
+            bind=coord_addr, join=survivor,
+            data_dir=str(tmp_path / "reborn"), use_planner=False,
+            check_nodes_interval=0.5, anti_entropy_interval=1.0)
+        nodes[coord_addr].open()
+
+        deadline = time.time() + 90
+        ok = False
+        while time.time() < deadline:
+            try:
+                sts = {a: json.loads(urllib.request.urlopen(
+                    f"http://{a}/status", timeout=5).read())
+                    for a in addrs}
+                rings_full = all(len(s["nodes"]) == 3
+                                 for s in sts.values())
+                flags = {a: [n["id"] for n in s["nodes"]
+                             if n.get("isCoordinator")]
+                         for a, s in sts.items()}
+                one_flag = all(len(f) == 1 for f in flags.values())
+                # The handover moved the flag OFF the stateless
+                # rejoiner onto a survivor, consistently everywhere.
+                if (rings_full and one_flag
+                        and len({f[0] for f in flags.values()}) == 1
+                        and flags[survivor][0] != coord_addr):
+                    ok = True
+                    break
+            except Exception:
+                pass
+            time.sleep(0.5)
+        assert ok, "stateless ex-coordinator never re-admitted with handover"
+    finally:
+        for n in nodes.values():
+            try:
+                n.close()
+            except Exception:
+                pass
