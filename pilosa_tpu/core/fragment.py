@@ -448,6 +448,24 @@ class Fragment:
                 return bitops.np_zero_row()
             return hr.to_words()
 
+    def row_upload(self, row_id: int):
+        """Cheapest faithful host form for a device upload:
+        ``("dense", uint32[W])`` or ``("sparse", uint64[positions])``
+        (positions sorted, deduped). Sparse rows let the planner ship
+        ~8B/set-bit COO triplets instead of the 128 KiB dense block —
+        the difference IS the query rate when leaves page over a
+        bandwidth-bound link (planner sparse-upload path)."""
+        with self._lock:
+            hr = self.rows.get(row_id)
+            if hr is None:
+                return ("sparse", np.empty(0, dtype=np.uint64))
+            if hr.is_dense:
+                return ("dense", hr.dense.copy())
+            hr._flush()
+            if hr.dense is not None:  # flush may densify
+                return ("dense", hr.dense.copy())
+            return ("sparse", hr.positions.copy())
+
     def rows_snapshot(self) -> list[tuple[int, np.ndarray]]:
         """Atomic [(row_id, positions)] snapshot of every row, sorted by
         id — THE way to read all rows for serialization/checksums (the

@@ -81,6 +81,11 @@ class MeshPlanner:
         self._cache_lock = threading.Lock()
         #: structural signature -> jitted tree evaluator
         self._fn_cache: dict[tuple, Callable] = {}
+        #: sparse-upload assembler, jitted per mesh so the scatter
+        #: output lands sharded (see _build_stack).
+        self._assemble_jit = jax.jit(
+            _assemble_stack, static_argnames=("s_pad",),
+            out_shardings=shard_spec(self.mesh))
         #: cross-query transfer coalescing (parallel.batcher): every
         #: Count pull goes through it, so concurrent queries share one
         #: stacked device->host transfer per wave.
@@ -637,19 +642,99 @@ class MeshPlanner:
             self._cache_bytes += nbytes
         return arr
 
+    #: rows with at most this many set bits upload as COO triplets
+    #: (~12 B/word touched) instead of the 128 KiB dense block; on a
+    #: bandwidth-bound link the upload size IS the cold/oversubscribed
+    #: query rate. Above it the dense block is competitive.
+    SPARSE_UPLOAD_MAX_BITS = 2048
+
+    def _sparse_upload_enabled(self) -> bool:
+        """Sparse COO uploads pay off where host->device transfers are
+        expensive (the TPU tunnel); on the CPU test mesh a device_put
+        is a memcpy and the scatter program would only add compiles."""
+        return jax.default_backend() == "tpu"
+
     def _build_stack(self, idx: Index, field_name: str, view: str,
                      row_id: int, shards: tuple) -> tuple[jax.Array, int]:
         """Materialize one row across ``shards`` as a device-put
-        ``[S_pad, W]`` stack. Overridden by the distributed planner to
-        assemble a global array from each process's local fragment rows
-        (jax.make_array_from_single_device_arrays)."""
+        ``[S_pad, W]`` stack. Sparse rows (the common case for bitmap
+        workloads) ship as COO word triplets and scatter into zeros on
+        device — ~8 B/set bit over the link instead of 128 KiB/row —
+        when `_sparse_upload_enabled`. Overridden by the distributed
+        planner to assemble a global array from each process's local
+        fragment rows (jax.make_array_from_single_device_arrays)."""
         s_pad = self._pad(len(shards))
-        mat = np.zeros((s_pad, WORDS_PER_SHARD), dtype=np.uint32)
+        nbytes = s_pad * WORDS_PER_SHARD * 4  # HBM-resident size
+        if not self._sparse_upload_enabled():
+            mat = np.zeros((s_pad, WORDS_PER_SHARD), dtype=np.uint32)
+            for i, shard in enumerate(shards):
+                frag = self.holder.fragment(idx.name, field_name, view,
+                                            shard)
+                if frag is not None:
+                    mat[i] = frag.row_words(row_id)
+            return jax.device_put(mat, shard_spec(self.mesh)), nbytes
+        dense_idx: list[int] = []
+        dense_rows: list[np.ndarray] = []
+        coo_i: list[np.ndarray] = []
+        coo_w: list[np.ndarray] = []
+        coo_v: list[np.ndarray] = []
         for i, shard in enumerate(shards):
             frag = self.holder.fragment(idx.name, field_name, view, shard)
-            if frag is not None:
-                mat[i] = frag.row_words(row_id)
-        return jax.device_put(mat, shard_spec(self.mesh)), mat.nbytes
+            if frag is None:
+                continue
+            kind, payload = frag.row_upload(row_id)
+            if kind == "sparse" and len(payload) == 0:
+                continue
+            if (kind == "sparse"
+                    and len(payload) <= self.SPARSE_UPLOAD_MAX_BITS):
+                w = (payload >> np.uint64(5)).astype(np.int32)
+                b = (np.uint32(1)
+                     << (payload & np.uint64(31)).astype(np.uint32))
+                # positions are sorted, so equal words are adjacent:
+                # one reduceat OR per distinct word.
+                starts = np.flatnonzero(
+                    np.diff(w, prepend=np.int32(-1)) != 0)
+                coo_i.append(np.full(len(starts), i, dtype=np.int32))
+                coo_w.append(w[starts])
+                coo_v.append(np.bitwise_or.reduceat(b, starts))
+            else:
+                dense_idx.append(i)
+                dense_rows.append(payload if kind == "dense" else
+                                  bitops.positions_to_words(payload))
+        nnz = sum(len(x) for x in coo_i)
+        if nnz == 0:
+            # No sparse rows to scatter: the plain host-sliced
+            # device_put beats shipping the same bytes through the
+            # assemble program (and pays no extra copies).
+            mat = np.zeros((s_pad, WORDS_PER_SHARD), dtype=np.uint32)
+            for i, row in zip(dense_idx, dense_rows):
+                mat[i] = row
+            return jax.device_put(mat, shard_spec(self.mesh)), nbytes
+        # Pad both inputs to pow2 buckets so the assemble program
+        # compiles O(log) distinct shapes, not one per leaf; padding
+        # lands in a sacrificial trash row the program slices off.
+        def bucket(n: int) -> int:
+            return 0 if n == 0 else max(8, 1 << (n - 1).bit_length())
+
+        d_pad = bucket(len(dense_idx))
+        didx = np.full(d_pad, s_pad, dtype=np.int32)
+        dmat = np.zeros((d_pad, WORDS_PER_SHARD), dtype=np.uint32)
+        didx[:len(dense_idx)] = dense_idx
+        for k, row in enumerate(dense_rows):
+            dmat[k] = row
+        n_pad = bucket(nnz)
+        ci = np.full(n_pad, s_pad, dtype=np.int32)
+        cw = np.zeros(n_pad, dtype=np.int32)
+        cv = np.zeros(n_pad, dtype=np.uint32)
+        ci[:nnz] = np.concatenate(coo_i)
+        cw[:nnz] = np.concatenate(coo_w)
+        cv[:nnz] = np.concatenate(coo_v)
+        # The per-mesh jit scatters DIRECTLY into the sharded layout
+        # (out_shardings): materializing the whole stack on one device
+        # and resharding would spike that device's HBM by the full
+        # stack size.
+        arr = self._assemble_jit(didx, dmat, ci, cw, cv, s_pad=s_pad)
+        return arr, nbytes
 
     def _zeros_stack(self, n_shards: int) -> jax.Array:
         s_pad = self._pad(n_shards)
@@ -901,6 +986,20 @@ def _copy_async(*arrays) -> None:
             a.copy_to_host_async()
         except (AttributeError, RuntimeError):  # non-jax array / backend
             pass
+
+
+def _assemble_stack(didx, dmat, ci, cw, cv, s_pad: int):
+    """Build a [s_pad, W] stack on device from a few dense rows plus
+    COO word triplets (sparse-upload path): row s_pad is a sacrificial
+    trash target for the pow2 padding, sliced off before return.
+    Jitted per planner (MeshPlanner.__init__) with the mesh's shard
+    sharding as out_shardings."""
+    base = jnp.zeros((s_pad + 1, WORDS_PER_SHARD), dtype=jnp.uint32)
+    if dmat.shape[0]:
+        base = base.at[didx].set(dmat)
+    if ci.shape[0]:
+        base = base.at[ci, cw].set(cv)
+    return base[:s_pad]
 
 
 @jax.jit
