@@ -531,6 +531,8 @@ def bench_oversubscribed(extra):
     churn_qps, st_churn = sweep_qps((n_rows // 2) * stack_bytes, sweeps=3)
     assert st_churn["bytes"] <= st_churn["budget_bytes"]
     assert st_churn["entries"] <= n_rows // 2
+    assert st_churn["evictions"] > 0  # the metric really measured churn
+    extra["oversub_evictions"] = st_churn["evictions"]
     extra["resident_count_qps"] = round(resident_qps, 1)
     extra["oversubscribed_count_qps"] = round(churn_qps, 1)
     extra["oversubscribed_vs_resident"] = round(churn_qps / resident_qps, 3)

@@ -41,6 +41,7 @@ def collect_runtime_gauges(stats, planner=None,
         out["plannerCacheBytes"] = float(snap["bytes"])
         out["plannerCacheBudgetBytes"] = float(snap["budget_bytes"])
         out["plannerCacheEntries"] = float(snap["entries"])
+        out["plannerCacheEvictions"] = float(snap.get("evictions", 0))
 
     if planner is not None and probe_device:
         # Only device-using nodes probe device memory: jax.local_devices

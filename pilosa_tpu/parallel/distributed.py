@@ -171,6 +171,12 @@ class DistributedMeshPlanner(MeshPlanner):
                 for d, g in self._local_devs]
 
     def _build_stack(self, idx, field_name, view, row_id, shards):
+        # NOTE: this override ships dense per-device blocks; the base
+        # planner's sparse COO upload path (3-5x under eviction churn
+        # on the bandwidth-bound single-chip rig) is NOT applied here —
+        # a per-device local-scatter variant is straightforward but
+        # unmeasurable without multi-process TPU hardware, so it stays
+        # unclaimed until it can be measured.
         s_pad = self._pad(len(shards))
         # Layout + ownership discipline over the WHOLE shard list (not
         # just local rows): an owned shard on a remote device position

@@ -75,6 +75,10 @@ class MeshPlanner:
         self._stack_cache: "OrderedDict[tuple, tuple[int, tuple, jax.Array]]" = \
             OrderedDict()
         self._cache_bytes = 0
+        #: lifetime stack-cache evictions (budget pressure), for the
+        #: runtime monitor / /debug/heap — churn in the oversubscribed
+        #: regime is invisible without it.
+        self._cache_evictions = 0
         self.max_cache_bytes = max_cache_bytes
         #: guards _stack_cache/_cache_bytes — one planner serves every
         #: thread of the HTTP server.
@@ -459,7 +463,8 @@ class MeshPlanner:
         with self._cache_lock:
             return {"bytes": self._cache_bytes,
                     "budget_bytes": self.max_cache_bytes,
-                    "entries": len(self._stack_cache)}
+                    "entries": len(self._stack_cache),
+                    "evictions": self._cache_evictions}
 
     # ------------------------------------------------------------------
     # tree → structural signature + leaf list
@@ -638,6 +643,7 @@ class MeshPlanner:
                    and self._cache_bytes + nbytes > self.max_cache_bytes):
                 _, (_, _, dropped) = self._stack_cache.popitem(last=False)
                 self._cache_bytes -= dropped.nbytes
+                self._cache_evictions += 1
             self._stack_cache[key] = (epoch, gens, arr)
             self._cache_bytes += nbytes
         return arr

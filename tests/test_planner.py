@@ -414,6 +414,7 @@ def test_stack_cache_evicts_lru_and_accounts_bytes(mesh, rng):
     assert st["entries"] == 3
     assert st["bytes"] == 3 * stack_bytes          # exact accounting
     assert st["bytes"] <= st["budget_bytes"]
+    assert st["evictions"] == 3                    # 6 leaves, 3 survived
     assert _stack_key_rows(planner) == [3, 4, 5]   # LRU order: oldest out
 
     # Touch the LRU entry; it must move to MRU and survive the next
@@ -424,6 +425,7 @@ def test_stack_cache_evicts_lru_and_accounts_bytes(mesh, rng):
     assert c0 == counts[0]                          # correct after evict
     assert _stack_key_rows(planner) == [5, 3, 0]
     assert planner.cache_stats()["bytes"] == 3 * stack_bytes
+    assert planner.cache_stats()["evictions"] == 4
 
     # Full sweep again: every answer identical under eviction churn.
     for r in range(6):
