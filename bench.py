@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import json
 import os
+import math
 import statistics
 import sys
 import time
@@ -51,22 +52,32 @@ CONFIGS = os.environ.get("BENCH_CONFIGS", "all")
 DENSITY = float(os.environ.get("BENCH_DENSITY", 0.05))
 
 
+def _p99(lat_s):
+    """p99 in ms from a list of second-latencies (nearest-rank)."""
+    ranked = sorted(lat_s)
+    idx = max(0, math.ceil(0.99 * len(ranked)) - 1)
+    return ranked[idx] * 1e3
+
+
 def _timer(fn, n, threads=1):
-    """(qps, p50_ms) over n calls; threads>1 = pipelined throughput."""
+    """(qps, p50_ms, p99_ms) over n calls; threads>1 = pipelined
+    throughput. Tail latency comes from the sequential sample (the
+    threaded phase measures occupancy, not per-call service time)."""
     lat = []
     for _ in range(min(n, N_LAT)):
         t0 = time.perf_counter()
         fn()
         lat.append(time.perf_counter() - t0)
     p50 = statistics.median(lat) * 1e3
+    p99 = _p99(lat)
     if threads <= 1:
         qps = 1e3 / p50 if p50 else float("inf")
-        return qps, p50
+        return qps, p50, p99
     t0 = time.perf_counter()
     with ThreadPoolExecutor(max_workers=threads) as pool:
         list(pool.map(lambda _: fn(), range(n)))
     dt = time.perf_counter() - t0
-    return n / dt, p50
+    return n / dt, p50, p99
 
 
 def _rand_positions(rng, n_bits, n_cols):
@@ -363,6 +374,17 @@ def bench_star_trace(extra):
         statistics.median(ratios), 3)
 
     # ---- one pass through HTTP (config-1 surface parity) ----
+    # The HTTP bench spawns child server processes and times their first
+    # queries; the 1B-col star working set still held here (host row
+    # words, device leaf stacks, planner HBM cache) is enough memory/CPU
+    # pressure to distort the children's compile+serve timings. Drop it
+    # before spawning.
+    bt.close()
+    del run_kernel_block, run_executor_block, post, kernel
+    del a, b, bt, ex, planner
+    del words_f, words_g, blocks_f, blocks_g, f, g, idx, h
+    import gc
+    gc.collect()
     try:
         _bench_http(extra, expected)
     except Exception as e:  # pragma: no cover - diagnostics only
@@ -382,11 +404,20 @@ def _bench_http(extra, expected):
     port = s.getsockname()[1]
     s.close()
     d = tempfile.mkdtemp()
+    # First boot: warmup OFF so the first query measures today's cold
+    # path (XLA compile + link through the full REST stack). A second
+    # boot below, warmup ON over the same data dir, measures what the
+    # warmed first query costs — the QoS warmup service's whole point.
     env = dict(os.environ)
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "pilosa_tpu.cli", "server",
-         "--bind", f"127.0.0.1:{port}", "--data-dir", d],
-        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env)
+    env["PILOSA_TPU_QOS_WARMUP"] = ""
+
+    def spawn(e):
+        return subprocess.Popen(
+            [sys.executable, "-m", "pilosa_tpu.cli", "server",
+             "--bind", f"127.0.0.1:{port}", "--data-dir", d],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=e)
+
+    proc = spawn(env)
     base = f"http://127.0.0.1:{port}"
 
     def post(path, body=""):
@@ -395,13 +426,20 @@ def _bench_http(extra, expected):
         return json.loads(urllib.request.urlopen(r, timeout=60).read()
                           or b"{}")
 
-    try:
+    def get(path):
+        return json.loads(
+            urllib.request.urlopen(base + path, timeout=10).read() or b"{}")
+
+    def wait_up():
         for _ in range(200):
             try:
                 urllib.request.urlopen(base + "/status", timeout=1)
-                break
+                return
             except Exception:
                 time.sleep(0.25)
+
+    try:
+        wait_up()
         post("/index/b")
         post("/index/b/field/f")
         post("/index/b/field/g")
@@ -415,10 +453,6 @@ def _bench_http(extra, expected):
                 "columnIDs": rng.integers(0, cols, n_bits).tolist()})
             post(f"/index/b/field/{fld}/import", body)
         q = "Count(Intersect(Row(f=1), Row(g=2)))"
-        warm = post("/index/b/query", q)
-        # r2 silently counted an EMPTY index here (wrong wire field
-        # names); never trust an unasserted benchmark query.
-        assert warm["results"][0] > 0, warm
 
         # Persistent (keep-alive) connections, one per worker thread —
         # the server speaks HTTP/1.1; paying a TCP handshake per query
@@ -428,17 +462,21 @@ def _bench_http(extra, expected):
         tls = _threading.local()
         host, p = base.replace("http://", "").split(":")
 
+        def connect():
+            conn = tls.conn = http.client.HTTPConnection(host, int(p),
+                                                         timeout=60)
+            conn.connect()
+            # Nagle + delayed-ACK adds ~40ms to every small POST
+            # (headers and body go in separate writes).
+            conn.sock.setsockopt(socket.IPPROTO_TCP,
+                                 socket.TCP_NODELAY, 1)
+            return conn
+
         def make_runner(path):
             def run():
                 conn = getattr(tls, "conn", None)
                 if conn is None:
-                    conn = tls.conn = http.client.HTTPConnection(
-                        host, int(p), timeout=60)
-                    conn.connect()
-                    # Nagle + delayed-ACK adds ~40ms to every small POST
-                    # (headers and body go in separate writes).
-                    conn.sock.setsockopt(socket.IPPROTO_TCP,
-                                         socket.TCP_NODELAY, 1)
+                    conn = connect()
                 try:
                     conn.request("POST", path, q.encode())
                     resp = conn.getresponse()
@@ -450,18 +488,67 @@ def _bench_http(extra, expected):
 
         run = make_runner("/index/b/query")
 
-        assert run() == warm
-        qps, p50 = _timer(run, 256, threads=8)
+        # First-query cost through a PRE-CONNECTED socket: today this
+        # pays the cold XLA compile + leaf-stack upload; the warmed
+        # restart below measures the same window with the compile
+        # already done. Handshake stays outside both timed windows.
+        connect()
+        t0 = time.perf_counter()
+        warm = run()
+        extra["http_count_first_cold_ms"] = round(
+            (time.perf_counter() - t0) * 1e3, 3)
+        # r2 silently counted an EMPTY index here (wrong wire field
+        # names); never trust an unasserted benchmark query.
+        assert warm["results"][0] > 0, warm
+        qps, p50, p99 = _timer(run, 256, threads=8)
         extra["http_count_qps_32m"] = round(qps, 1)
         extra["http_count_p50_ms_32m"] = round(p50, 3)
+        extra["http_count_p99_ms_32m"] = round(p99, 3)
 
         # Cold REST path (VERDICT r4 #10): cache bypassed server-side,
         # so every request runs its device program through the full
         # stack — what a real FIRST query costs end to end.
         run_cold = make_runner("/index/b/query?noCache=true")
         assert run_cold() == warm
-        _, p50c = _timer(run_cold, 12)
+        _, p50c, p99c = _timer(run_cold, 12)
         extra["http_count_cold_p50_ms"] = round(p50c, 3)
+        extra["http_count_cold_p99_ms"] = round(p99c, 3)
+
+        # QoS shed/deadline counters from the steady-state run (expected
+        # 0 with the default generous bounds — nonzero means the gate
+        # bit during the bench and the numbers above include queueing).
+        dv = get("/debug/vars")
+        counters = dv.get("counters", {})
+        extra["http_qos_sheds"] = sum(
+            v for k, v in counters.items() if k.startswith("qos.shed"))
+        extra["http_qos_deadline_misses"] = sum(
+            v for k, v in counters.items()
+            if k.startswith("qos.deadlineMiss"))
+
+        # ---- warmed restart: same data dir, kernel warmup ON ----
+        proc.terminate()
+        proc.wait(timeout=15)
+        env2 = dict(os.environ)
+        env2["PILOSA_TPU_QOS_WARMUP"] = "count"
+        proc = spawn(env2)
+        wait_up()
+        # Warmup runs in the background; wait for it to finish so the
+        # first query below measures the warmed path, not a race.
+        for _ in range(240):
+            counters = get("/debug/vars").get("counters", {})
+            if counters.get("qos.warmupRuns", 0) >= 1:
+                break
+            time.sleep(0.25)
+        tls.conn = None  # old keep-alive socket died with the old server
+        connect()
+        t0 = time.perf_counter()
+        first = run()
+        extra["http_count_first_warm_ms"] = round(
+            (time.perf_counter() - t0) * 1e3, 3)
+        assert first == warm, (first, warm)
+        cold_ms = extra["http_count_first_cold_ms"]
+        extra["http_warmup_speedup"] = round(
+            cold_ms / max(extra["http_count_first_warm_ms"], 1e-3), 1)
     finally:
         proc.terminate()
         proc.wait(timeout=15)
@@ -537,6 +624,62 @@ def bench_oversubscribed(extra):
     extra["oversubscribed_count_qps"] = round(churn_qps, 1)
     extra["oversubscribed_vs_resident"] = round(churn_qps / resident_qps, 3)
 
+    # ---- tail latency + QoS under the same churn regime ----
+    # Individually-timed sync queries through a tight admission gate
+    # while a batch-class flood oversubscribes it: what an admitted
+    # interactive query's p50/p99 looks like when the node is saturated
+    # and the queue bound is doing its job (sheds + deadline misses
+    # recorded rather than unbounded queueing).
+    from pilosa_tpu.qos import (AdmissionController, Deadline,
+                                DeadlineExceededError, QueryShedError,
+                                reset_current_deadline,
+                                set_current_deadline)
+    planner = MeshPlanner(h, mesh, max_cache_bytes=(n_rows // 2) * stack_bytes)
+    ex = Executor(h, planner=planner, result_cache=False)
+    for r in range(n_rows):  # warm compiles
+        ex.execute("over", f"Count(Row(f={r}))", shards=shards)
+    ctl = AdmissionController(max_concurrent=2, max_queue=4)
+    sheds = misses = 0
+    lat = []
+
+    def one_query(r, qos_class, deadline_s):
+        nonlocal sheds, misses
+        tok = set_current_deadline(Deadline(timeout=deadline_s))
+        t0 = time.perf_counter()
+        try:
+            with ctl.admit(qos_class):
+                ex.execute("over", f"Count(Row(f={r}))", shards=shards)
+            return time.perf_counter() - t0
+        except QueryShedError:
+            sheds += 1
+        except DeadlineExceededError:
+            misses += 1
+        finally:
+            reset_current_deadline(tok)
+        return None
+
+    with ThreadPoolExecutor(max_workers=16) as pool:
+        futs = []
+        for i in range(n_rows * 4):
+            if i % 2:  # batch flood with a tight deadline
+                futs.append(pool.submit(one_query, i % n_rows, "batch", 0.5))
+            else:      # the interactive stream we're protecting
+                futs.append(pool.submit(one_query, i % n_rows,
+                                        "interactive", 10.0))
+        for i, fut in enumerate(futs):
+            dt = fut.result()
+            if dt is not None and i % 2 == 0:
+                lat.append(dt)
+    planner.close()
+    extra["oversub_qos_sheds"] = sheds
+    extra["oversub_qos_deadline_misses"] = misses
+    if lat:
+        extra["oversub_admitted_p50_ms"] = round(
+            statistics.median(lat) * 1e3, 3)
+        extra["oversub_admitted_p99_ms"] = round(_p99(lat), 3)
+    snap = ctl.snapshot()
+    assert snap["shed"] == sheds and snap["deadlineMiss"] == misses
+
 
 def bench_topn(extra):
     from pilosa_tpu.config import SHARD_WIDTH
@@ -563,10 +706,10 @@ def bench_topn(extra):
     (warm,) = ex.execute("topn", "TopN(f, n=10)")
     assert len(warm) == 10
 
-    qps, p50 = _timer(lambda: ex.execute("topn", "TopN(f, n=10)"), N_LAT)
+    qps, p50, _ = _timer(lambda: ex.execute("topn", "TopN(f, n=10)"), N_LAT)
     extra["topn_1m_rows_p50_ms"] = round(p50, 3)
     extra["topn_1m_rows_qps"] = round(qps, 1)
-    _, p50c = _timer(lambda: ex.execute("topn", "TopN(f, n=10)",
+    _, p50c, _ = _timer(lambda: ex.execute("topn", "TopN(f, n=10)",
                                         cache=False), N_LAT)
     extra["topn_1m_rows_cold_p50_ms"] = round(p50c, 3)
 
@@ -575,10 +718,10 @@ def bench_topn(extra):
     rows2 = rng.integers(0, 20_000, 400_000).astype(np.uint64)
     f2.import_bits(rows2, _rand_positions(rng, 400_000, cols))
     ex.execute("topn", "TopN(f2, Row(g=0), n=10)")  # warm
-    _, p50f = _timer(lambda: ex.execute("topn", "TopN(f2, Row(g=0), n=10)"),
+    _, p50f, _ = _timer(lambda: ex.execute("topn", "TopN(f2, Row(g=0), n=10)"),
                      max(5, N_LAT // 3))
     extra["topn_filtered_20k_rows_p50_ms"] = round(p50f, 3)
-    _, p50fc = _timer(lambda: ex.execute("topn", "TopN(f2, Row(g=0), n=10)",
+    _, p50fc, _ = _timer(lambda: ex.execute("topn", "TopN(f2, Row(g=0), n=10)",
                                          cache=False), max(5, N_LAT // 3))
     extra["topn_filtered_20k_rows_cold_p50_ms"] = round(p50fc, 3)
 
@@ -659,9 +802,9 @@ def bench_bsi(extra):
                    ("Sum(Row(f=1), field=v)", "bsi_sum_filtered_p50_ms"),
                    ("Count(Row(v > 50000))", "bsi_range_count_p50_ms")):
         ex.execute("bsi", q)  # warm/compile
-        _, p50 = _timer(lambda q=q: ex.execute("bsi", q), N_LAT)
+        _, p50, _ = _timer(lambda q=q: ex.execute("bsi", q), N_LAT)
         extra[key] = round(p50, 3)
-        _, p50c = _timer(lambda q=q: ex.execute("bsi", q, cache=False),
+        _, p50c, _ = _timer(lambda q=q: ex.execute("bsi", q, cache=False),
                          max(5, N_LAT // 3))
         extra[key.replace("_p50_ms", "_cold_p50_ms")] = round(p50c, 3)
 
@@ -694,7 +837,7 @@ def bench_time(extra):
     ex = Executor(h, planner=MeshPlanner(h, make_mesh()))
     q = ("Count(Row(f=1, from='2019-01-15T00:00', to='2019-03-15T00:00'))")
     ex.execute("t", q)
-    _, p50 = _timer(lambda: ex.execute("t", q), N_LAT)
+    _, p50, _ = _timer(lambda: ex.execute("t", q), N_LAT)
     extra["time_range_count_p50_ms"] = round(p50, 3)
 
 
@@ -743,15 +886,15 @@ def bench_cluster(extra):
     # the coordinator's result cache so every remote node and device
     # program runs (remote nodes still use THEIR caches, as they would
     # in production — only the measured query is forced cold).
-    qps, p50 = _timer(lambda: lc.query("c", q_count), N_LAT, threads=8)
+    qps, p50, _ = _timer(lambda: lc.query("c", q_count), N_LAT, threads=8)
     extra["cluster4_count_qps"] = round(qps, 1)
     extra["cluster4_count_p50_ms"] = round(p50, 3)
-    _, p50c = _timer(lambda: lc.query("c", q_count, cache=False),
+    _, p50c, _ = _timer(lambda: lc.query("c", q_count, cache=False),
                      max(5, N_LAT // 3))
     extra["cluster4_count_cold_p50_ms"] = round(p50c, 3)
-    _, p50g = _timer(lambda: lc.query("c", q_group), max(5, N_LAT // 3))
+    _, p50g, _ = _timer(lambda: lc.query("c", q_group), max(5, N_LAT // 3))
     extra["cluster4_groupby_p50_ms"] = round(p50g, 3)
-    _, p50gc = _timer(lambda: lc.query("c", q_group, cache=False),
+    _, p50gc, _ = _timer(lambda: lc.query("c", q_group, cache=False),
                       max(5, N_LAT // 3))
     extra["cluster4_groupby_cold_p50_ms"] = round(p50gc, 3)
     extra["cluster4_cols"] = cols

@@ -43,6 +43,20 @@ _DEFAULTS = {
     # recycled fault-warm pages instead of paying first-touch faults —
     # the classic database buffer-pool reserve.
     "import_pool_mb": 512,
+    # QoS (pilosa_tpu.qos): concurrency gate on query dispatch, bounded
+    # admission queue (excess load sheds with 503 + Retry-After), and a
+    # default per-query deadline (seconds; 0 = none). The gate is ON
+    # for the CLI server — set qos_max_concurrent = 0 to disable.
+    "qos_max_concurrent": 32,
+    "qos_max_queue": 64,
+    "qos_internal_reserve": 4,
+    "qos_default_deadline": 0.0,
+    "qos_slow_query_ms": 500.0,
+    # Kernel warmup at node start: comma-separated kernel families
+    # ("count,topn,bsi"; "" disables) compiled for each shard-count
+    # bucket, so steady traffic never pays the cold XLA compile.
+    "qos_warmup": "count,topn,bsi",
+    "qos_warmup_shards": "1,8,32",
 }
 
 
@@ -94,6 +108,14 @@ def cmd_server(args) -> int:
         cfg["trace_endpoint"] = args.trace_endpoint
     if args.import_pool_mb is not None:
         cfg["import_pool_mb"] = args.import_pool_mb
+    if args.qos_max_concurrent is not None:
+        cfg["qos_max_concurrent"] = args.qos_max_concurrent
+    if args.qos_max_queue is not None:
+        cfg["qos_max_queue"] = args.qos_max_queue
+    if args.qos_default_deadline is not None:
+        cfg["qos_default_deadline"] = args.qos_default_deadline
+    if args.qos_warmup is not None:
+        cfg["qos_warmup"] = args.qos_warmup
 
     from pilosa_tpu.server.node import ServerNode
     node = ServerNode(
@@ -113,17 +135,34 @@ def cmd_server(args) -> int:
                          if str(cfg["tls_skip_verify"]) else None),
         trace_endpoint=str(cfg["trace_endpoint"]) or None,
         import_pool_mb=int(cfg["import_pool_mb"]),
+        qos_max_concurrent=int(cfg["qos_max_concurrent"]),
+        qos_max_queue=int(cfg["qos_max_queue"]),
+        qos_internal_reserve=int(cfg["qos_internal_reserve"]),
+        qos_default_deadline=float(cfg["qos_default_deadline"]),
+        qos_slow_query_ms=float(cfg["qos_slow_query_ms"]),
+        qos_warmup=str(cfg["qos_warmup"]),
+        qos_warmup_shards=str(cfg["qos_warmup_shards"]),
     )
     node.open()  # starts the (single) serve loop in the background
     print(f"pilosa-tpu serving at {node.address}", file=sys.stderr)
+    # Orchestrators stop nodes with SIGTERM; without a handler the
+    # process dies before node.close() can flush schema.json + final
+    # snapshots, turning every rolling restart into a WAL-less schema
+    # loss. SIGINT (ctrl-C) keeps its KeyboardInterrupt path.
+    import signal
+    import threading
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
     try:
-        import threading
-        threading.Event().wait()  # block until interrupted
+        stop.wait()  # block until SIGTERM or ctrl-C
     except KeyboardInterrupt:
         pass
     finally:
         node.close()
-    return 0
+    # Interpreter teardown after jax has run aborts (XLA's C++ worker
+    # threads hit std::terminate); everything durable was flushed by
+    # node.close(), so skip teardown and report the clean exit.
+    os._exit(0)
 
 
 def _base_url(host: str, tls: bool = False) -> str:
@@ -331,7 +370,16 @@ def cmd_generate_config(args) -> int:
           'tls-ca-cert = ""\n'
           '# trace-endpoint = "http://127.0.0.1:4318/v1/traces"\n'
           '# tls-skip-verify = false\n'
-          'planner = true')
+          'planner = true\n'
+          '# QoS: admission gate + shedding (0 disables the gate)\n'
+          'qos-max-concurrent = 32\n'
+          'qos-max-queue = 64\n'
+          'qos-internal-reserve = 4\n'
+          'qos-default-deadline = 0.0\n'
+          'qos-slow-query-ms = 500.0\n'
+          '# kernel warmup at boot ("" disables)\n'
+          'qos-warmup = "count,topn,bsi"\n'
+          'qos-warmup-shards = "1,8,32"')
     return 0
 
 
@@ -351,6 +399,15 @@ def main(argv: list[str] | None = None) -> int:
     s.add_argument("--tls-key", default="")
     s.add_argument("--tls-ca-cert", default="")
     s.add_argument("--tls-skip-verify", action="store_true")
+    s.add_argument("--qos-max-concurrent", type=int, default=None,
+                   help="concurrency gate on query dispatch (0 disables)")
+    s.add_argument("--qos-max-queue", type=int, default=None,
+                   help="bounded admission queue; excess load sheds w/ 503")
+    s.add_argument("--qos-default-deadline", type=float, default=None,
+                   help="default per-query deadline, seconds (0 = none)")
+    s.add_argument("--qos-warmup", default=None,
+                   help='kernel warmup set, e.g. "count,topn,bsi" '
+                        '("" disables)')
     s.add_argument("--import-pool-mb", type=int, default=None,
                    help="buffer-pool pages pre-faulted at boot (0 disables)")
     s.add_argument("--trace-endpoint", default="",

@@ -106,7 +106,22 @@ class LocalClient:
         return peer
 
     def query_node(self, node, index, query, shards, remote=True):
-        return self._peer(node).handle_query(index, query, shards, remote)
+        peer = self._peer(node)
+        # Cross the serialization boundary the way the HTTP transport
+        # does (X-Deadline, server/httpclient.py): don't dispatch an
+        # already-expired query, and hand the peer a RE-DERIVED token
+        # (absolute expiry only — the coordinator's local cancel flag
+        # doesn't travel over the wire either).
+        from pilosa_tpu.qos import deadline as qos_deadline
+        dl = qos_deadline.current_deadline()
+        if dl is None:
+            return peer.handle_query(index, query, shards, remote)
+        dl.check()
+        token = qos_deadline.set_current_deadline(dl.rederive())
+        try:
+            return peer.handle_query(index, query, shards, remote)
+        finally:
+            qos_deadline.reset_current_deadline(token)
 
     def fragment_blocks(self, node, index, field, view, shard):
         return self._peer(node).handle_fragment_blocks(index, field, view, shard)

@@ -272,18 +272,26 @@ class Cluster:
         pending = list(shards)
         pql = str(c)  # serialize the node-boundary query once
         # The fan-out pool's threads don't inherit contextvars; carry
-        # the active trace id into them so remote sub-queries join it.
+        # the active trace id AND deadline into them so remote
+        # sub-queries join the trace and stay cancellable.
         from pilosa_tpu.obs import tracing
+        from pilosa_tpu.qos import deadline as qos_deadline
         tid = tracing.current_trace_id()
+        dl = qos_deadline.current_deadline()
 
         def _with_trace(fn):
-            if tid is None:
-                return fn()
-            token = tracing.set_current_trace(tid)
+            tokens = []
+            if tid is not None:
+                tokens.append((tracing.reset_current_trace,
+                               tracing.set_current_trace(tid)))
+            if dl is not None:
+                tokens.append((qos_deadline.reset_current_deadline,
+                               qos_deadline.set_current_deadline(dl)))
             try:
                 return fn()
             finally:
-                tracing.reset_current_trace(token)
+                for reset, token in reversed(tokens):
+                    reset(token)
 
         def run_local(node_shards: list[int]):
             def go():
@@ -301,6 +309,12 @@ class Cluster:
                 node, idx.name, pql, node_shards, remote=True)[0])
 
         while pending:
+            # Cancel the whole fan-out (including failover retry waves)
+            # once the coordinator's deadline is spent: raising here
+            # means no partial result can escape and no further peer
+            # queries launch.
+            if dl is not None:
+                dl.check()
             groups = self.shards_by_node(nodes, idx.name, pending)
             failed: list[int] = []
             tasks: list[tuple[str, list[int], Any]] = []

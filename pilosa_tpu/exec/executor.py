@@ -54,6 +54,7 @@ from pilosa_tpu.exec.result import (
 )
 from pilosa_tpu.pql import BETWEEN, NEQ, Call, Condition, Query, parse
 from pilosa_tpu.pql import ast as pql_ast
+from pilosa_tpu.qos.deadline import check_current as check_deadline
 
 _MAXINT = (1 << 63) - 1
 
@@ -177,6 +178,9 @@ class Executor:
         # results so the coordinator can merge them (executor.go:113-160).
         results = []
         for call in query.calls:
+            # Between plan steps: an expired/cancelled deadline stops
+            # the query before it consumes more device time.
+            check_deadline()
             if not opt.remote:
                 call = self._translate_call(idx, call)  # clones
             else:
@@ -446,9 +450,13 @@ class Executor:
                                            map_fn, reduce_fn,
                                            local_batch_fn=local_batch_fn)
         if local_batch_fn is not None:
+            check_deadline()
             return local_batch_fn(list(shards))
         acc = None
         for shard in shards:
+            # Per-shard cancellation point: an expired deadline stops
+            # the scan instead of finishing the remaining shards.
+            check_deadline()
             acc = reduce_fn(acc, map_fn(shard))
         return acc
 

@@ -16,7 +16,7 @@ _PAGE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
 
 
 def collect_runtime_gauges(stats, planner=None,
-                           probe_device: bool = True) -> dict:
+                           probe_device: bool = True, qos=None) -> dict:
     """One sweep of gauges into ``stats``; returns them for callers that
     surface the snapshot directly (the /info route, tests)."""
     out: dict[str, float] = {}
@@ -74,6 +74,20 @@ def collect_runtime_gauges(stats, planner=None,
     except Exception:
         pass
 
+    if qos is not None:
+        # Admission pressure: queue depth / in-flight per class, plus
+        # lifetime shed and deadline-miss totals. The per-class splits
+        # go out as tagged qos.* gauges via export_gauges.
+        try:
+            snap = qos.snapshot()
+            out["qosActive"] = float(snap["active"])
+            out["qosQueueDepth"] = float(snap["queuedTotal"])
+            out["qosShedTotal"] = float(snap["shed"])
+            out["qosDeadlineMissTotal"] = float(snap["deadlineMiss"])
+            qos.export_gauges(stats)
+        except Exception:
+            pass  # monitoring must never kill the node
+
     for name, value in out.items():
         stats.gauge(f"runtime.{name}", value)
     return out
@@ -86,9 +100,10 @@ class RuntimeMonitor:
     DEFAULT_INTERVAL = 30.0
 
     def __init__(self, stats, planner=None,
-                 interval: float = DEFAULT_INTERVAL):
+                 interval: float = DEFAULT_INTERVAL, qos=None):
         self.stats = stats
         self.planner = planner
+        self.qos = qos
         self.interval = interval
         self._timer: threading.Timer | None = None
         self._closed = False
@@ -101,7 +116,7 @@ class RuntimeMonitor:
         # the device-memory probe waits for the first background tick so
         # ServerNode.open() never blocks on backend init.
         collect_runtime_gauges(self.stats, self.planner,
-                               probe_device=False)
+                               probe_device=False, qos=self.qos)
         self._schedule()
 
     def _schedule(self) -> None:
@@ -109,7 +124,8 @@ class RuntimeMonitor:
 
         def tick():
             try:
-                collect_runtime_gauges(self.stats, self.planner)
+                collect_runtime_gauges(self.stats, self.planner,
+                                       qos=self.qos)
             except Exception:
                 pass  # monitoring must never kill the node
             finally:

@@ -78,10 +78,21 @@ class TransferBatcher:
                 fut.set_exception(e)
         return fut
 
-    def close(self) -> None:
+    def close(self, timeout: float | None = 30.0) -> None:
+        """Drain-and-join: mark closed, wake the resolver, and wait for
+        it to finish every transfer already queued. Without the join, a
+        close racing in-flight submits could drop queued futures on
+        process exit (the resolver is a daemon thread); after close
+        returns, every future enqueued before it is resolved, and any
+        later ``submit`` resolves synchronously on the caller's thread.
+        Safe to call repeatedly and from a resolver callback (joining
+        the current thread is skipped)."""
         with self._cv:
             self._closed = True
-            self._cv.notify()
+            self._cv.notify_all()
+            t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout)
 
     # -- resolver --------------------------------------------------------
 

@@ -453,6 +453,20 @@ class MeshPlanner:
             self._plan_cache.clear()
             self._cache_bytes = 0
 
+    def drop_index(self, index_name: str) -> None:
+        """Evict one index's entries from the stack/filter/plan caches.
+        Compiled programs (`_fn_cache`) are structural — not tied to any
+        index — and are kept; this is what lets the QoS warmup service
+        discard its scratch index without losing the warmed kernels."""
+        with self._cache_lock:
+            for key in [k for k in self._stack_cache if k[0] == index_name]:
+                self._cache_bytes -= self._stack_cache.pop(key)[2].nbytes
+            for key in [k for k in self._filter_host_cache
+                        if k[0] == index_name]:
+                del self._filter_host_cache[key]
+            for key in [k for k in self._plan_cache if k[0] == index_name]:
+                del self._plan_cache[key]
+
     def close(self) -> None:
         """Release caches and stop the batcher's resolver thread."""
         self.invalidate()

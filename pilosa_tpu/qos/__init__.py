@@ -1,0 +1,56 @@
+"""Quality-of-service: query admission control, deadlines, load
+shedding, slow-query logging, and kernel warmup.
+
+Everything the HTTP edge needs is exported here. ``WarmupService`` is
+re-exported too but imports the executor lazily (inside its run), so
+``pilosa_tpu.exec`` can import ``pilosa_tpu.qos.deadline`` without a
+cycle.
+"""
+
+from .admission import (
+    CLASS_BATCH,
+    CLASS_INTERACTIVE,
+    CLASS_INTERNAL,
+    DEFAULT_WEIGHTS,
+    QOS_CLASSES,
+    AdmissionController,
+    QueryShedError,
+    normalize_class,
+)
+from .deadline import (
+    DEADLINE_HEADER,
+    Deadline,
+    DeadlineExceededError,
+    check_current,
+    current_deadline,
+    extract_http_headers,
+    inject_http_headers,
+    reset_current_deadline,
+    set_current_deadline,
+)
+from .slowlog import SlowQueryLog
+from .warmup import DEFAULT_KINDS, DEFAULT_SHARD_COUNTS, WarmupService
+
+__all__ = [
+    "AdmissionController",
+    "CLASS_BATCH",
+    "CLASS_INTERACTIVE",
+    "CLASS_INTERNAL",
+    "DEADLINE_HEADER",
+    "DEFAULT_KINDS",
+    "DEFAULT_SHARD_COUNTS",
+    "DEFAULT_WEIGHTS",
+    "Deadline",
+    "DeadlineExceededError",
+    "QOS_CLASSES",
+    "QueryShedError",
+    "SlowQueryLog",
+    "WarmupService",
+    "check_current",
+    "current_deadline",
+    "extract_http_headers",
+    "inject_http_headers",
+    "normalize_class",
+    "reset_current_deadline",
+    "set_current_deadline",
+]

@@ -1,0 +1,249 @@
+"""Admission control: weighted priority classes, a bounded wait queue,
+and a concurrency gate on query dispatch.
+
+The reference Pilosa bounds executor work with a worker pool
+(executor.go:2561); the TPU-native equivalent gates at admission time,
+because device dispatch is where oversubscription actually hurts (every
+concurrent query pins host staging buffers and competes for the single
+device stream). Excess load is shed with ``QueryShedError`` — surfaced
+as HTTP 503 + ``Retry-After`` at the edge — rather than queueing
+unboundedly.
+
+Scheduling between classes is smooth weighted round-robin over the
+non-empty wait queues, so a flood of batch queries cannot starve
+interactive ones, and vice versa a steady interactive stream still
+leaks batch queries through at the configured ratio.
+
+The internal-sync class gets reserved headroom *above* the public
+concurrency limit: remote fan-out legs arriving from a coordinator must
+never queue behind the coordinator-held slots that are waiting on them
+(the classic distributed admission deadlock).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import deque
+
+from .deadline import Deadline, DeadlineExceededError, current_deadline
+
+CLASS_INTERACTIVE = "interactive"
+CLASS_BATCH = "batch"
+CLASS_INTERNAL = "internal"
+
+QOS_CLASSES = (CLASS_INTERACTIVE, CLASS_BATCH, CLASS_INTERNAL)
+
+DEFAULT_WEIGHTS = {CLASS_INTERACTIVE: 8, CLASS_INTERNAL: 4, CLASS_BATCH: 1}
+
+
+class QueryShedError(RuntimeError):
+    """Admission queue is full — surfaced as HTTP 503 + Retry-After.
+
+    Not a PilosaError: the generic query-error handlers map those to
+    400, and a shed is the server's fault, not the client's.
+    """
+
+    def __init__(self, message: str = "query shed: admission queue full",
+                 retry_after: float = 1.0):
+        super().__init__(message)
+        self.retry_after = float(retry_after)
+
+
+def normalize_class(name: str | None, remote: bool = False) -> str:
+    """Map a client-supplied class name to a known class. Remote legs of
+    a fan-out are always internal-sync regardless of what the header
+    says — the coordinator already paid the public admission toll."""
+    if remote:
+        return CLASS_INTERNAL
+    name = (name or "").strip().lower()
+    return name if name in QOS_CLASSES else CLASS_INTERACTIVE
+
+
+class _Waiter:
+    __slots__ = ("cls", "granted", "abandoned")
+
+    def __init__(self, cls: str):
+        self.cls = cls
+        self.granted = False
+        self.abandoned = False
+
+
+class AdmissionController:
+    """Concurrency gate + bounded per-class wait queues.
+
+    ``max_concurrent=0`` disables the gate entirely (admit() still
+    tracks metrics and the slow-query log / default deadline still
+    apply), which keeps single-node test servers byte-for-byte on the
+    old code path.
+    """
+
+    def __init__(self, max_concurrent: int = 0, max_queue: int = 64,
+                 weights: dict[str, int] | None = None,
+                 internal_reserve: int = 4,
+                 default_deadline: float = 0.0,
+                 stats=None, slow_log=None):
+        self.max_concurrent = int(max_concurrent)
+        self.max_queue = max(0, int(max_queue))
+        self.weights = dict(DEFAULT_WEIGHTS)
+        if weights:
+            self.weights.update({normalize_class(k): int(v)
+                                 for k, v in weights.items()})
+        self.internal_reserve = max(0, int(internal_reserve))
+        self.default_deadline = float(default_deadline)
+        self.slow_log = slow_log
+        self._stats = stats
+        self._cv = threading.Condition()
+        self._active = 0
+        self._queues: dict[str, deque[_Waiter]] = {c: deque() for c in QOS_CLASSES}
+        # smooth-WRR credit per class (Nginx upstream algorithm)
+        self._credit: dict[str, float] = {c: 0.0 for c in QOS_CLASSES}
+        self._shed_total = 0
+        self._deadline_miss_total = 0
+        self._admitted_total = 0
+
+    # -- scheduling ---------------------------------------------------
+
+    def _limit_for(self, cls: str) -> int:
+        if cls == CLASS_INTERNAL:
+            return self.max_concurrent + self.internal_reserve
+        return self.max_concurrent
+
+    def _queued(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    def _pick_class(self) -> str | None:
+        """Smooth weighted round-robin over non-empty queues that have
+        headroom under their class limit. Called with the lock held."""
+        eligible = [c for c, q in self._queues.items()
+                    if q and self._active < self._limit_for(c)]
+        if not eligible:
+            return None
+        total = 0
+        best = None
+        for c in eligible:
+            w = self.weights.get(c, 1)
+            total += w
+            self._credit[c] += w
+            if best is None or self._credit[c] > self._credit[best]:
+                best = c
+        self._credit[best] -= total
+        return best
+
+    def _grant_next(self) -> None:
+        """Hand freed slots to queued waiters. Called with lock held."""
+        while True:
+            cls = self._pick_class()
+            if cls is None:
+                return
+            w = self._queues[cls].popleft()
+            if w.abandoned:
+                continue
+            w.granted = True
+            self._active += 1
+            self._cv.notify_all()
+
+    # -- admission ----------------------------------------------------
+
+    def _retry_after(self) -> float:
+        # Rough drain estimate: one "generation" of the queue per slot
+        # turn; clamp to a 1..30s hint so clients neither hammer nor
+        # stay away forever.
+        if self.max_concurrent <= 0:
+            return 1.0
+        depth = self._queued()
+        return min(30.0, max(1.0, round(depth / self.max_concurrent + 0.5)))
+
+    def acquire(self, cls: str, deadline: Deadline | None = None) -> None:
+        cls = normalize_class(cls)
+        if self.max_concurrent <= 0:
+            self._count("qos.admitted", cls)
+            self._admitted_total += 1
+            return
+        t0 = time.perf_counter()
+        with self._cv:
+            if self._active < self._limit_for(cls) and not self._queues[cls]:
+                self._active += 1
+                self._admit_metrics(cls, t0)
+                return
+            if self._queued() >= self.max_queue:
+                self._shed_total += 1
+                self._count("qos.shed", cls)
+                raise QueryShedError(retry_after=self._retry_after())
+            w = _Waiter(cls)
+            self._queues[cls].append(w)
+            try:
+                while not w.granted:
+                    timeout = None
+                    if deadline is not None:
+                        timeout = deadline.remaining()
+                        if deadline.cancelled or \
+                                (timeout is not None and timeout <= 0):
+                            raise DeadlineExceededError(
+                                "deadline expired while queued for admission")
+                    self._cv.wait(timeout=timeout)
+            except BaseException as e:
+                if w.granted:
+                    # Granted concurrently with the timeout/interrupt:
+                    # the slot is ours, give it back properly.
+                    self._active -= 1
+                    self._grant_next()
+                else:
+                    w.abandoned = True
+                if isinstance(e, DeadlineExceededError):
+                    self._deadline_miss_total += 1
+                    self._count("qos.deadlineMiss", cls)
+                raise
+            self._admit_metrics(cls, t0)
+
+    def release(self) -> None:
+        if self.max_concurrent <= 0:
+            return
+        with self._cv:
+            self._active -= 1
+            self._grant_next()
+
+    @contextlib.contextmanager
+    def admit(self, cls: str, deadline: Deadline | None = None):
+        if deadline is None:
+            deadline = current_deadline()
+        self.acquire(cls, deadline)
+        try:
+            yield
+        finally:
+            self.release()
+
+    # -- observability ------------------------------------------------
+
+    def _count(self, name: str, cls: str) -> None:
+        if self._stats is not None:
+            self._stats.with_tags(f"class:{cls}").count(name, 1)
+
+    def _admit_metrics(self, cls: str, t0: float) -> None:
+        self._admitted_total += 1
+        if self._stats is not None:
+            sc = self._stats.with_tags(f"class:{cls}")
+            sc.count("qos.admitted", 1)
+            sc.timing("qos.waitSeconds", time.perf_counter() - t0)
+
+    def snapshot(self) -> dict:
+        with self._cv:
+            queued = {c: len(q) for c, q in self._queues.items()}
+        return {
+            "active": self._active,
+            "queued": queued,
+            "queuedTotal": sum(queued.values()),
+            "admitted": self._admitted_total,
+            "shed": self._shed_total,
+            "deadlineMiss": self._deadline_miss_total,
+            "maxConcurrent": self.max_concurrent,
+            "maxQueue": self.max_queue,
+        }
+
+    def export_gauges(self, stats) -> None:
+        snap = self.snapshot()
+        stats.gauge("qos.active", float(snap["active"]))
+        stats.gauge("qos.queueDepth", float(snap["queuedTotal"]))
+        for c, n in snap["queued"].items():
+            stats.with_tags(f"class:{c}").gauge("qos.queueDepth", float(n))

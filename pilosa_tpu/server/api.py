@@ -52,6 +52,10 @@ class API:
         #: ServerNode — used to answer routing queries on a standalone
         #: node, where there is no cluster to consult.
         self.local_node = None
+        #: QoS front (pilosa_tpu.qos.AdmissionController), set by
+        #: ServerNode; None = no admission gate, no default deadline,
+        #: no slow-query log — the pre-QoS behavior.
+        self.qos = None
 
     #: method-availability matrix per cluster state (reference
     #: api.go:99-105 validAPIMethods + :1379-1411 method sets): during

@@ -8,6 +8,8 @@ remote=true, fragment sync via /internal/fragment/*, messages via
 from __future__ import annotations
 
 import json
+import random
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -16,16 +18,32 @@ from typing import Any
 import numpy as np
 
 from pilosa_tpu.cluster.node import Node
+from pilosa_tpu.qos.deadline import DeadlineExceededError
+from pilosa_tpu.qos.deadline import inject_http_headers as _inject_deadline
+from pilosa_tpu.qos.deadline import current_deadline as _current_deadline
 
 
 class NodeHTTPError(RuntimeError):
     """A live peer rejected the request (HTTP status attached). Stays a
     RuntimeError so existing 'alive but refused' handling keeps working;
-    failover paths must keep catching ConnectionError only."""
+    failover paths must keep catching ConnectionError only.
 
-    def __init__(self, code: int, message: str):
+    ``retry_after`` carries the peer's Retry-After hint (seconds) when
+    it shed the request (QoS 503); None otherwise."""
+
+    def __init__(self, code: int, message: str,
+                 retry_after: float | None = None):
         super().__init__(message)
         self.code = code
+        self.retry_after = retry_after
+
+
+#: bounded exponential backoff for idempotent requests a peer shed
+#: (503). Full jitter (AWS-style) so a synchronized burst of retries
+#: doesn't re-overload the node that just told everyone to back off.
+RETRY_503_ATTEMPTS = 3
+RETRY_BASE_DELAY = 0.1
+RETRY_MAX_DELAY = 5.0
 
 
 class HTTPInternalClient:
@@ -66,41 +84,104 @@ class HTTPInternalClient:
             self._ssl_ctx = ctx
         return ctx
 
+    def _deadline_timeout(self) -> float:
+        """Per-request socket timeout capped to the active deadline's
+        remaining budget; raises instead of sending a request that
+        cannot finish in time."""
+        dl = _current_deadline()
+        if dl is None:
+            return self.timeout
+        rem = dl.remaining()
+        if rem is None:
+            dl.check()  # cancel-only token
+            return self.timeout
+        if rem <= 0 or dl.cancelled:
+            raise DeadlineExceededError("deadline expired before remote call")
+        return max(0.05, min(self.timeout, rem))
+
     def _request_raw(self, node: Node, method: str, path: str,
                      body: bytes | None = None,
                      accept: str | None = None,
-                     content_type: str = "application/json") -> tuple[bytes, str]:
-        """Returns (body, content-type)."""
-        req = urllib.request.Request(self._url(node, path), data=body,
-                                     method=method)
-        if body is not None:
-            req.add_header("Content-Type", content_type)
-        if accept is not None:
-            req.add_header("Accept", accept)
-        from pilosa_tpu.obs.tracing import inject_http_headers
-        for k, v in inject_http_headers({}).items():
-            req.add_header(k, v)
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout,
-                                        context=self._ctx(req.full_url)) as resp:
-                return resp.read(), resp.headers.get("Content-Type", "")
-        except urllib.error.HTTPError as e:
-            # The peer is alive but rejected the request — application
-            # error, NOT a connection failure (failover must not trigger).
-            detail = e.read().decode(errors="replace")
-            if e.code == 404:
-                raise LookupError(f"{node.id}: {detail}") from e
-            raise NodeHTTPError(e.code,
-                                f"node {node.id} HTTP {e.code}: {detail}") \
-                from e
-        except (urllib.error.URLError, OSError) as e:
-            raise ConnectionError(f"node {node.id} unreachable: {e}") from e
+                     content_type: str = "application/json",
+                     retry_503: bool = False) -> tuple[bytes, str]:
+        """Returns (body, content-type).
+
+        ``retry_503=True`` (idempotent requests only): when the peer
+        sheds with 503, retry up to RETRY_503_ATTEMPTS times with
+        bounded exponential backoff + full jitter, honoring the peer's
+        Retry-After hint as the floor — and never sleeping past the
+        active deadline.
+        """
+        attempt = 0
+        while True:
+            req = urllib.request.Request(self._url(node, path), data=body,
+                                         method=method)
+            if body is not None:
+                req.add_header("Content-Type", content_type)
+            if accept is not None:
+                req.add_header("Accept", accept)
+            from pilosa_tpu.obs.tracing import inject_http_headers
+            headers: dict = {}
+            inject_http_headers(headers)
+            _inject_deadline(headers)
+            for k, v in headers.items():
+                req.add_header(k, v)
+            try:
+                with urllib.request.urlopen(
+                        req, timeout=self._deadline_timeout(),
+                        context=self._ctx(req.full_url)) as resp:
+                    return resp.read(), resp.headers.get("Content-Type", "")
+            except urllib.error.HTTPError as e:
+                # The peer is alive but rejected the request — application
+                # error, NOT a connection failure (failover must not
+                # trigger).
+                detail = e.read().decode(errors="replace")
+                if e.code == 404:
+                    raise LookupError(f"{node.id}: {detail}") from e
+                retry_after = None
+                if e.code == 503:
+                    try:
+                        retry_after = float(e.headers.get("Retry-After"))
+                    except (TypeError, ValueError):
+                        retry_after = None
+                    if retry_503 and attempt < RETRY_503_ATTEMPTS:
+                        delay = self._backoff_delay(attempt, retry_after)
+                        if delay is not None:
+                            time.sleep(delay)
+                            attempt += 1
+                            continue
+                raise NodeHTTPError(e.code,
+                                    f"node {node.id} HTTP {e.code}: {detail}",
+                                    retry_after=retry_after) from e
+            except (urllib.error.URLError, OSError) as e:
+                raise ConnectionError(f"node {node.id} unreachable: {e}") \
+                    from e
+
+    @staticmethod
+    def _backoff_delay(attempt: int, retry_after: float | None) -> float | None:
+        """Jittered, bounded delay before re-sending a shed request, or
+        None when the active deadline can't afford the wait (give the
+        remaining budget back to the caller's failover logic instead of
+        sleeping it away)."""
+        cap = min(RETRY_MAX_DELAY, RETRY_BASE_DELAY * (2 ** attempt))
+        delay = random.uniform(0, cap)
+        if retry_after is not None:
+            # The shedding node knows its queue better than our curve
+            # does; keep jitter on top so retries don't synchronize.
+            delay = retry_after + random.uniform(0, cap)
+        dl = _current_deadline()
+        if dl is not None:
+            rem = dl.remaining()
+            if rem is not None and rem <= delay:
+                return None
+        return delay
 
     def _request(self, node: Node, method: str, path: str,
                  body: bytes | None = None,
                  content_type: str = "application/json") -> Any:
         data, _ = self._request_raw(node, method, path, body,
-                                    content_type=content_type)
+                                    content_type=content_type,
+                                    retry_503=(method == "GET"))
         return json.loads(data) if data else {}
 
     def _post_import(self, node: Node, req: dict,
@@ -145,10 +226,11 @@ class HTTPInternalClient:
         if remote:
             # Advertise binary-frame support: Row results come back as
             # roaring blobs instead of JSON int lists (~10-100x smaller
-            # for large rows; wire.encode_frames).
+            # for large rows; wire.encode_frames). Reads are idempotent,
+            # so a shed (503) leg may back off and retry.
             data, ctype = self._request_raw(
                 node, "POST", path, query.encode(),
-                accept=wire.FRAMES_CONTENT_TYPE)
+                accept=wire.FRAMES_CONTENT_TYPE, retry_503=True)
             if ctype.startswith(wire.FRAMES_CONTENT_TYPE):
                 return wire.decode_frames(data)
             resp = json.loads(data) if data else {}

@@ -13,6 +13,7 @@ from __future__ import annotations
 import json
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
@@ -27,6 +28,8 @@ from pilosa_tpu.errors import (
     QueryError,
 )
 from pilosa_tpu.pql import ParseError
+from pilosa_tpu.qos import DeadlineExceededError, QueryShedError, normalize_class
+from pilosa_tpu.qos import deadline as qos_deadline
 from pilosa_tpu.server.api import API
 
 _CONFLICTS = (IndexExistsError, FieldExistsError)
@@ -121,6 +124,7 @@ def _make_handler(api: API):
             parsed = urlparse(self.path)
             params = {k: v[0] for k, v in parse_qs(parsed.query).items()}
             params["_accept"] = self.headers.get("Accept", "")
+            params["_qos_class"] = self.headers.get("X-Qos-Class", "")
             length = int(self.headers.get("Content-Length") or 0)
             body = self.rfile.read(length) if length else b""
             for pattern, methods in routes:
@@ -131,16 +135,26 @@ def _make_handler(api: API):
                 if fn is None:
                     continue
                 headers = None
-                # Join a propagated cross-node trace, if any.
+                # Join a propagated cross-node trace and deadline.
                 from pilosa_tpu.obs import tracing as _tr
                 tid = _tr.extract_http_headers(self.headers)
                 token = _tr.set_current_trace(tid) if tid else None
+                dl = qos_deadline.extract_http_headers(self.headers)
+                dtoken = (qos_deadline.set_current_deadline(dl)
+                          if dl is not None else None)
                 try:
                     out = fn(m.groupdict(), params, body)
                     if len(out) == 3:  # optional extra response headers
                         status, payload, headers = out
                     else:
                         status, payload = out
+                except QueryShedError as e:
+                    # Load shed: tell the client when to come back
+                    # instead of queueing unboundedly.
+                    status, payload = 503, {"error": str(e)}
+                    headers = {"Retry-After": str(int(e.retry_after))}
+                except DeadlineExceededError as e:
+                    status, payload = 504, {"error": str(e)}
                 except _CONFLICTS as e:
                     status, payload = 409, {"error": str(e)}
                 except _NOT_FOUND as e:
@@ -155,6 +169,8 @@ def _make_handler(api: API):
                 except Exception as e:  # pragma: no cover
                     status, payload = 500, {"error": f"internal: {e}"}
                 finally:
+                    if dtoken is not None:
+                        qos_deadline.reset_current_deadline(dtoken)
                     if token is not None:
                         _tr.reset_current_trace(token)
                 return self._reply(status, payload, headers)
@@ -277,19 +293,67 @@ def _build_routes(api: API):
         remote = params.get("remote") == "true"
         frames = (remote
                   and wire.FRAMES_CONTENT_TYPE in params.get("_accept", ""))
+        # QoS front: classify, apply the node default deadline when the
+        # client sent none, gate on admission, and feed the slow log.
+        # Shed/deadline errors propagate to _dispatch's 503/504 mapping.
+        qos_ctl = getattr(api, "qos", None)
+        cls = normalize_class(params.get("qosClass")
+                              or params.get("_qos_class"), remote=remote)
+        dtoken = None
+        if (qos_ctl is not None and qos_ctl.default_deadline > 0
+                and qos_deadline.current_deadline() is None):
+            dtoken = qos_deadline.set_current_deadline(
+                qos_deadline.Deadline(timeout=qos_ctl.default_deadline))
+        status = "ok"
+        t0 = time.perf_counter()
         try:
-            resp = api.query(
-                pv["index"], body.decode(),
-                shards=shards,
-                column_attrs=params.get("columnAttrs") == "true",
-                exclude_row_attrs=params.get("excludeRowAttrs") == "true",
-                exclude_columns=params.get("excludeColumns") == "true",
-                remote=remote, accept_frames=frames,
-                cache=params.get("noCache") != "true")
-        except _NOT_FOUND + (ApiMethodNotAllowedError,):
-            raise
-        except (QueryError, ParseError, PilosaError, ValueError) as e:
-            return 400, {"error": str(e)}
+            try:
+                # An already-expired deadline 504s even when the answer
+                # would come free from the query cache: the client has
+                # abandoned the request, and answering 200 here would
+                # make expiry behavior depend on cache residency.
+                qos_deadline.check_current()
+                if qos_ctl is not None:
+                    with qos_ctl.admit(cls):
+                        resp = api.query(
+                            pv["index"], body.decode(),
+                            shards=shards,
+                            column_attrs=params.get("columnAttrs") == "true",
+                            exclude_row_attrs=params.get(
+                                "excludeRowAttrs") == "true",
+                            exclude_columns=params.get(
+                                "excludeColumns") == "true",
+                            remote=remote, accept_frames=frames,
+                            cache=params.get("noCache") != "true")
+                else:
+                    resp = api.query(
+                        pv["index"], body.decode(),
+                        shards=shards,
+                        column_attrs=params.get("columnAttrs") == "true",
+                        exclude_row_attrs=params.get(
+                            "excludeRowAttrs") == "true",
+                        exclude_columns=params.get(
+                            "excludeColumns") == "true",
+                        remote=remote, accept_frames=frames,
+                        cache=params.get("noCache") != "true")
+            except _NOT_FOUND + (ApiMethodNotAllowedError,):
+                status = "error"
+                raise
+            except (QueryShedError, DeadlineExceededError) as e:
+                status = ("shed" if isinstance(e, QueryShedError)
+                          else "deadline")
+                raise
+            except (QueryError, ParseError, PilosaError, ValueError) as e:
+                status = "error"
+                return 400, {"error": str(e)}
+        finally:
+            if dtoken is not None:
+                qos_deadline.reset_current_deadline(dtoken)
+            slow_log = getattr(qos_ctl, "slow_log", None)
+            if slow_log is not None and status != "shed":
+                slow_log.observe(pv["index"], body.decode(errors="replace"),
+                                 (time.perf_counter() - t0) * 1000.0,
+                                 qos_class=cls, status=status)
         if isinstance(resp, bytes):
             return 200, resp, {"Content-Type": wire.FRAMES_CONTENT_TYPE}
         return 200, resp
@@ -337,6 +401,20 @@ def _build_routes(api: API):
                 "gauges": {f"{n}{list(t) or ''}": v
                            for (n, t), v in sorted(stats.gauges.items())},
             }
+
+    def get_debug_slow_queries(pv, params, body):
+        """The QoS slow-query ring plus an admission snapshot — the
+        first stop when a node's latency goes sideways."""
+        qos_ctl = getattr(api, "qos", None)
+        if qos_ctl is None:
+            return 200, {"queries": [], "admission": None}
+        slow_log = getattr(qos_ctl, "slow_log", None)
+        return 200, {
+            "queries": slow_log.entries() if slow_log is not None else [],
+            "thresholdMs": (slow_log.threshold_ms
+                            if slow_log is not None else None),
+            "admission": qos_ctl.snapshot(),
+        }
 
     def get_debug_threads(pv, params, body):
         """Thread stack dump — the pprof-goroutine analog for diagnosing
@@ -555,6 +633,7 @@ def _build_routes(api: API):
         (r"/version", {"GET": get_version}),
         (r"/metrics", {"GET": get_metrics}),
         (r"/debug/vars", {"GET": get_debug_vars}),
+        (r"/debug/slow-queries", {"GET": get_debug_slow_queries}),
         (r"/debug/threads", {"GET": get_debug_threads}),
         (r"/debug/profile", {"GET": get_debug_profile}),
         (r"/debug/heap", {"GET": get_debug_heap}),

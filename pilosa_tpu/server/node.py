@@ -53,7 +53,15 @@ class ServerNode:
                  tls_ca_cert: str | None = None,
                  tls_skip_verify: bool | None = None,
                  trace_endpoint: str | None = None,
-                 import_pool_mb: int = 0):
+                 import_pool_mb: int = 0,
+                 qos_max_concurrent: int = 0,
+                 qos_max_queue: int = 64,
+                 qos_internal_reserve: int = 4,
+                 qos_class_weights: dict[str, int] | None = None,
+                 qos_default_deadline: float = 0.0,
+                 qos_slow_query_ms: float = 500.0,
+                 qos_warmup: str = "",
+                 qos_warmup_shards: str = "1,8,32"):
         host, _, port = bind.partition(":")
         self.host, self.port = host or "127.0.0.1", int(port or 10101)
         # Node identity IS the address — member ids are built the same
@@ -134,6 +142,24 @@ class ServerNode:
         self.api.message_handler = self.handle_message
         self.api.import_handler = self.handle_internal_import
         self.api.resize_handler = self.resize
+        # QoS front: admission gate + default deadline + slow-query log.
+        # max_concurrent=0 (the constructor default) leaves the gate
+        # open — metrics/slow-log only — so embedded/test nodes keep the
+        # old dispatch behavior unless explicitly configured.
+        from pilosa_tpu.qos import AdmissionController, SlowQueryLog
+        self.qos = AdmissionController(
+            max_concurrent=qos_max_concurrent,
+            max_queue=qos_max_queue,
+            internal_reserve=qos_internal_reserve,
+            weights=qos_class_weights,
+            default_deadline=qos_default_deadline,
+            stats=self.stats,
+            slow_log=SlowQueryLog(threshold_ms=qos_slow_query_ms,
+                                  stats=self.stats))
+        self.api.qos = self.qos
+        self._qos_warmup = qos_warmup
+        self._qos_warmup_shards = qos_warmup_shards
+        self.warmup = None
         self.http = HTTPServer(self.api, self.host, self.port,
                                tls_cert=tls_cert, tls_key=tls_key)
         self.port = self.http.port
@@ -288,8 +314,24 @@ class ServerNode:
             self._schedule_check_nodes()
         from pilosa_tpu.obs.runtime import RuntimeMonitor
         self.runtime_monitor = RuntimeMonitor(self.stats,
-                                              self.executor.planner)
+                                              self.executor.planner,
+                                              qos=self.qos)
         self.runtime_monitor.start()
+        if self._qos_warmup and self.executor.planner is not None:
+            # Precompile the canonical kernel shapes in the background
+            # (the planner's program cache is structural, so these
+            # compiles serve real traffic); node start never blocks on
+            # XLA.
+            from pilosa_tpu.qos import WarmupService
+            kinds = [k.strip() for k in self._qos_warmup.split(",")
+                     if k.strip()]
+            shard_counts = [int(s) for s in
+                            str(self._qos_warmup_shards).split(",")
+                            if s.strip()]
+            self.warmup = WarmupService(self.executor.planner, kinds=kinds,
+                                        shard_counts=shard_counts,
+                                        stats=self.stats)
+            self.warmup.start()
 
     #: join announcement retry schedule (seconds between attempts);
     #: after JOIN_RETRIES fast attempts the announcer drops to the slow

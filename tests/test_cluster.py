@@ -482,3 +482,117 @@ def test_asymmetric_partition_does_not_mark_node_down():
     changed = check_nodes(a.cluster, client, discover=False)
     assert "node2" in changed
     assert a.cluster.node_by_id("node2").state == "DOWN"
+
+
+# -- deadline propagation across the fan-out -------------------------------
+
+def test_expired_deadline_cancels_fanout_no_partial_results():
+    """A coordinator whose deadline already passed must cancel the whole
+    query — zero remote legs dispatched, DeadlineExceededError raised —
+    never return partial results."""
+    from pilosa_tpu.qos import deadline as qdl
+
+    lc = LocalCluster(3)
+    seed_cluster(lc)
+    remote_calls = []
+    orig = lc.client.query_node
+
+    def recording(node, index, query, shards, remote=True):
+        remote_calls.append(node.id)
+        return orig(node, index, query, shards, remote)
+
+    lc.client.query_node = recording
+    tok = qdl.set_current_deadline(qdl.Deadline(timeout=-1))
+    try:
+        with pytest.raises(qdl.DeadlineExceededError):
+            lc.query("i", "Count(Row(f=1))", cache=False)
+    finally:
+        qdl.reset_current_deadline(tok)
+        lc.client.query_node = orig
+    assert remote_calls == []
+
+
+def test_cancel_stops_failover_retry_wave():
+    """A query cancelled while a node failure is being handled must NOT
+    launch the failover retry wave: the coordinator raises instead of
+    re-mapping the failed shards onto replicas and assembling a result
+    the client already gave up on."""
+    from pilosa_tpu.qos import deadline as qdl
+
+    # 2 nodes, full replication: node1's shards can fail over to node0.
+    # Seed BOTH replicas (seed_cluster writes primaries only, but this
+    # control run needs the replica to hold real data).
+    lc = LocalCluster(2, replica_n=2)
+    lc.create_index("i")
+    lc.create_field("i", "f")
+    rng = np.random.default_rng(5)
+    rows = rng.integers(0, 4, 2000)
+    cols = rng.integers(0, 4 * SHARD_WIDTH, 2000)
+    for cn in lc.nodes:
+        cn.holder.field("i", "f").import_bits(rows, cols)
+    want = expected_single_node([(rows, cols)], "Count(Row(f=1))")
+    orig = lc.client.query_node
+
+    # Control: a plain node failure DOES fail over and still produces
+    # the complete result (this is the retry wave we then cancel).
+    calls = []
+
+    def failing_once(node, index, query, shards, remote=True):
+        calls.append(node.id)
+        if len(calls) == 1:
+            raise ConnectionError(f"node {node.id} is down")
+        return orig(node, index, query, shards, remote)
+
+    lc.client.query_node = failing_once
+    try:
+        assert lc.query("i", "Count(Row(f=1))", cache=False) == want
+    finally:
+        lc.client.query_node = orig
+
+    # Cancelled during the same failure: the between-wave deadline check
+    # fires before any shard is re-mapped.
+    dl = qdl.Deadline()  # no time limit; cancellation only
+
+    def failing_cancelled(node, index, query, shards, remote=True):
+        dl.cancel()
+        raise ConnectionError(f"node {node.id} is down")
+
+    lc.client.query_node = failing_cancelled
+    tok = qdl.set_current_deadline(dl)
+    try:
+        with pytest.raises(qdl.DeadlineExceededError):
+            lc.query("i", "Count(Row(f=1))", cache=False)
+    finally:
+        qdl.reset_current_deadline(tok)
+        lc.client.query_node = orig
+
+
+def test_deadline_rederived_on_remote_legs():
+    """Each remote leg sees a peer-local token with the coordinator's
+    absolute expiry (the X-Deadline re-derivation), not the coordinator's
+    own token object."""
+    from pilosa_tpu.qos import deadline as qdl
+
+    lc = LocalCluster(3)
+    data = seed_cluster(lc)
+    seen = []
+    for cn in lc.nodes[1:]:
+        orig_handle = cn.handle_query
+
+        def spying(index, query, shards, remote, _orig=orig_handle):
+            seen.append(qdl.current_deadline())
+            return _orig(index, query, shards, remote)
+
+        cn.handle_query = spying
+
+    coordinator_dl = qdl.Deadline(timeout=60)
+    tok = qdl.set_current_deadline(coordinator_dl)
+    try:
+        got = lc.query("i", "Count(Row(f=1))", cache=False)
+    finally:
+        qdl.reset_current_deadline(tok)
+    assert got == expected_single_node(data, "Count(Row(f=1))")
+    assert seen, "no remote legs dispatched"
+    for dl in seen:
+        assert dl is not None and dl is not coordinator_dl
+        assert dl.expires_at == pytest.approx(coordinator_dl.expires_at)

@@ -169,6 +169,34 @@ def test_batcher_mixed_shapes():
     bt.close()
 
 
+def test_batcher_close_drains_and_joins():
+    """Regression: close() must wake the resolver, wait for every queued
+    future to resolve (no futures dropped on shutdown), and leave later
+    submits resolving synchronously. Double-close is safe."""
+    import jax
+    from pilosa_tpu.parallel.batcher import TransferBatcher
+
+    bt = TransferBatcher()
+    futs = [bt.submit(jax.device_put(np.full(3, i, dtype=np.int32)),
+                      lambda host: host.sum())
+            for i in range(50)]
+    bt.close()
+    # the resolver thread has fully exited...
+    assert bt._thread is not None and not bt._thread.is_alive()
+    # ...and nothing it owned was dropped
+    assert all(f.done() for f in futs)
+    assert [f.result() for f in futs] == [3 * i for i in range(50)]
+    # post-close submits resolve synchronously on the caller's thread
+    fut = bt.submit(jax.device_put(np.arange(4, dtype=np.int32)),
+                    lambda host: int(host.max()))
+    assert fut.done() and fut.result() == 3
+    # post-close failures surface on the future, not the caller
+    bad = bt.submit(jax.device_put(np.arange(2, dtype=np.int32)),
+                    lambda host: 1 / 0)
+    assert isinstance(bad.exception(), ZeroDivisionError)
+    bt.close()  # idempotent
+
+
 def test_result_cache_index_recreate():
     """A deleted-and-recreated index must never serve its predecessor's
     cached results, even at an identical epoch value."""

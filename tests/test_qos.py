@@ -1,0 +1,637 @@
+"""QoS subsystem tests: deadlines, admission control / load shedding,
+slow-query log, and kernel warmup.
+
+The load-shedding test drives a REAL ServerNode over HTTP: beyond the
+admission queue bound, excess requests must get 503 + Retry-After while
+admitted interactive-class latency stays bounded; an expired deadline
+must 504 without launching any work.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.qos import (
+    CLASS_BATCH,
+    CLASS_INTERACTIVE,
+    CLASS_INTERNAL,
+    AdmissionController,
+    Deadline,
+    DeadlineExceededError,
+    QueryShedError,
+    SlowQueryLog,
+    WarmupService,
+    current_deadline,
+    normalize_class,
+    reset_current_deadline,
+    set_current_deadline,
+)
+from pilosa_tpu.qos import deadline as qdl
+from pilosa_tpu.server.node import ServerNode
+
+
+# ---------------------------------------------------------------------------
+# Deadline token
+# ---------------------------------------------------------------------------
+
+
+def test_deadline_basics():
+    dl = Deadline(timeout=60)
+    assert not dl.expired()
+    assert 59 < dl.remaining() <= 60
+    dl.check()  # no raise
+
+    expired = Deadline(timeout=-1)
+    assert expired.expired()
+    with pytest.raises(DeadlineExceededError):
+        expired.check()
+
+    unlimited = Deadline()
+    assert unlimited.remaining() is None
+    assert not unlimited.expired()
+    unlimited.cancel()
+    assert unlimited.expired()
+    with pytest.raises(DeadlineExceededError):
+        unlimited.check()
+
+
+def test_deadline_header_roundtrip():
+    dl = Deadline(timeout=30)
+    tok = set_current_deadline(dl)
+    try:
+        headers = qdl.inject_http_headers({})
+        assert qdl.DEADLINE_HEADER in headers
+    finally:
+        reset_current_deadline(tok)
+    rederived = qdl.extract_http_headers(headers)
+    assert rederived is not None
+    assert rederived.expires_at == pytest.approx(dl.expires_at)
+    # cancellation does NOT cross the wire
+    dl.cancel()
+    assert not rederived.expired()
+    # garbage header degrades to no deadline, never an error
+    assert qdl.extract_http_headers({qdl.DEADLINE_HEADER: "bogus"}) is None
+    assert qdl.extract_http_headers({}) is None
+
+
+def test_normalize_class():
+    assert normalize_class("interactive") == CLASS_INTERACTIVE
+    assert normalize_class("BATCH") == CLASS_BATCH
+    assert normalize_class("") == CLASS_INTERACTIVE
+    assert normalize_class(None) == CLASS_INTERACTIVE
+    assert normalize_class("wat") == CLASS_INTERACTIVE
+    # remote fan-out legs are always internal, whatever the header says
+    assert normalize_class("batch", remote=True) == CLASS_INTERNAL
+
+
+# ---------------------------------------------------------------------------
+# Admission controller
+# ---------------------------------------------------------------------------
+
+
+def _hold_slot(ctl, cls, hold_s):
+    """Occupy one slot on a background thread; returns (thread, started,
+    release) — set release to let it finish early."""
+    started = threading.Event()
+    release = threading.Event()
+
+    def go():
+        with ctl.admit(cls):
+            started.set()
+            release.wait(hold_s)
+
+    t = threading.Thread(target=go)
+    t.start()
+    started.wait(5)
+    return t, release
+
+
+def test_admission_shed_with_retry_after():
+    ctl = AdmissionController(max_concurrent=1, max_queue=1)
+    t, release = _hold_slot(ctl, CLASS_INTERACTIVE, hold_s=5)
+    # one waiter fills the queue
+    t2_started = threading.Event()
+
+    def waiter():
+        t2_started.set()
+        with ctl.admit(CLASS_INTERACTIVE):
+            pass
+
+    t2 = threading.Thread(target=waiter)
+    t2.start()
+    t2_started.wait(5)
+    for _ in range(100):
+        if ctl.snapshot()["queuedTotal"] == 1:
+            break
+        time.sleep(0.01)
+    # queue full -> shed, with a sane Retry-After hint
+    with pytest.raises(QueryShedError) as ei:
+        ctl.acquire(CLASS_INTERACTIVE)
+    assert 1.0 <= ei.value.retry_after <= 30.0
+    release.set()
+    t.join(5)
+    t2.join(5)
+    snap = ctl.snapshot()
+    assert snap["shed"] == 1
+    assert snap["active"] == 0 and snap["queuedTotal"] == 0
+
+
+def test_admission_weighted_priority():
+    """With both classes queued, the weighted round-robin grants the
+    interactive waiter (weight 8) before the batch one (weight 1)."""
+    ctl = AdmissionController(max_concurrent=1, max_queue=8)
+    t, release = _hold_slot(ctl, CLASS_INTERACTIVE, hold_s=5)
+    order = []
+    lock = threading.Lock()
+
+    def waiter(cls):
+        with ctl.admit(cls):
+            with lock:
+                order.append(cls)
+
+    # batch arrives FIRST; interactive must still win the freed slot
+    tb = threading.Thread(target=waiter, args=(CLASS_BATCH,))
+    tb.start()
+    for _ in range(100):
+        if ctl.snapshot()["queued"][CLASS_BATCH] == 1:
+            break
+        time.sleep(0.01)
+    ti = threading.Thread(target=waiter, args=(CLASS_INTERACTIVE,))
+    ti.start()
+    for _ in range(100):
+        if ctl.snapshot()["queued"][CLASS_INTERACTIVE] == 1:
+            break
+        time.sleep(0.01)
+    release.set()
+    t.join(5)
+    tb.join(5)
+    ti.join(5)
+    assert order[0] == CLASS_INTERACTIVE
+
+
+def test_admission_internal_reserve():
+    """Remote fan-out legs (internal class) get reserved headroom above
+    the public limit — the distributed-deadlock guard."""
+    ctl = AdmissionController(max_concurrent=1, max_queue=4,
+                              internal_reserve=1)
+    t, release = _hold_slot(ctl, CLASS_INTERACTIVE, hold_s=5)
+    # public classes are at the limit...
+    snap = ctl.snapshot()
+    assert snap["active"] == 1
+    # ...but an internal query still admits immediately
+    got = threading.Event()
+
+    def internal():
+        with ctl.admit(CLASS_INTERNAL):
+            got.set()
+
+    ti = threading.Thread(target=internal)
+    ti.start()
+    assert got.wait(2), "internal-sync query blocked behind public limit"
+    ti.join(5)
+    release.set()
+    t.join(5)
+
+
+def test_admission_deadline_miss_while_queued():
+    ctl = AdmissionController(max_concurrent=1, max_queue=4)
+    t, release = _hold_slot(ctl, CLASS_INTERACTIVE, hold_s=5)
+    with pytest.raises(DeadlineExceededError):
+        ctl.acquire(CLASS_INTERACTIVE, deadline=Deadline(timeout=0.1))
+    release.set()
+    t.join(5)
+    snap = ctl.snapshot()
+    assert snap["deadlineMiss"] == 1
+    assert snap["queuedTotal"] == 0  # the abandoned waiter left no residue
+
+
+def test_admission_ungated_is_noop():
+    """max_concurrent=0 (the embedded/test default) never blocks, never
+    sheds."""
+    ctl = AdmissionController(max_concurrent=0, max_queue=0)
+    for _ in range(20):
+        with ctl.admit(CLASS_BATCH):
+            pass
+    assert ctl.snapshot()["shed"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Executor integration: expired deadline never launches work
+# ---------------------------------------------------------------------------
+
+
+def test_expired_deadline_stops_executor_before_any_call():
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.exec import Executor
+
+    h = Holder()
+    idx = h.create_index("i")
+    idx.create_field("f").set_bit(1, 5)
+    ex = Executor(h)
+    calls = []
+    orig = ex._execute_call
+    ex._execute_call = lambda *a, **k: calls.append(1) or orig(*a, **k)
+    tok = set_current_deadline(Deadline(timeout=-1))
+    try:
+        with pytest.raises(DeadlineExceededError):
+            ex.execute("i", "Count(Row(f=1))", cache=False)
+    finally:
+        reset_current_deadline(tok)
+    assert calls == []  # no device work after cancellation
+
+
+def test_cancelled_deadline_stops_mid_query():
+    """cancel() between plan steps aborts the remaining calls."""
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.exec import Executor
+
+    h = Holder()
+    idx = h.create_index("i")
+    idx.create_field("f").set_bit(1, 5)
+    ex = Executor(h)
+    dl = Deadline()  # unlimited; cancel-only token
+    seen = []
+    orig = ex._execute_call
+
+    def tracking(idx_, c, shards, opt):
+        seen.append(c.name)
+        dl.cancel()  # cancel after the FIRST call completes
+        return orig(idx_, c, shards, opt)
+
+    ex._execute_call = tracking
+    tok = set_current_deadline(dl)
+    try:
+        with pytest.raises(DeadlineExceededError):
+            ex.execute("i", "Count(Row(f=1))\nCount(Row(f=1))", cache=False)
+    finally:
+        reset_current_deadline(tok)
+    assert seen == ["Count"]  # second call never dispatched
+
+
+# ---------------------------------------------------------------------------
+# Slow-query log
+# ---------------------------------------------------------------------------
+
+
+def test_slow_query_log():
+    log = SlowQueryLog(threshold_ms=10.0, capacity=2)
+    log.observe("i", "Count(Row(f=1))", 5.0)  # under threshold
+    assert log.entries() == []
+    log.observe("i", "Count(Row(f=1))", 50.0, qos_class="interactive")
+    log.observe("i", "x" * 1000, 60.0, status="deadline")
+    log.observe("i", "TopN(f)", 70.0)
+    entries = log.entries()
+    assert len(entries) == 2  # ring capacity
+    assert entries[-1]["query"] == "TopN(f)"
+    assert entries[0]["durationMs"] == 60.0
+    assert len(entries[0]["query"]) <= 512
+    assert log.total == 3
+
+
+# ---------------------------------------------------------------------------
+# HTTP edge: shedding, Retry-After, 504, slow-query route
+# ---------------------------------------------------------------------------
+
+
+def _req(base, method, path, body=None, headers=None):
+    data = body.encode() if isinstance(body, str) else body
+    r = urllib.request.Request(base + path, data=data, method=method)
+    for k, v in (headers or {}).items():
+        r.add_header(k, v)
+    try:
+        with urllib.request.urlopen(r, timeout=30) as resp:
+            return resp.status, json.loads(resp.read() or b"{}"), resp.headers
+    except urllib.error.HTTPError as e:
+        payload = e.read()
+        try:
+            parsed = json.loads(payload)
+        except json.JSONDecodeError:
+            parsed = {"raw": payload.decode()}
+        return e.code, parsed, e.headers
+
+
+@pytest.fixture
+def qos_node():
+    n = ServerNode(bind="127.0.0.1:0", use_planner=False,
+                   qos_max_concurrent=1, qos_max_queue=2,
+                   qos_slow_query_ms=200.0)
+    n.open()
+    base = f"http://127.0.0.1:{n.port}"
+    _req(base, "POST", "/index/i")
+    _req(base, "POST", "/index/i/field/f")
+    _req(base, "POST", "/index/i/query", 'Set(5, f=1)')
+    yield n, base
+    n.close()
+
+
+def test_http_overload_sheds_503_with_retry_after(qos_node):
+    """Acceptance: beyond the admission queue bound, excess concurrent
+    requests get 503 + Retry-After; admitted interactive requests finish
+    with bounded latency."""
+    n, base = qos_node
+    # make each admitted query take ~0.5s so the flood truly overlaps
+    orig_query = n.api.query
+
+    def slow_query(*a, **k):
+        time.sleep(0.5)
+        return orig_query(*a, **k)
+
+    n.api.query = slow_query
+    try:
+        n_requests = 8
+
+        def one(_):
+            t0 = time.perf_counter()
+            status, payload, headers = _req(
+                base, "POST", "/index/i/query?noCache=true",
+                "Count(Row(f=1))")
+            return status, headers, time.perf_counter() - t0
+
+        with ThreadPoolExecutor(max_workers=n_requests) as pool:
+            results = list(pool.map(one, range(n_requests)))
+    finally:
+        n.api.query = orig_query
+
+    admitted = [r for r in results if r[0] == 200]
+    shed = [r for r in results if r[0] == 503]
+    assert len(shed) == n_requests - 3, results  # 1 active + 2 queued
+    for status, headers, _ in shed:
+        assert int(headers["Retry-After"]) >= 1
+    # admitted interactive latency stays bounded: worst case is 3
+    # sequential 0.5s slots, nowhere near the unbounded-queue regime
+    lat = sorted(dt for _, _, dt in admitted)
+    assert lat[-1] < 5.0, lat  # p99/max bounded
+    snap = n.qos.snapshot()
+    assert snap["shed"] == len(shed)
+    # sheds surface in stats counters too
+    assert n.stats.counter_value("qos.shed",
+                                 "class:interactive") == len(shed)
+
+
+def test_http_expired_deadline_504_runs_nothing(qos_node):
+    n, base = qos_node
+    calls = []
+    orig = n.executor._execute_call
+    n.executor._execute_call = lambda *a, **k: calls.append(1) or orig(*a, **k)
+    try:
+        status, payload, _ = _req(
+            base, "POST", "/index/i/query", "Count(Row(f=1))",
+            headers={qdl.DEADLINE_HEADER: f"{time.time() - 1:.6f}"})
+    finally:
+        n.executor._execute_call = orig
+    assert status == 504, payload
+    assert calls == []  # expired queries never launch device work
+
+
+def test_http_default_deadline_applies(qos_node):
+    """A node-configured default deadline kicks in when the client sent
+    none."""
+    n, base = qos_node
+    n.qos.default_deadline = 30.0
+    seen = {}
+    orig_query = n.api.query
+
+    def spy(*a, **k):
+        seen["deadline"] = current_deadline()
+        return orig_query(*a, **k)
+
+    n.api.query = spy
+    try:
+        status, _, _ = _req(base, "POST", "/index/i/query?noCache=true",
+                            "Count(Row(f=1))")
+    finally:
+        n.api.query = orig_query
+        n.qos.default_deadline = 0.0
+    assert status == 200
+    assert seen["deadline"] is not None
+    assert 0 < seen["deadline"].remaining() <= 30.0
+
+
+def test_http_slow_query_log_route(qos_node):
+    n, base = qos_node
+    n.qos.slow_log.threshold_ms = 0.0  # record everything
+    try:
+        status, _, _ = _req(base, "POST", "/index/i/query?noCache=true",
+                            "Count(Row(f=1))")
+        assert status == 200
+        status, payload, _ = _req(base, "GET", "/debug/slow-queries")
+    finally:
+        n.qos.slow_log.threshold_ms = 200.0
+    assert status == 200
+    queries = [e for e in payload["queries"]
+               if e["query"] == "Count(Row(f=1))"]
+    assert queries and queries[-1]["status"] == "ok"
+    assert queries[-1]["class"] == "interactive"
+    assert payload["admission"]["maxConcurrent"] == 1
+
+
+def test_http_qos_class_param(qos_node):
+    """qosClass=batch routes admission metrics to the batch class."""
+    n, base = qos_node
+    status, _, _ = _req(base, "POST",
+                        "/index/i/query?noCache=true&qosClass=batch",
+                        "Count(Row(f=1))")
+    assert status == 200
+    assert n.stats.counter_value("qos.admitted", "class:batch") >= 1
+
+
+# ---------------------------------------------------------------------------
+# Kernel warmup
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def jax_cpu():
+    jax = pytest.importorskip("jax")
+    return jax
+
+
+def test_warmup_precompiles_real_traffic_programs(jax_cpu):
+    """Warming a scratch schema precompiles the EXACT programs real
+    traffic runs: the planner's program cache is structural (leaf slots,
+    not names) and XLA caches per shard-count shape. After warmup, a
+    real Count(Intersect) triggers zero new compiles."""
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.exec import Executor
+    from pilosa_tpu.parallel import MeshPlanner
+
+    h = Holder()
+    planner = MeshPlanner(h)
+    w = WarmupService(planner, kinds=("count",), shard_counts=(2,))
+    out = w.run()
+    assert out["errors"] == 0, out
+    assert out["programs"] > 0
+    assert w.done.is_set()
+    # scratch index left nothing behind in the planner's data caches
+    assert planner.cache_stats()["entries"] == 0
+    warmed = len(planner._fn_cache)
+
+    idx = h.create_index("real")
+    idx.create_field("f").set_bit(1, 5)
+    idx.create_field("g").set_bit(1, 5)
+    ex = Executor(h, planner=planner)
+    (got,) = ex.execute("real", "Count(Intersect(Row(f=1), Row(g=1)))",
+                        shards=[0, 1])
+    assert got == 1
+    # the load-bearing assertion: the real query found its program warm
+    assert len(planner._fn_cache) == warmed
+
+
+def test_warmup_survives_broken_planner():
+    """A warmup failure must never take down node start."""
+    class ExplodingPlanner:
+        def supports(self, c):
+            raise RuntimeError("boom")
+
+    w = WarmupService(ExplodingPlanner(), kinds=("count",),
+                      shard_counts=(1,))
+    out = w.run()  # no raise
+    assert w.done.is_set()
+    assert out["errors"] >= 1 or out["queries"] == 0
+
+
+def test_planner_drop_index(jax_cpu):
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.exec import Executor
+    from pilosa_tpu.parallel import MeshPlanner
+
+    h = Holder()
+    for name in ("a", "b"):
+        idx = h.create_index(name)
+        idx.create_field("f").set_bit(1, 5)
+    planner = MeshPlanner(h)
+    ex = Executor(h, planner=planner)
+    ex.execute("a", "Count(Row(f=1))", shards=[0])
+    ex.execute("b", "Count(Row(f=1))", shards=[0])
+    before = planner.cache_stats()
+    assert before["entries"] == 2
+    planner.drop_index("a")
+    after = planner.cache_stats()
+    assert after["entries"] == 1
+    assert 0 < after["bytes"] < before["bytes"]
+    # surviving index still queries fine
+    (got,) = ex.execute("b", "Count(Row(f=1))", shards=[0], cache=False)
+    assert got == 1
+
+
+# ---------------------------------------------------------------------------
+# httpclient: bounded backoff with jitter on shed (503) retries
+# ---------------------------------------------------------------------------
+
+
+def test_backoff_delay_bounds():
+    from pilosa_tpu.server.httpclient import (
+        RETRY_BASE_DELAY,
+        RETRY_MAX_DELAY,
+        HTTPInternalClient,
+    )
+
+    for attempt in range(6):
+        cap = min(RETRY_MAX_DELAY, RETRY_BASE_DELAY * (2 ** attempt))
+        for _ in range(20):
+            d = HTTPInternalClient._backoff_delay(attempt, None)
+            assert 0 <= d <= cap
+            # the peer's Retry-After hint is a floor, jitter on top
+            d = HTTPInternalClient._backoff_delay(attempt, 2.0)
+            assert 2.0 <= d <= 2.0 + cap
+    # never sleep past the active deadline: hand the budget back instead
+    tok = set_current_deadline(Deadline(timeout=0.5))
+    try:
+        assert HTTPInternalClient._backoff_delay(0, 30.0) is None
+    finally:
+        reset_current_deadline(tok)
+
+
+class _SheddingHandler(__import__("http.server", fromlist=["x"]).BaseHTTPRequestHandler):
+    """Returns 503 + Retry-After for the first ``fail_n`` hits, then 200."""
+
+    hits: list = []
+    fail_n = 2
+
+    def do_GET(self):
+        n = len(self.hits)
+        self.hits.append(time.monotonic())
+        if n < self.fail_n:
+            body = b'{"error": "shed"}'
+            self.send_response(503)
+            self.send_header("Retry-After", "0")
+        else:
+            body = b'{"ok": true}'
+            self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture
+def shedding_server():
+    from http.server import ThreadingHTTPServer
+
+    from pilosa_tpu.cluster.node import URI, Node
+
+    _SheddingHandler.hits = []
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _SheddingHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    node = Node(id="shedder",
+                uri=URI(host="127.0.0.1", port=srv.server_address[1]))
+    yield node
+    srv.shutdown()
+    t.join(5)
+
+
+def test_httpclient_retries_503_with_backoff(shedding_server):
+    """Idempotent requests ride out transient sheds: retry with backoff,
+    honoring the peer's Retry-After, and succeed once admitted."""
+    import json as _json
+
+    from pilosa_tpu.server.httpclient import HTTPInternalClient
+
+    client = HTTPInternalClient(timeout=5.0)
+    data, _ = client._request_raw(shedding_server, "GET", "/status",
+                                  retry_503=True)
+    assert _json.loads(data) == {"ok": True}
+    assert len(_SheddingHandler.hits) == 3  # 2 sheds + 1 success
+
+
+def test_httpclient_non_idempotent_surfaces_retry_after(shedding_server):
+    """Non-idempotent requests must NOT auto-retry; the shed surfaces as
+    NodeHTTPError carrying the Retry-After hint for the caller."""
+    from pilosa_tpu.server.httpclient import HTTPInternalClient, NodeHTTPError
+
+    client = HTTPInternalClient(timeout=5.0)
+    with pytest.raises(NodeHTTPError) as ei:
+        client._request_raw(shedding_server, "GET", "/status",
+                            retry_503=False)
+    assert ei.value.code == 503
+    assert ei.value.retry_after == 0.0
+    assert len(_SheddingHandler.hits) == 1  # exactly one attempt
+
+
+def test_httpclient_backoff_respects_deadline(shedding_server):
+    """When the deadline can't afford the peer's Retry-After, fail fast
+    instead of sleeping the budget away."""
+    from pilosa_tpu.server.httpclient import HTTPInternalClient, NodeHTTPError
+
+    _SheddingHandler.fail_n = 99
+    client = HTTPInternalClient(timeout=5.0)
+    tok = set_current_deadline(Deadline(timeout=1.0))
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(NodeHTTPError):
+            client._request_raw(shedding_server, "GET", "/status",
+                                retry_503=True)
+        waited = time.monotonic() - t0
+    finally:
+        reset_current_deadline(tok)
+        _SheddingHandler.fail_n = 2
+    assert waited < 1.5  # gave the budget back, didn't sleep it away
