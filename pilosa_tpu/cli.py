@@ -31,6 +31,12 @@ _DEFAULTS = {
     "replica_n": 1,
     "anti_entropy_interval": 10.0,
     "check_nodes_interval": 5.0,
+    # Background integrity scrub: re-verify snapshot CRCs + repair
+    # quarantined fragments from replicas (0 disables).
+    "scrub_interval": 60.0,
+    # WAL records per fragment before a background snapshot triggers
+    # (reference MaxOpN, fragment.go:84).
+    "max_op_n": 10_000,
     "join": "",
     "tls_cert": "",
     "tls_key": "",
@@ -116,6 +122,10 @@ def cmd_server(args) -> int:
         cfg["qos_default_deadline"] = args.qos_default_deadline
     if args.qos_warmup is not None:
         cfg["qos_warmup"] = args.qos_warmup
+    if args.scrub_interval is not None:
+        cfg["scrub_interval"] = args.scrub_interval
+    if args.max_op_n is not None:
+        cfg["max_op_n"] = args.max_op_n
 
     from pilosa_tpu.server.node import ServerNode
     node = ServerNode(
@@ -125,6 +135,8 @@ def cmd_server(args) -> int:
         use_planner=bool(cfg["planner"]),
         anti_entropy_interval=float(cfg["anti_entropy_interval"]),
         check_nodes_interval=float(cfg["check_nodes_interval"]),
+        scrub_interval=float(cfg["scrub_interval"]),
+        max_op_n=int(cfg["max_op_n"]),
         join=str(cfg["join"]) or None,
         data_dir=cfg["data_dir"] or None,
         tls_cert=str(cfg["tls_cert"]) or None,
@@ -313,26 +325,78 @@ def cmd_export(args) -> int:
 
 
 def cmd_check(args) -> int:
-    """Offline consistency check of a data dir (ctl/check.go:30)."""
-    from pilosa_tpu.storage.wal import WalReader
-    import numpy as np
+    """Offline integrity check of a data dir (ctl/check.go:30): verify
+    snapshot footer CRCs, WAL op checksums (torn tail vs mid-file
+    corruption), and jsonl line frames; report quarantined evidence
+    files. ``--repair`` sweeps stale ``*.tmp`` crash leftovers. Exits
+    non-zero when any file is BAD."""
+    from pilosa_tpu.storage.integrity import LineCorruptError, parse_line
+    from pilosa_tpu.storage.wal import scan_wal
     bad = 0
     for root, _, files in os.walk(args.data_dir):
-        for fn in files:
+        for fn in sorted(files):
             p = os.path.join(root, fn)
             if fn.endswith(".wal"):
-                n = sum(1 for _ in WalReader(p))
-                print(f"ok wal   {p} ({n} ops)")
-            elif fn.endswith(".snap"):
-                try:
-                    with np.load(p) as z:
-                        n = len(z["row_ids"])
-                    print(f"ok snap  {p} ({n} rows)")
-                except Exception as e:
-                    print(f"BAD snap {p}: {e}")
+                info = scan_wal(p)
+                if info["corrupt"]:
+                    print(f"BAD wal  {p}: corrupt record mid-file "
+                          f"({info['ops']} ops salvageable, "
+                          f"{info['total_bytes'] - info['valid_bytes']} "
+                          f"bytes damaged)")
                     bad += 1
+                elif info["torn"]:
+                    print(f"ok wal   {p} ({info['ops']} ops; torn tail of "
+                          f"{info['total_bytes'] - info['valid_bytes']} "
+                          f"bytes — normal crash shape, replay truncates)")
+                else:
+                    print(f"ok wal   {p} ({info['ops']} ops)")
+            elif fn.endswith(".snap"):
+                from pilosa_tpu.storage.diskstore import read_snapshot
+                arrays, meta, status = read_snapshot(p)
+                if status == "bad":
+                    print(f"BAD snap {p}: {meta['error']}")
+                    bad += 1
+                elif status == "legacy":
+                    print(f"ok snap  {p} ({len(arrays['row_ids'])} rows; "
+                          f"legacy unframed — re-snapshot to checksum)")
+                else:
+                    print(f"ok snap  {p} ({meta['rows']} rows, "
+                          f"{meta['bits']} bits, crc verified)")
+            elif fn.endswith(".jsonl"):
+                n_ok = n_legacy = n_bad = 0
+                with open(p) as f:
+                    for line in f:
+                        line = line.rstrip("\n")
+                        if not line.strip():
+                            continue
+                        try:
+                            _, verified = parse_line(line)
+                            if verified:
+                                n_ok += 1
+                            else:
+                                n_legacy += 1
+                        except LineCorruptError:
+                            n_bad += 1
+                if n_bad:
+                    print(f"BAD jsonl {p}: {n_bad} corrupt line(s) "
+                          f"({n_ok} verified, {n_legacy} unframed)")
+                    bad += 1
+                else:
+                    print(f"ok jsonl {p} ({n_ok} verified, "
+                          f"{n_legacy} unframed)")
+            elif fn.endswith(".quarantine"):
+                print(f"quarantined {p} (preserved corruption evidence)")
             elif fn.endswith(".tmp"):
-                print(f"stale tmp {p} (crash leftover; safe to delete)")
+                if getattr(args, "repair", False):
+                    try:
+                        os.remove(p)
+                        print(f"repaired {p} (stale tmp removed)")
+                    except OSError as e:
+                        print(f"BAD tmp  {p}: cannot remove: {e}")
+                        bad += 1
+                else:
+                    print(f"stale tmp {p} (crash leftover; "
+                          f"--repair removes)")
     return 1 if bad else 0
 
 
@@ -365,6 +429,10 @@ def cmd_generate_config(args) -> int:
           'replica-n = 1\n'
           'anti-entropy-interval = 10.0\n'
           'check-nodes-interval = 5.0\n'
+          '# background integrity scrub cadence, seconds (0 disables)\n'
+          'scrub-interval = 60.0\n'
+          '# WAL records per fragment before a snapshot triggers\n'
+          'max-op-n = 10000\n'
           'tls-cert = ""\n'
           'tls-key = ""\n'
           'tls-ca-cert = ""\n'
@@ -410,6 +478,12 @@ def main(argv: list[str] | None = None) -> int:
                         '("" disables)')
     s.add_argument("--import-pool-mb", type=int, default=None,
                    help="buffer-pool pages pre-faulted at boot (0 disables)")
+    s.add_argument("--scrub-interval", type=float, default=None,
+                   help="background integrity scrub cadence, seconds "
+                        "(0 disables)")
+    s.add_argument("--max-op-n", type=int, default=None,
+                   help="WAL records per fragment before a snapshot "
+                        "triggers")
     s.add_argument("--trace-endpoint", default="",
                    help="OTLP/HTTP collector URL for trace export")
     s.add_argument("--config", default=None)
@@ -441,6 +515,8 @@ def main(argv: list[str] | None = None) -> int:
 
     s = sub.add_parser("check", help="offline data-dir consistency check")
     s.add_argument("data_dir")
+    s.add_argument("--repair", action="store_true",
+                   help="sweep stale .tmp crash leftovers")
     s.set_defaults(fn=cmd_check)
 
     s = sub.add_parser("inspect", help="data-dir fragment stats")

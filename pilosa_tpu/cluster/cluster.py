@@ -22,7 +22,10 @@ from pilosa_tpu.cluster.event import (
 )
 from pilosa_tpu.cluster.node import Node
 from pilosa_tpu.cluster.placement import jump_hash, partition
+from pilosa_tpu.cluster.scrub import DirtyShards
 from pilosa_tpu.errors import PilosaError
+from pilosa_tpu.obs.stats import NopStats
+from pilosa_tpu.storage.quarantine import ShardCorruptError
 
 STATE_STARTING = "STARTING"
 #: terminal state of a node removed from the ring by a committed resize:
@@ -65,6 +68,14 @@ class Cluster:
         #: coordinator committing "version 1" again would be silently
         #: rejected as stale by every peer, forking the ring.
         self.save_hook: Callable | None = None
+        self.stats = NopStats()
+        #: shards the write fan-out skipped a DOWN replica for — the
+        #: scrubber checks these first (cluster/scrub.py).
+        self.dirty_shards = DirtyShards()
+        #: quarantine hook: fn(index) -> set of shards this node must
+        #: NOT serve locally (storage corruption); placement then skips
+        #: the local owner so reads land on replicas.
+        self.blocked_shards_fn: Callable[[str], set] | None = None
         self._lock = threading.RLock()
         #: NodeEvent consumers (cluster/event.py).
         self._listeners: list[Callable] = []
@@ -243,15 +254,29 @@ class Cluster:
     def shards_by_node(self, nodes: list[Node], index: str,
                        shards: list[int]) -> dict[str, list[int]]:
         """Reference shardsByNode (executor.go:2435): each shard goes to
-        its first live owner among ``nodes``."""
+        its first live owner among ``nodes``; the LOCAL owner is skipped
+        for shards whose data is quarantined here (blocked_shards_fn),
+        so reads route to a replica instead of serving corrupt/no data."""
         out: dict[str, list[int]] = {}
         live = {n.id for n in nodes}
+        blocked: set = set()
+        if self.blocked_shards_fn is not None:
+            blocked = self.blocked_shards_fn(index) or set()
         for shard in shards:
+            skipped_blocked = False
             for owner in self.shard_nodes(index, shard):
-                if owner.id in live:
-                    out.setdefault(owner.id, []).append(shard)
-                    break
+                if owner.id not in live:
+                    continue
+                if owner.id == self.local_id and shard in blocked:
+                    skipped_blocked = True
+                    continue
+                out.setdefault(owner.id, []).append(shard)
+                break
             else:
+                if skipped_blocked:
+                    # Only the corrupt local copy remains: distinct
+                    # error, the data exists but cannot be trusted.
+                    raise ShardCorruptError()
                 raise ShardUnavailableError()
         return out
 
@@ -325,7 +350,9 @@ class Cluster:
                            if node_id == self.local_id
                            else run_remote(node_id, node_shards))
                     result = acc if result is None else reduce_fn(result, acc)
-                except ConnectionError:
+                except (ConnectionError, ShardCorruptError):
+                    # A corrupt-data refusal fails over exactly like a
+                    # dead node: drop it, remap its shards to replicas.
                     nodes = [n for n in nodes if n.id != node_id]
                     failed.extend(node_shards)
             else:
@@ -347,7 +374,7 @@ class Cluster:
                         acc = run_local(local_shards)
                         result = acc if result is None else \
                             reduce_fn(result, acc)
-                    except ConnectionError:
+                    except (ConnectionError, ShardCorruptError):
                         # Drop the local node too — otherwise its failed
                         # shards re-map straight back to it and the
                         # retry loop never terminates.
@@ -356,7 +383,7 @@ class Cluster:
                 for node_id, node_shards, fut in tasks:
                     try:
                         acc = fut.result()
-                    except ConnectionError:
+                    except (ConnectionError, ShardCorruptError):
                         # Failover: drop the node, re-map its shards
                         # onto replicas (executor.go:2492-2503).
                         nodes = [n for n in nodes if n.id != node_id]
@@ -381,7 +408,10 @@ class Cluster:
             elif not opt.remote:
                 if node.state == "DOWN":
                     # Skip lost replicas; anti-entropy repairs them on
-                    # rejoin (holder.go:911 SyncHolder).
+                    # rejoin (holder.go:911 SyncHolder) — and the
+                    # scrubber gets first crack via the dirty mark.
+                    self.stats.count("cluster.replica_write_skipped")
+                    self.dirty_shards.mark(idx_name, shard)
                     continue
                 res = self.client.query_node(node, idx_name, str(c), None,
                                              remote=True)

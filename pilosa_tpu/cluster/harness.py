@@ -57,7 +57,8 @@ class ClusterNode:
     (the handler surface LocalClient dispatches to — mirrors the
     /internal/* HTTP routes, http/handler.go:274)."""
 
-    def __init__(self, node_id: str, cluster: Cluster, planner=None):
+    def __init__(self, node_id: str, cluster: Cluster, planner=None,
+                 data_dir: str | None = None, store_factory=None):
         self.id = node_id
         from pilosa_tpu.cluster.dirty import DirtyBroadcaster
         self.dirty = DirtyBroadcaster(cluster)
@@ -73,6 +74,25 @@ class ClusterNode:
         self.translator = ClusterKeyTranslator(self.holder, cluster,
                                                cluster.client)
         self.executor.translator = self.translator
+        #: optional durability, exactly like a server process: open the
+        #: store (reload + integrity verification), route quarantined
+        #: shards to replicas, and give the coordinator the blocked-
+        #: shard view. store_factory lets tests swap FaultyDiskStore in.
+        self.store = None
+        self.scrubber = None
+        if data_dir is not None:
+            from pilosa_tpu.cluster.scrub import (
+                Scrubber,
+                route_quarantined_to_replicas,
+            )
+            from pilosa_tpu.storage.diskstore import DiskStore
+            factory = store_factory or DiskStore
+            self.store = factory(data_dir, self.holder)
+            self.store.open()
+            cluster.blocked_shards_fn = self.store.quarantine.blocked_shards
+            route_quarantined_to_replicas(self.holder, cluster, self.store)
+            self.scrubber = Scrubber(self.holder, cluster, cluster.client,
+                                     self.store)
 
     def _broadcast_shard(self, index: str, field: str, view: str, shard: int):
         msg = {"type": "create-shard", "index": index, "field": field,
@@ -217,7 +237,9 @@ class ClusterNode:
 class LocalCluster:
     """N in-process nodes sharing a LocalClient transport."""
 
-    def __init__(self, n: int, replica_n: int = 1, planner_factory=None):
+    def __init__(self, n: int, replica_n: int = 1, planner_factory=None,
+                 data_dirs: list[str | None] | None = None,
+                 store_factory=None):
         self.client = LocalClient()
         nodes = [Node(id=f"node{i}", uri=URI(host="localhost", port=10101 + i),
                       is_coordinator=(i == 0))
@@ -231,7 +253,9 @@ class LocalCluster:
                               replica_n=replica_n, client=self.client)
             cluster.set_state(STATE_NORMAL)
             planner = planner_factory(i) if planner_factory else None
-            cn = ClusterNode(f"node{i}", cluster, planner=planner)
+            cn = ClusterNode(f"node{i}", cluster, planner=planner,
+                             data_dir=(data_dirs[i] if data_dirs else None),
+                             store_factory=store_factory)
             self.client.register(cn.id, cn)
             self.nodes.append(cn)
 

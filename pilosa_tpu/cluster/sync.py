@@ -20,13 +20,19 @@ from pilosa_tpu.core.holder import Holder
 
 
 def merge_block(local_pairs: tuple[np.ndarray, np.ndarray],
-                remote_pairs: list[tuple[np.ndarray, np.ndarray]]):
+                remote_pairs: list[tuple[np.ndarray, np.ndarray]],
+                include_local: bool = True):
     """Consensus-merge one block. Pairs are (row_ids, ABSOLUTE column_ids).
 
     Returns (local_sets, local_clears, remote_diffs) where remote_diffs is
     a list of (sets, clears) per remote node; each sets/clears is a
     (rows, cols) pair. majorityN = (n+1)//2 over all participants — an
     even split keeps the bit (fragment.go:1917).
+
+    ``include_local=False`` excludes the local copy from the vote (its
+    diffs are still computed): the scrubber uses this to repair a
+    fragment whose local data is quarantined-corrupt — evidence of
+    corruption means the local bits must not outvote healthy replicas.
     """
     all_pairs = [local_pairs] + list(remote_pairs)
     n = len(all_pairs)
@@ -53,7 +59,11 @@ def merge_block(local_pairs: tuple[np.ndarray, np.ndarray],
         if len(e):
             idx = np.searchsorted(universe, e)
             presence[i, idx] = 1
-    keep = presence.sum(axis=0) >= majority_n
+    if include_local:
+        keep = presence.sum(axis=0) >= majority_n
+    else:
+        majority_n = (len(remote_pairs) + 1) // 2
+        keep = presence[1:].sum(axis=0) >= max(majority_n, 1)
 
     def decode(mask):
         sel = universe[mask]
@@ -162,10 +172,19 @@ class HolderSyncer:
         block_ids = set(local_blocks)
         for pb in peer_blocks:
             block_ids |= set(pb)
+        idx = self.holder.index(index_name)
+        epoch = idx.epoch if idx is not None else None
         changed = False
         for b in sorted(block_ids):
             if all(pb.get(b) == local_blocks.get(b) for pb in peer_blocks):
                 continue
+            # Read-merge-write guard: a write that lands between reading
+            # this block and applying the merged plan would be UNDONE by
+            # the plan (a freshly cleared bit still in the stale read
+            # gets resurrected on every copy). Snapshot the index's
+            # mutation epoch with the read; a bump during the merge
+            # invalidates the plan for this block — next pass replans.
+            e0 = epoch.value if epoch is not None else None
             local_pairs = frag.block_data(b)
             remote_pairs, reachable = [], []
             empty = (np.empty(0, np.uint64), np.empty(0, np.uint64))
@@ -182,6 +201,8 @@ class HolderSyncer:
             if not reachable:
                 continue
             (lsets, lclears), remote_diffs = merge_block(local_pairs, remote_pairs)
+            if e0 is not None and epoch.value != e0:
+                continue  # a write raced this merge: stale plan, replan
             if len(lsets[0]):
                 frag.bulk_import(lsets[0].tolist(), lsets[1].tolist())
                 changed = True

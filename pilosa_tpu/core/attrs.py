@@ -15,8 +15,17 @@ import os
 import threading
 from typing import Any
 
+from pilosa_tpu.obs.logger import StandardLogger
+from pilosa_tpu.storage.integrity import (
+    LineCorruptError,
+    frame_line,
+    parse_line,
+)
+
 #: ids per checksum block (reference attrBlockSize attr.go:28).
 ATTR_BLOCK_SIZE = 100
+
+_logger = StandardLogger()
 
 
 class AttrStore:
@@ -29,6 +38,9 @@ class AttrStore:
         #: invalidate epoch-stamped result caches too.
         self.epoch = epoch
         self._attrs: dict[int, dict[str, Any]] = {}
+        #: integrity counters from the last _load (operator-facing).
+        self.corrupt_lines = 0
+        self.unverified_lines = 0
         self._lock = threading.RLock()
         if path and os.path.exists(path):
             self._load()
@@ -92,10 +104,22 @@ class AttrStore:
 
     def _load(self) -> None:
         with open(self.path) as f:
-            for line in f:
-                if line.strip():
-                    id_, attrs = json.loads(line)
-                    self._attrs[int(id_)] = attrs
+            for lineno, line in enumerate(f, 1):
+                line = line.rstrip("\n")
+                if not line.strip():
+                    continue
+                try:
+                    payload, verified = parse_line(line)
+                    id_, attrs = json.loads(payload)
+                except (LineCorruptError, ValueError) as e:
+                    self.corrupt_lines += 1
+                    _logger.printf(
+                        "attrs: skipping corrupt line %d in %s: %s",
+                        lineno, self.path, e)
+                    continue
+                if not verified:
+                    self.unverified_lines += 1
+                self._attrs[int(id_)] = attrs
 
     def save(self) -> None:
         if not self.path:
@@ -107,5 +131,6 @@ class AttrStore:
                 os.makedirs(d, exist_ok=True)
             with open(tmp, "w") as f:
                 for id_ in sorted(self._attrs):
-                    f.write(json.dumps([id_, self._attrs[id_]]) + "\n")
+                    f.write(frame_line(json.dumps([id_, self._attrs[id_]]))
+                            + "\n")
             os.replace(tmp, self.path)

@@ -12,6 +12,14 @@ import os
 import threading
 
 from pilosa_tpu.errors import TranslateStoreReadOnlyError
+from pilosa_tpu.obs.logger import StandardLogger
+from pilosa_tpu.storage.integrity import (
+    LineCorruptError,
+    frame_line,
+    parse_line,
+)
+
+_logger = StandardLogger()
 
 
 class TranslateStore:
@@ -28,6 +36,9 @@ class TranslateStore:
         #: on the coordinator by other writers, so replica pulls resume
         #: from here, not max_id() (which _next races ahead of).
         self._watermark = 0
+        #: integrity counters from the last _load (operator-facing).
+        self.corrupt_lines = 0
+        self.unverified_lines = 0
         self._lock = threading.RLock()
         if path and os.path.exists(path):
             self._load()
@@ -88,11 +99,26 @@ class TranslateStore:
 
     def _load(self) -> None:
         with open(self.path) as f:
-            for line in f:
-                if line.strip():
-                    id_, key = json.loads(line)
-                    self._fwd[key] = int(id_)
-                    self._rev[int(id_)] = key
+            for lineno, line in enumerate(f, 1):
+                line = line.rstrip("\n")
+                if not line.strip():
+                    continue
+                try:
+                    payload, verified = parse_line(line)
+                    id_, key = json.loads(payload)
+                except (LineCorruptError, ValueError) as e:
+                    # Skip the damaged line, keep the rest of the store:
+                    # one flipped bit must not take the whole index's
+                    # key translation down.
+                    self.corrupt_lines += 1
+                    _logger.printf(
+                        "translate: skipping corrupt line %d in %s: %s",
+                        lineno, self.path, e)
+                    continue
+                if not verified:
+                    self.unverified_lines += 1
+                self._fwd[key] = int(id_)
+                self._rev[int(id_)] = key
         if self._rev:
             self._next = max(self._rev) + 1
 
@@ -106,5 +132,6 @@ class TranslateStore:
                 os.makedirs(d, exist_ok=True)
             with open(tmp, "w") as f:
                 for id_ in sorted(self._rev):
-                    f.write(json.dumps([id_, self._rev[id_]]) + "\n")
+                    f.write(frame_line(json.dumps([id_, self._rev[id_]]))
+                            + "\n")
             os.replace(tmp, self.path)

@@ -449,6 +449,16 @@ class Executor:
             return self.cluster.map_reduce(self, idx, shards, c, opt,
                                            map_fn, reduce_fn,
                                            local_batch_fn=local_batch_fn)
+        # Refuse to serve shards whose local data is quarantined
+        # (storage corruption). Standalone this is terminal; as a
+        # remote leg it makes the COORDINATOR fail this node over to a
+        # replica, exactly like a connection failure.
+        q = getattr(self.holder, "quarantine", None)
+        if q is not None and len(q):
+            blocked = q.blocked_shards(idx.name)
+            if blocked and any(s in blocked for s in shards):
+                from pilosa_tpu.storage.quarantine import ShardCorruptError
+                raise ShardCorruptError()
         if local_batch_fn is not None:
             check_deadline()
             return local_batch_fn(list(shards))

@@ -228,9 +228,20 @@ class HTTPInternalClient:
             # roaring blobs instead of JSON int lists (~10-100x smaller
             # for large rows; wire.encode_frames). Reads are idempotent,
             # so a shed (503) leg may back off and retry.
-            data, ctype = self._request_raw(
-                node, "POST", path, query.encode(),
-                accept=wire.FRAMES_CONTENT_TYPE, retry_503=True)
+            try:
+                data, ctype = self._request_raw(
+                    node, "POST", path, query.encode(),
+                    accept=wire.FRAMES_CONTENT_TYPE, retry_503=True)
+            except NodeHTTPError as e:
+                if e.code == 503 and "quarantined" in str(e):
+                    # The peer refused because ITS copy of a shard is
+                    # corrupt: surface the typed error so the
+                    # coordinator fails this leg over to a replica.
+                    from pilosa_tpu.storage.quarantine import (
+                        ShardCorruptError,
+                    )
+                    raise ShardCorruptError() from e
+                raise
             if ctype.startswith(wire.FRAMES_CONTENT_TYPE):
                 return wire.decode_frames(data)
             resp = json.loads(data) if data else {}

@@ -31,6 +31,7 @@ from pilosa_tpu.pql import ParseError
 from pilosa_tpu.qos import DeadlineExceededError, QueryShedError, normalize_class
 from pilosa_tpu.qos import deadline as qos_deadline
 from pilosa_tpu.server.api import API
+from pilosa_tpu.storage.quarantine import ShardCorruptError
 
 _CONFLICTS = (IndexExistsError, FieldExistsError)
 _NOT_FOUND = (IndexNotFoundError, FieldNotFoundError, FragmentNotFoundError)
@@ -164,6 +165,12 @@ def _make_handler(api: API):
                     # doesn't speak the binary frame format" and re-send
                     # as JSON — a state-gated refusal must stay distinct.
                     status, payload = 405, {"error": str(e)}
+                except ShardCorruptError as e:
+                    # 503, NOT 400 (must precede the PilosaError
+                    # catch-all): the data exists but this node's copy is
+                    # quarantined — a server-side condition a replica or
+                    # the scrubber will clear, not a bad request.
+                    status, payload = 503, {"error": str(e)}
                 except (QueryError, ParseError, ValueError, PilosaError) as e:
                     status, payload = 400, {"error": str(e)}
                 except Exception as e:  # pragma: no cover
@@ -343,6 +350,11 @@ def _build_routes(api: API):
                 status = ("shed" if isinstance(e, QueryShedError)
                           else "deadline")
                 raise
+            except ShardCorruptError:
+                # Re-raise past the PilosaError catch: the dispatch
+                # ladder maps this to 503 (quarantined, not a bad query).
+                status = "error"
+                raise
             except (QueryError, ParseError, PilosaError, ValueError) as e:
                 status = "error"
                 return 400, {"error": str(e)}
@@ -415,6 +427,17 @@ def _build_routes(api: API):
                             if slow_log is not None else None),
             "admission": qos_ctl.snapshot(),
         }
+
+    def get_debug_quarantine(pv, params, body):
+        """Corruption quarantine view: which fragments failed integrity
+        verification, their serving state, and the preserved evidence
+        files (`*.quarantine`)."""
+        store = getattr(api, "store", None)
+        q = getattr(store, "quarantine", None) if store is not None else None
+        if q is None:
+            return 200, {"entries": [], "count": 0}
+        entries = q.entries()
+        return 200, {"entries": entries, "count": len(entries)}
 
     def get_debug_threads(pv, params, body):
         """Thread stack dump — the pprof-goroutine analog for diagnosing
@@ -634,6 +657,7 @@ def _build_routes(api: API):
         (r"/metrics", {"GET": get_metrics}),
         (r"/debug/vars", {"GET": get_debug_vars}),
         (r"/debug/slow-queries", {"GET": get_debug_slow_queries}),
+        (r"/debug/quarantine", {"GET": get_debug_quarantine}),
         (r"/debug/threads", {"GET": get_debug_threads}),
         (r"/debug/profile", {"GET": get_debug_profile}),
         (r"/debug/heap", {"GET": get_debug_heap}),

@@ -39,6 +39,10 @@ class ServerNode:
     #: chunks as permanent fragment storage; the pool re-faults the
     #: deficit in the background).
     POOL_TOPUP_INTERVAL = 30.0
+    #: background scrub cadence, seconds: re-verify on-disk snapshot
+    #: CRCs and repair quarantined fragments from replica consensus.
+    #: Longer than anti-entropy — a scrub re-reads every snapshot file.
+    DEFAULT_SCRUB_INTERVAL = 60.0
 
     def __init__(self, bind: str = "127.0.0.1:10101",
                  peers: list[str] | None = None,
@@ -46,6 +50,8 @@ class ServerNode:
                  use_planner: bool = True,
                  anti_entropy_interval: float | None = None,
                  check_nodes_interval: float | None = None,
+                 scrub_interval: float | None = None,
+                 max_op_n: int | None = None,
                  join: str | None = None,
                  data_dir: str | None = None,
                  tls_cert: str | None = None,
@@ -174,8 +180,10 @@ class ServerNode:
         self._import_pool_mb = int(import_pool_mb)
         self._pool_stop = threading.Event()
         self.syncer = None
+        self.scrubber = None
         self._sync_timer: threading.Timer | None = None
         self._check_timer: threading.Timer | None = None
+        self._scrub_timer: threading.Timer | None = None
         self._closed = False
         #: one resize job at a time (reference cluster.go:1447).
         self._resize_gate = threading.Lock()
@@ -187,7 +195,11 @@ class ServerNode:
         self._check_nodes_interval = (
             self.DEFAULT_CHECK_NODES_INTERVAL
             if check_nodes_interval is None else check_nodes_interval)
+        self._scrub_interval = (
+            self.DEFAULT_SCRUB_INTERVAL
+            if scrub_interval is None else scrub_interval)
         if self.cluster is not None:
+            self.cluster.stats = self.stats
             self.syncer = HolderSyncer(self.holder, self.cluster,
                                        self.cluster.client)
             # Coordinator-primary key allocation (translate.go:93 model):
@@ -200,13 +212,31 @@ class ServerNode:
 
         if data_dir:
             from pilosa_tpu.storage.diskstore import DiskStore
-            self.store = DiskStore(data_dir, self.holder)
+            kw = {} if max_op_n is None else {"max_op_n": max_op_n}
+            self.store = DiskStore(data_dir, self.holder, stats=self.stats,
+                                   **kw)
             self.store.open()
         else:
             self.store = None
         self.api.store = self.store
         if self.store is not None and self.cluster is not None:
             self._wire_topology_persistence(data_dir)
+        if self.store is not None:
+            from pilosa_tpu.cluster.scrub import (
+                Scrubber,
+                route_quarantined_to_replicas,
+            )
+            if self.cluster is not None:
+                # Placement must not hand quarantined shards to this
+                # node; route their reads to replicas instead.
+                self.cluster.blocked_shards_fn = \
+                    self.store.quarantine.blocked_shards
+                route_quarantined_to_replicas(self.holder, self.cluster,
+                                              self.store, stats=self.stats)
+            self.scrubber = Scrubber(
+                self.holder, self.cluster,
+                self.cluster.client if self.cluster is not None else None,
+                self.store, stats=self.stats, admission=self.qos)
 
     def _wire_topology_persistence(self, data_dir: str) -> None:
         """Durable topology (reference .topology file, cluster.go:1657):
@@ -312,6 +342,8 @@ class ServerNode:
             self._schedule_sync()
         if self.cluster is not None and self._check_nodes_interval > 0:
             self._schedule_check_nodes()
+        if self.scrubber is not None and self._scrub_interval > 0:
+            self._schedule_scrub()
         from pilosa_tpu.obs.runtime import RuntimeMonitor
         self.runtime_monitor = RuntimeMonitor(self.stats,
                                               self.executor.planner,
@@ -487,6 +519,23 @@ class ServerNode:
         self._sync_timer.daemon = True
         self._sync_timer.start()
 
+    def _schedule_scrub(self) -> None:
+        def tick():
+            try:
+                res = self.scrubber.scrub_pass()
+                if res.get("mismatch"):
+                    self.stats.count("integrity.scrubMismatchFragments",
+                                     res["mismatch"])
+            except Exception:
+                pass  # next tick retries; the scrub must never kill the node
+            finally:
+                if not self._closed:
+                    self._schedule_scrub()
+        self._scrub_timer = threading.Timer(
+            self._jitter(self._scrub_interval), tick)
+        self._scrub_timer.daemon = True
+        self._scrub_timer.start()
+
     #: membership push/pull piggybacks on every Nth liveness sweep
     #: (full-ring pulls each sweep would double detector traffic).
     DISCOVER_EVERY_N_SWEEPS = 5
@@ -532,6 +581,8 @@ class ServerNode:
             self._sync_timer.cancel()
         if self._check_timer is not None:
             self._check_timer.cancel()
+        if self._scrub_timer is not None:
+            self._scrub_timer.cancel()
         if getattr(self, "runtime_monitor", None) is not None:
             self.runtime_monitor.close()
         if self.executor.planner is not None:

@@ -1,14 +1,39 @@
 """Durability: per-fragment snapshot + WAL, schema/attr/translate
-persistence, holder reload.
+persistence, holder reload, integrity framing + corruption quarantine.
 
 Reference: the op-log + snapshot cycle (roaring.go:4650-4790 op records,
 fragment.go:84 MaxOpN, :2296 enqueueSnapshot, :2337-2393 snapshot temp +
 rename; holder.go:137 Open walks the data dir). Here the WAL is a binary
 record stream per fragment and snapshots are compressed position arrays —
 the host-side truth the device stacks are rebuilt from on boot.
+
+Exports resolve lazily (PEP 562): core.attrs/core.translate import the
+integrity framing from this package, and an eager diskstore import here
+would close the cycle diskstore → core.attrs → storage.
 """
 
-from pilosa_tpu.storage.diskstore import DiskStore
-from pilosa_tpu.storage.wal import WalReader, WalWriter
+_EXPORTS = {
+    "DiskStore": "pilosa_tpu.storage.diskstore",
+    "read_snapshot": "pilosa_tpu.storage.diskstore",
+    "WalReader": "pilosa_tpu.storage.wal",
+    "WalWriter": "pilosa_tpu.storage.wal",
+    "scan_wal": "pilosa_tpu.storage.wal",
+    "SnapshotCorruptError": "pilosa_tpu.storage.integrity",
+    "LineCorruptError": "pilosa_tpu.storage.integrity",
+    "snapshot_footer": "pilosa_tpu.storage.integrity",
+    "split_snapshot": "pilosa_tpu.storage.integrity",
+    "frame_line": "pilosa_tpu.storage.integrity",
+    "parse_line": "pilosa_tpu.storage.integrity",
+    "QuarantineRegistry": "pilosa_tpu.storage.quarantine",
+    "ShardCorruptError": "pilosa_tpu.storage.quarantine",
+}
 
-__all__ = ["DiskStore", "WalReader", "WalWriter"]
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+    return getattr(importlib.import_module(mod), name)

@@ -20,6 +20,7 @@ Layout under ``data_dir``::
 
 from __future__ import annotations
 
+import io
 import json
 import os
 import queue
@@ -32,6 +33,19 @@ from pilosa_tpu.core.attrs import AttrStore
 from pilosa_tpu.core.holder import Holder
 from pilosa_tpu.core.hostrow import HostRow
 from pilosa_tpu.core.translate import TranslateStore
+from pilosa_tpu.obs.logger import StandardLogger
+from pilosa_tpu.obs.stats import NopStats
+from pilosa_tpu.storage.integrity import (
+    SnapshotCorruptError,
+    snapshot_footer,
+    split_snapshot,
+)
+from pilosa_tpu.storage.quarantine import (
+    BLOCKED_STATES,
+    STATE_DEGRADED,
+    STATE_UNAVAILABLE,
+    QuarantineRegistry,
+)
 from pilosa_tpu.storage.wal import (
     OP_ADD,
     OP_CLEAR_ROW,
@@ -39,7 +53,32 @@ from pilosa_tpu.storage.wal import (
     OP_SET_ROW,
     WalReader,
     WalWriter,
+    scan_wal,
 )
+
+
+def read_snapshot(path: str):
+    """Read + verify one snapshot file.
+
+    Returns ``(arrays, meta, status)`` with status one of ``"ok"``
+    (framed, CRC verified), ``"legacy"`` (pre-footer file, unverified),
+    or ``"bad"`` (corrupt — arrays is None and meta carries the error).
+    """
+    try:
+        with open(path, "rb") as fh:
+            data = fh.read()
+    except OSError as e:
+        return None, {"error": str(e)}, "bad"
+    try:
+        payload, meta = split_snapshot(data)
+    except SnapshotCorruptError as e:
+        return None, {"error": str(e)}, "bad"
+    try:
+        with np.load(io.BytesIO(payload)) as z:
+            arrays = {k: z[k] for k in ("row_ids", "offsets", "positions")}
+    except Exception as e:
+        return None, {"error": f"unreadable payload: {e}"}, "bad"
+    return arrays, meta, ("ok" if meta is not None else "legacy")
 
 
 class DiskStore:
@@ -47,13 +86,17 @@ class DiskStore:
 
     def __init__(self, data_dir: str, holder: Holder,
                  max_op_n: int = MAX_OP_N, snapshot_workers: int = 2,
-                 fsync_appends: bool = False):
+                 fsync_appends: bool = False, stats=None, logger=None):
         self.data_dir = data_dir
         self.holder = holder
         self.max_op_n = max_op_n
         #: fsync every WAL record (strict durability; default matches the
         #: reference's buffered op-log writes).
         self.fsync_appends = fsync_appends
+        self.stats = stats if stats is not None else NopStats()
+        self.logger = logger if logger is not None else StandardLogger()
+        self.quarantine = QuarantineRegistry(stats=self.stats,
+                                             logger=self.logger)
         os.makedirs(data_dir, exist_ok=True)
         self._writers: dict[tuple, WalWriter] = {}
         #: tombstones: fragments the holderCleaner removed. A snapshot
@@ -87,6 +130,9 @@ class DiskStore:
 
     def open(self) -> None:
         self.holder.op_writer_factory = self._op_writer_factory
+        # Let the executor consult the quarantine without a store import
+        # cycle (exec checks getattr(holder, "quarantine", None)).
+        self.holder.quarantine = self.quarantine
         # Finish any deletion a crash interrupted: subtrees are detached
         # by rename before their slow recursive unlink.
         import shutil
@@ -160,19 +206,37 @@ class DiskStore:
     def _load_fragment(self, frag, key: tuple) -> None:
         saved_writer = frag.op_writer
         frag.op_writer = None  # don't re-log replayed ops
+        snap_corrupt = False
+        wal_corrupt = False
+        replayed = 0
         try:
             snap = self._snap_path(key)
             if os.path.exists(snap):
-                with np.load(snap) as z:
-                    row_ids = z["row_ids"]
-                    offsets = z["offsets"]
-                    positions = z["positions"]
-                for i, rid in enumerate(row_ids.tolist()):
-                    lo, hi = int(offsets[i]), int(offsets[i + 1])
-                    frag.rows[rid] = HostRow.from_positions(positions[lo:hi])
-                frag._invalidate()
+                arrays, meta, status = read_snapshot(snap)
+                if status == "bad":
+                    snap_corrupt = True
+                    self.stats.count("integrity.snapshotCorrupt")
+                    self.quarantine.quarantine_file(
+                        key, snap, reason=f"snapshot: {meta['error']}")
+                else:
+                    if status == "legacy":
+                        self.stats.count("integrity.snapshotUnverified")
+                    row_ids = arrays["row_ids"]
+                    offsets = arrays["offsets"]
+                    positions = arrays["positions"]
+                    for i, rid in enumerate(row_ids.tolist()):
+                        lo, hi = int(offsets[i]), int(offsets[i + 1])
+                        frag.rows[rid] = HostRow.from_positions(
+                            positions[lo:hi])
+                    frag._invalidate()
+            wal_path = self._wal_path(key)
+            wal_info = scan_wal(wal_path)
+            wal_corrupt = wal_info["corrupt"]
             base = frag.shard * _shard_width()
-            for code, rows, cols in WalReader(self._wal_path(key)):
+            # Replay the valid prefix BEFORE any quarantine rename below
+            # — the prefix ops live only in this file.
+            for code, rows, cols in WalReader(wal_path):
+                replayed += 1
                 if code == OP_ADD:
                     frag.bulk_import(rows.tolist(), cols.tolist())
                 elif code == OP_REMOVE:
@@ -186,8 +250,33 @@ class DiskStore:
                     rid = int(rows[0]) if len(rows) else 0
                     frag.rows.pop(rid, None)
                     frag._invalidate()
+            if wal_corrupt:
+                # Mid-file damage: every op past the damage point is
+                # silently gone, so the replayed state is NOT the full
+                # acknowledged history — unlike a torn tail, which is
+                # the normal crash shape and stays un-quarantined.
+                self.stats.count("integrity.walCorrupt")
+                self.quarantine.quarantine_file(
+                    key, wal_path,
+                    reason="wal: corrupt record mid-file "
+                           f"({wal_info['ops']} ops salvaged)",
+                    state=STATE_DEGRADED)
         finally:
             frag.op_writer = saved_writer
+        if snap_corrupt or wal_corrupt:
+            # Final serving state: any salvaged data (snapshot or WAL
+            # prefix) leaves the fragment degraded-but-servable on a
+            # standalone node; no data at all makes the shard
+            # unavailable until a replica or repair steps in.
+            has_data = replayed > 0 or (wal_corrupt and not snap_corrupt)
+            self.quarantine.set_state(
+                key, STATE_DEGRADED if has_data else STATE_UNAVAILABLE)
+            if snap_corrupt and replayed > 0:
+                self.stats.count("integrity.walReplayFallback")
+            # The surviving state exists only in memory now (the bad
+            # files were renamed aside): persist it as soon as the
+            # snapshot workers start.
+            self._enqueue_snapshot(key)
 
     # -- WAL wiring --------------------------------------------------------
 
@@ -340,6 +429,14 @@ class DiskStore:
         frag = self.holder.fragment(index, field, view, shard)
         if frag is None:
             return  # deleted (cleaner / delete-field): nothing to write
+        e = self.quarantine.get(key)
+        if e is not None and e["state"] in BLOCKED_STATES:
+            # A blocked fragment's memory is NOT the truth (empty or
+            # partial); snapshotting it would launder the corruption
+            # into a "clean" file a restart then trusts. The scrubber
+            # flips the state to degraded after repairing, then
+            # snapshots and releases.
+            return
         with frag._lock:
             snap_rows = frag.rows_snapshot()
             row_ids = np.asarray([r for r, _ in snap_rows], dtype=np.uint64)
@@ -352,9 +449,14 @@ class DiskStore:
             path = self._snap_path(key)
             os.makedirs(os.path.dirname(path), exist_ok=True)
             tmp = path + ".tmp"
+            buf = io.BytesIO()
+            np.savez_compressed(buf, row_ids=row_ids, offsets=offsets,
+                                positions=positions)
+            payload = buf.getvalue()
             with open(tmp, "wb") as fh:
-                np.savez_compressed(fh, row_ids=row_ids, offsets=offsets,
-                                    positions=positions)
+                fh.write(payload)
+                fh.write(snapshot_footer(payload, rows=len(row_ids),
+                                         bits=len(positions)))
                 fh.flush()
                 os.fsync(fh.fileno())
             # Publish under the store lock, mutually exclusive with the
@@ -404,6 +506,16 @@ class DiskStore:
     def snapshot_all(self) -> None:
         for key in self._all_keys():
             self.snapshot_fragment(key)
+
+    def verify_snapshot(self, key: tuple) -> str:
+        """Re-verify one on-disk snapshot without loading it into the
+        holder (scrubber's disk walk). Returns "ok" / "legacy" / "bad"
+        / "missing"."""
+        path = self._snap_path(key)
+        if not os.path.exists(path):
+            return "missing"
+        _arrays, _meta, status = read_snapshot(path)
+        return status
 
     def _all_keys(self):
         for iname in self.holder.index_names():
@@ -479,9 +591,8 @@ class DiskStore:
             # A straggler is still snapshotting: leave the writers OPEN
             # so its lock-held snapshot+truncate stays valid, and warn —
             # closing them under it could lose acknowledged ops.
-            import sys
-            print("diskstore.close: snapshot worker still running; "
-                  "leaving WAL writers open", file=sys.stderr)
+            self.logger.printf("diskstore.close: snapshot worker still "
+                               "running; leaving WAL writers open")
             self.flush()
             return
         self.flush()

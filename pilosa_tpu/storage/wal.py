@@ -94,6 +94,59 @@ class WalWriter:
         self._f.close()
 
 
+def scan_wal(path: str) -> dict:
+    """Integrity scan distinguishing the two failure shapes a replay
+    cannot: a TORN TAIL (crash mid-append; the invalid bytes are the
+    file's last record and nothing valid follows) and MID-FILE
+    CORRUPTION (a damaged record with intact records after it — replay
+    silently drops every op past the damage, so the fragment must be
+    quarantined, not trusted).
+
+    Returns ``{"ops", "valid_bytes", "total_bytes", "torn", "corrupt"}``.
+    """
+    if not os.path.exists(path):
+        return {"ops": 0, "valid_bytes": 0, "total_bytes": 0,
+                "torn": False, "corrupt": False}
+    with open(path, "rb") as f:
+        data = f.read()
+
+    def _valid_at(off: int) -> int | None:
+        """End offset of a valid record starting at ``off``, else None."""
+        if off + _HEADER.size > len(data):
+            return None
+        magic, _code, n_rows, n_cols, crc = _HEADER.unpack_from(data, off)
+        end = off + _HEADER.size + 8 * (n_rows + n_cols)
+        if magic != _MAGIC or end > len(data):
+            return None
+        if (zlib.crc32(data[off + _HEADER.size:end]) & 0xFFFFFFFF) != crc:
+            return None
+        return end
+
+    ops = 0
+    off = 0
+    while True:
+        end = _valid_at(off)
+        if end is None:
+            break
+        ops += 1
+        off = end
+    torn = off < len(data)
+    corrupt = False
+    if torn:
+        # Any valid record past the damage proves mid-file corruption
+        # (appends are strictly sequential, so bytes after a real torn
+        # tail can only be garbage).
+        magic_bytes = _MAGIC.to_bytes(2, "little")
+        pos = data.find(magic_bytes, off + 1)
+        while pos != -1:
+            if _valid_at(pos) is not None:
+                corrupt = True
+                break
+            pos = data.find(magic_bytes, pos + 1)
+    return {"ops": ops, "valid_bytes": off, "total_bytes": len(data),
+            "torn": torn, "corrupt": corrupt}
+
+
 class WalReader:
     """Replays records; stops cleanly at a torn tail."""
 

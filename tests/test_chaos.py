@@ -58,6 +58,9 @@ def _spawn(addr, peers, data_dir, join=None, log_path=None):
     # fails the wedged job and frees the resize gate for the joiner's
     # next announce.
     env["PILOSA_TPU_RESIZE_ACK_TIMEOUT"] = "15"
+    # Fast scrub so disk corruption injected mid-soak is found and
+    # repaired within the heal window.
+    env["PILOSA_TPU_SCRUB_INTERVAL"] = "1.0"
     argv = [sys.executable, "-m", "pilosa_tpu.cli", "server",
             "--bind", addr, "--replica-n", "2", "--no-planner",
             "--data-dir", data_dir]
@@ -103,11 +106,13 @@ class Soak:
         self.procs = {}
         self.paused: set[int] = set()
         self.spawn_n = 0
+        #: each node's CURRENT data dir (re-joins get fresh dirs).
+        self.dirs = {i: str(tmp_path / f"n{i}") for i in range(3)}
         for i in range(3):
             self.procs[i] = _spawn(
                 self.addrs[i],
                 [a for j, a in enumerate(self.addrs) if j != i],
-                str(tmp_path / f"n{i}"),
+                self.dirs[i],
                 log_path=str(tmp_path / f"n{i}.log"))
         for a in self.addrs:
             _wait_up(a)
@@ -138,6 +143,7 @@ class Soak:
         """Operator re-admission flow: fresh dir, explicit join."""
         self.spawn_n += 1
         d = str(self.tmp / f"n{i}-re{self.spawn_n}")
+        self.dirs[i] = d
         self.procs[i] = _spawn(self.addrs[i], [], d, join=self.addrs[0],
                                log_path=str(self.tmp / f"n{i}.log"))
 
@@ -154,7 +160,7 @@ class Soak:
             self.procs[i] = _spawn(
                 self.addrs[i],
                 [a for j, a in enumerate(self.addrs) if j != i],
-                str(self.tmp / f"n{i}"),
+                self.dirs[i],
                 log_path=str(self.tmp / f"n{i}.log"))
 
     def act_pause(self):
@@ -196,6 +202,25 @@ class Soak:
             _post(self.addrs[0], "/cluster/resize/abort", timeout=20)
         except Exception:
             pass  # no active job / gate: fine
+
+    def act_corrupt_snapshot(self):
+        """Disk rot under a LIVE node: bit-flip one of its published
+        snapshots. The scrubber's re-verification (1s interval) or the
+        load-time check after a later restart must catch it; with
+        replica_n=2 the final oracle assertions stay exact either way."""
+        from pilosa_tpu.storage.faults import corrupt_file
+        i = self.rng.choice(self.victims() or [0])
+        snaps = []
+        for root, _dirs, files in os.walk(self.dirs[i]):
+            snaps += [os.path.join(root, f) for f in files
+                      if f.endswith(".snap")]
+        if not snaps:
+            return
+        try:
+            corrupt_file(self.rng.choice(sorted(snaps)), "bitflip",
+                         rng=self.rng)
+        except OSError:
+            pass  # racing the node's own snapshot publish: fine
 
     # -- workload actions ----------------------------------------------
 
@@ -258,7 +283,7 @@ class Soak:
         (3, "act_write_batch"), (2, "act_import_batch"), (2, "act_clear"),
         (4, "act_query"), (1, "act_kill"), (2, "act_restart"),
         (1, "act_pause"), (2, "act_resume"), (1, "act_remove_node"),
-        (1, "act_resize_abort"),
+        (1, "act_resize_abort"), (1, "act_corrupt_snapshot"),
     )
 
     def run_chaos(self, seconds: float):
@@ -274,32 +299,90 @@ class Soak:
         self.paused.clear()
         for _ in range(3):  # act_restart fills at most one slot per call
             self.act_restart()
-        for i, p in self.procs.items():
-            _wait_up(self.addrs[i])
+        for i, p in list(self.procs.items()):
+            try:
+                _wait_up(self.addrs[i])
+            except TimeoutError:
+                pass  # the settle loop below reaps and refills dead slots
         # Wait for the ring to settle: every node NORMAL and the
         # coordinator seeing 3 members. A node that restarted with its
         # old data dir after a membership removal correctly parks in
         # terminal REMOVED — recycle it through the operator flow
         # (kill + fresh join).
         deadline = time.time() + 360
+        last_abort = time.time()
+        #: node -> when the coordinator's committed ring was first seen
+        #: excluding it while the node itself still reported NORMAL.
+        missing_since: dict[int, float] = {}
         while time.time() < deadline:
-            try:
-                sts = {i: _status(self.addrs[i])
-                       for i in sorted(self.procs)}
-                # EVERY node must hold the full 3-member ring: a
-                # (re)joined node can report NORMAL while still solo,
-                # and a solo member serves neither schema nor writes.
-                if (all(s["state"] == "NORMAL" for s in sts.values())
-                        and all(len(s["nodes"]) == 3
-                                for s in sts.values())):
-                    return
-                for i, s in sts.items():
-                    if s["state"] == "REMOVED" and i != 0:
+            # A process that exits DURING this wait (lost a startup race
+            # with a mid-heal membership change) would otherwise park the
+            # loop on connection-refused until the deadline: reap and
+            # refill dead slots every iteration.
+            for i, p in list(self.procs.items()):
+                if p.poll() is not None:
+                    del self.procs[i]
+            self.act_restart()
+            # Per-node status: one unreachable node must not blind the
+            # sweep to a REMOVED peer that needs recycling.
+            sts = {}
+            for i in sorted(self.procs):
+                try:
+                    sts[i] = _status(self.addrs[i])
+                except Exception:
+                    pass
+            # EVERY node must hold the full 3-member ring: a
+            # (re)joined node can report NORMAL while still solo,
+            # and a solo member serves neither schema nor writes.
+            if (len(sts) == 3
+                    and all(s["state"] == "NORMAL" for s in sts.values())
+                    and all(len(s["nodes"]) == 3
+                            for s in sts.values())):
+                return
+            for i, s in sts.items():
+                if s["state"] == "REMOVED" and i != 0:
+                    try:
                         self.procs[i].kill()
                         self.procs[i].wait(timeout=10)
-                        self._respawn_join(i)
-            except Exception:
-                pass
+                    except Exception:
+                        pass
+                    self._respawn_join(i)
+            # Ambiguous removal: if a remove-node response is lost after
+            # the server commits it, the un-killed victim keeps running
+            # with its stale pre-removal ring and never learns it is no
+            # longer a member — NORMAL forever, never REMOVED. Detect
+            # "alive but excluded from the coordinator's committed ring"
+            # (stable for 20s, so a join mid-announce is not shot down)
+            # and recycle through the operator flow.
+            if 0 in sts and sts[0]["state"] == "NORMAL":
+                ring0 = {n["id"] for n in sts[0]["nodes"]}
+                for i in list(self.procs):
+                    s = sts.get(i)
+                    if (i == 0 or s is None or s["state"] != "NORMAL"
+                            or self.addrs[i] in ring0):
+                        missing_since.pop(i, None)
+                        continue
+                    t0 = missing_since.setdefault(i, time.time())
+                    if time.time() - t0 < 20:
+                        continue
+                    missing_since.pop(i, None)
+                    try:
+                        self.procs[i].kill()
+                        self.procs[i].wait(timeout=10)
+                    except Exception:
+                        pass
+                    self._respawn_join(i)
+            # A resize job wedged on a participant that vanished
+            # mid-stream holds the gate shut against every later join;
+            # if nothing has settled for a while, kick it loose (an
+            # aborted healthy join just re-announces).
+            if time.time() - last_abort > 45:
+                last_abort = time.time()
+                try:
+                    _post(self.addrs[0], "/cluster/resize/abort",
+                          timeout=20)
+                except Exception:
+                    pass
             time.sleep(0.5)
         states = {}
         for i in sorted(self.procs):
@@ -413,5 +496,63 @@ def test_chaos_soak(tmp_path, seed):
         except AssertionError:
             soak.heal()  # contention: one more settle window, no rewrite
             soak.assert_converged()
+    finally:
+        soak.close()
+
+
+@pytest.mark.slow
+def test_corrupt_snapshot_recovery_across_restart(tmp_path):
+    """Deterministic corruption drill on real server processes: flip a
+    bit in a killed node's published snapshot, restart it on the same
+    dir, and require exact convergence — the restarted node must detect
+    the damage, serve via replicas, and let the scrubber repair it."""
+    os.environ["PILOSA_TPU_MAX_OP_N"] = "20"  # snapshot early and often
+    try:
+        soak = Soak(tmp_path, 4242)
+    finally:
+        del os.environ["PILOSA_TPU_MAX_OP_N"]
+    try:
+        _post(soak.addrs[0], "/index/i")
+        _post(soak.addrs[0], "/index/i/field/f")
+        # Each Set is one WAL record: enough records on every shard to
+        # cross max-op-n so snapshots are published (not just WALs).
+        for shard in range(3):
+            base_col = shard * (1 << 20)
+            for batch in range(3):
+                pairs = [(r, base_col + 100 * batch + 10 * i + r)
+                         for r in range(N_ROWS) for i in range(10)]
+                q = " ".join(f"Set({c}, f={r})" for r, c in pairs)
+                _post(soak.addrs[0], "/index/i/query", q, timeout=60)
+                for r, c in pairs:
+                    soak.intent[(r, c)] = True
+
+        def snaps_of(i):
+            out = []
+            for root, _dirs, files in os.walk(soak.dirs[i]):
+                out += [os.path.join(root, fn) for fn in files
+                        if fn.endswith(".snap")]
+            return out
+
+        deadline = time.time() + 60
+        while time.time() < deadline and not snaps_of(1):
+            time.sleep(0.3)
+        assert snaps_of(1), "node1 never published a snapshot"
+
+        soak.procs[1].kill()
+        soak.procs[1].wait(timeout=10)
+        del soak.procs[1]
+        from pilosa_tpu.storage.faults import corrupt_file
+        for snap in snaps_of(1):
+            corrupt_file(snap, "bitflip", rng=soak.rng)
+        soak.act_restart()  # only node1 is dead; may pick fresh-join too
+        soak.heal()
+        soak.assert_converged()
+        # The evidence survives somewhere: either preserved *.quarantine
+        # files (same-dir restart) or the abandoned dir (fresh re-join).
+        if soak.dirs[1] == str(tmp_path / "n1"):
+            qfiles = [os.path.join(root, fn)
+                      for root, _d, files in os.walk(soak.dirs[1])
+                      for fn in files if fn.endswith(".quarantine")]
+            assert qfiles, "corrupt snapshot was not quarantined"
     finally:
         soak.close()

@@ -118,3 +118,53 @@ def test_base_url_scheme_handling():
         "https://127.0.0.1:10101"
     assert cli._base_url("https://h:1/", tls=False) == "https://h:1"
     assert cli._base_url("http://h:1") == "http://h:1"
+
+
+def test_check_detects_corruption_and_repairs_tmp(node, tmp_path, capsys):
+    """The offline verifier: BAD + exit 1 on a flipped snapshot bit,
+    quarantined files reported, --repair sweeps stale tmp files."""
+    from pilosa_tpu.storage.faults import corrupt_file
+
+    base = node.address
+    _post(base, "/index/i", "{}")
+    _post(base, "/index/i/field/f", "{}")
+    _post(base, "/index/i/query", "Set(5, f=1)")
+    node.store.flush()
+    data_dir = str(tmp_path / "data")
+    snap = os.path.join(data_dir, "i", "f", "standard", "0.snap")
+    corrupt_file(snap, "bitflip")
+    stale = os.path.join(data_dir, "i", "f", "standard", "0.snap.tmp")
+    open(stale, "w").close()
+
+    assert cli.main(["check", data_dir]) == 1
+    out = capsys.readouterr().out
+    assert "BAD snap" in out and "crc mismatch" in out
+    assert "stale tmp" in out
+    assert os.path.exists(stale)  # without --repair: report only
+
+    assert cli.main(["check", "--repair", data_dir]) == 1
+    assert not os.path.exists(stale)
+
+    # Quarantined evidence is listed, not flagged BAD.
+    os.replace(snap, snap + ".quarantine")
+    assert cli.main(["check", data_dir]) == 0
+    assert "quarantined" in capsys.readouterr().out
+
+
+def test_check_flags_midfile_wal_corruption(tmp_path, capsys):
+    from pilosa_tpu.storage.wal import WalWriter
+
+    d = tmp_path / "data" / "i" / "f" / "standard"
+    d.mkdir(parents=True)
+    p = str(d / "0.wal")
+    w = WalWriter(p)
+    for i in range(6):
+        w.append("add", [i], [i])
+    w.close()
+    size = os.path.getsize(p)
+    with open(p, "r+b") as f:
+        f.seek(size // 2)
+        f.write(b"\x00\x00\x00\x00")
+    assert cli.main(["check", str(tmp_path / "data")]) == 1
+    out = capsys.readouterr().out
+    assert "BAD wal" in out and "salvageable" in out

@@ -271,3 +271,263 @@ def test_delete_view_unlinks_files_and_survives_reload(tmp_path):
     assert f2.view("standard_2024") is None
     assert list(f2.row(1).columns()) == [3]  # standard view intact
     store2.close()
+
+
+# -- integrity: checksummed snapshots, quarantine, fault injection ---------
+
+def test_snapshot_footer_roundtrip():
+    from pilosa_tpu.storage.integrity import snapshot_footer, split_snapshot
+    payload = b"not really an npz but bytes are bytes"
+    data = payload + snapshot_footer(payload, rows=3, bits=9)
+    got, meta = split_snapshot(data)
+    assert got == payload
+    assert meta["rows"] == 3 and meta["bits"] == 9
+
+
+def test_snapshot_footer_rejects_damage():
+    from pilosa_tpu.storage.integrity import (
+        SnapshotCorruptError, snapshot_footer, split_snapshot)
+    payload = b"x" * 100
+    data = bytearray(payload + snapshot_footer(payload, rows=1, bits=1))
+    data[50] ^= 0x10  # flip a payload bit
+    with pytest.raises(SnapshotCorruptError):
+        split_snapshot(bytes(data))
+
+
+def test_truncated_footer_is_corrupt_not_legacy():
+    """A crash mid-footer must read as CORRUPT: zipfile tolerates
+    trailing junk, so without the leading-magic check np.load would
+    silently 'downgrade' the file to an unverified legacy snapshot."""
+    from pilosa_tpu.storage.integrity import (
+        SnapshotCorruptError, snapshot_footer, split_snapshot)
+    payload = b"y" * 100
+    data = payload + snapshot_footer(payload, rows=1, bits=1)
+    with pytest.raises(SnapshotCorruptError, match="truncated"):
+        split_snapshot(data[:-7])
+
+
+def test_line_frame_roundtrip_and_legacy():
+    from pilosa_tpu.storage.integrity import (
+        LineCorruptError, frame_line, parse_line)
+    framed = frame_line('["k", 7]')
+    assert parse_line(framed) == ('["k", 7]', True)
+    # Pre-framing line: accepted but flagged unverified.
+    assert parse_line('["legacy", 1]') == ('["legacy", 1]', False)
+    with pytest.raises(LineCorruptError):
+        parse_line(framed[:-1] + "X")
+
+
+def test_bitflip_snapshot_quarantined_preserved(tmp_path):
+    """Bit-flipped snapshot + empty WAL: the fragment must NOT serve
+    zeros — the file moves to *.quarantine (evidence kept) and the
+    shard is marked unavailable."""
+    from pilosa_tpu.storage.faults import corrupt_file
+
+    d = str(tmp_path / "data")
+    h, store = make_holder(d)
+    h.create_index("i").create_field("f").import_bits([1] * 20, range(20))
+    store.close()
+    snap = os.path.join(d, "i", "f", "standard", "0.snap")
+    corrupt_file(snap, "bitflip")
+
+    h2, store2 = make_holder(d)
+    key = ("i", "f", "standard", 0)
+    e = store2.quarantine.get(key)
+    assert e is not None and e["state"] == "unavailable"
+    assert os.path.exists(snap + ".quarantine")
+    assert not os.path.exists(snap)
+    from pilosa_tpu.storage.quarantine import ShardCorruptError
+    with pytest.raises(ShardCorruptError):
+        Executor(h2).execute("i", "Row(f=1)")
+    store2.close()
+
+
+def test_corrupt_snapshot_falls_back_to_wal(tmp_path):
+    """Snapshot corrupt but WAL intact: standalone degrades to WAL-only
+    replay — partial truth, flagged degraded, still servable."""
+    from pilosa_tpu.storage.faults import corrupt_file
+
+    d = str(tmp_path / "data")
+    h, store = make_holder(d)
+    h.create_index("i").create_field("f")
+    e = Executor(h)
+    e.execute("i", "Set(5, f=1) Set(9, f=1)")
+    store.save_schema()  # crash: WAL only, no snapshot
+    # Fabricate a corrupt snapshot beside the healthy WAL.
+    snap = os.path.join(d, "i", "f", "standard", "0.snap")
+    with open(snap, "wb") as f:
+        f.write(b"\x01" * 48)
+
+    h2, store2 = make_holder(d)
+    entry = store2.quarantine.get(("i", "f", "standard", 0))
+    assert entry is not None and entry["state"] == "degraded"
+    (row,) = Executor(h2).execute("i", "Row(f=1)")
+    assert row.columns().tolist() == [5, 9]
+    store2.close()
+
+
+def test_scan_wal_midfile_corruption(tmp_path):
+    """Damage in the MIDDLE of a WAL (a later record is still valid) is
+    corruption — ops were silently lost — unlike a torn tail."""
+    from pilosa_tpu.storage import scan_wal
+    from pilosa_tpu.storage.wal import WalWriter
+
+    p = str(tmp_path / "f.wal")
+    w = WalWriter(p)
+    for i in range(8):
+        w.append("add", [i], [i * 10])
+    w.close()
+    # Clean file: no tear, no corruption.
+    info = scan_wal(p)
+    assert info["ops"] == 8 and not info["torn"] and not info["corrupt"]
+    # Flip a byte inside record 3's payload.
+    size = os.path.getsize(p)
+    with open(p, "r+b") as f:
+        f.seek(size // 2)
+        b = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([b[0] ^ 0xFF]))
+    info = scan_wal(p)
+    assert info["corrupt"]
+    assert 0 < info["ops"] < 8
+    # Torn tail (truncate mid-record): NOT corruption.
+    w2path = str(tmp_path / "g.wal")
+    w2 = WalWriter(w2path)
+    w2.append("add", [1], [10])
+    w2.append("add", [2], [20])
+    w2.close()
+    with open(w2path, "r+b") as f:
+        f.truncate(os.path.getsize(w2path) - 3)
+    info = scan_wal(w2path)
+    assert info["torn"] and not info["corrupt"] and info["ops"] == 1
+
+
+def test_corrupt_wal_quarantined_as_degraded(tmp_path):
+    """Mid-file WAL damage: salvage the valid prefix, quarantine the
+    file (degraded — some acked ops are gone), keep serving."""
+    d = str(tmp_path / "data")
+    h, store = make_holder(d)
+    h.create_index("i").create_field("f")
+    e = Executor(h)
+    for c in range(10):
+        e.execute("i", f"Set({c}, f=1)")
+    store.save_schema()
+    wal = os.path.join(d, "i", "f", "standard", "0.wal")
+    size = os.path.getsize(wal)
+    with open(wal, "r+b") as f:
+        f.seek(size // 2)
+        f.write(b"\xde\xad\xbe\xef")
+
+    h2, store2 = make_holder(d)
+    entry = store2.quarantine.get(("i", "f", "standard", 0))
+    assert entry is not None and entry["state"] == "degraded"
+    assert os.path.exists(wal + ".quarantine")
+    (row,) = Executor(h2).execute("i", "Row(f=1)")
+    cols = row.columns().tolist()
+    assert 0 < len(cols) < 10  # prefix salvaged, damaged tail lost
+    store2.close()
+
+
+def test_corrupt_jsonl_lines_skipped(tmp_path):
+    """A damaged line in translate/attrs jsonl is skipped (and counted),
+    not allowed to poison the whole store."""
+    from pilosa_tpu.core.attrs import AttrStore
+    from pilosa_tpu.core.translate import TranslateStore
+
+    tpath = str(tmp_path / "t.jsonl")
+    ts = TranslateStore(tpath)
+    ka = ts.translate_key("alpha")
+    ts.translate_key("beta")
+    ts.save()
+    lines = open(tpath).read().splitlines()
+    lines[1] = lines[1][:-3] + "xyz"  # damage beta's line
+    with open(tpath, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    ts2 = TranslateStore(tpath)
+    assert ts2.corrupt_lines == 1
+    assert ts2.translate_key("alpha", create=False) == ka
+    assert ts2.translate_key("beta", create=False) is None
+
+    apath = str(tmp_path / "a.jsonl")
+    st = AttrStore(apath)
+    st.set_attrs(1, {"color": "red"})
+    st.set_attrs(2, {"color": "blue"})
+    st.save()
+    lines = open(apath).read().splitlines()
+    lines[0] = lines[0][:-1]  # truncate a framed line
+    with open(apath, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    st2 = AttrStore(apath)
+    assert st2.corrupt_lines == 1
+    assert st2.attrs(2) == {"color": "blue"}
+
+
+def test_legacy_unframed_snapshot_still_loads(tmp_path):
+    """Pre-footer snapshots (and unframed jsonl) from older data dirs
+    load fine — flagged unverified, upgraded on the next snapshot."""
+    from pilosa_tpu.storage.diskstore import read_snapshot
+    from pilosa_tpu.storage.integrity import FOOTER_SIZE
+
+    d = str(tmp_path / "data")
+    h, store = make_holder(d)
+    h.create_index("i").create_field("f").import_bits([1, 2], [10, 20])
+    store.close()
+    snap = os.path.join(d, "i", "f", "standard", "0.snap")
+    # Strip the footer: byte-identical to a pre-footer snapshot.
+    data = open(snap, "rb").read()
+    with open(snap, "wb") as f:
+        f.write(data[:-FOOTER_SIZE])
+    arrays, meta, status = read_snapshot(snap)
+    assert status == "legacy" and meta is None
+    assert arrays["row_ids"].tolist() == [1, 2]
+
+    h2, store2 = make_holder(d)
+    assert len(store2.quarantine) == 0
+    (row,) = Executor(h2).execute("i", "Row(f=1)")
+    assert row.columns().tolist() == [10]
+    # Re-snapshot upgrades the file to framed.
+    store2.snapshot_fragment(("i", "f", "standard", 0))
+    assert store2.verify_snapshot(("i", "f", "standard", 0)) == "ok"
+    store2.close()
+
+
+def test_faulty_diskstore_one_shot(tmp_path):
+    from pilosa_tpu.storage.diskstore import read_snapshot
+    from pilosa_tpu.storage.faults import FaultyDiskStore
+
+    d = str(tmp_path / "data")
+    h = Holder()
+    store = FaultyDiskStore(d, h)
+    store.open()
+    h.create_index("i").create_field("f").set_bit(1, 5)
+    key = ("i", "f", "standard", 0)
+    store.fault_next_snapshot = "bitflip"
+    store.snapshot_fragment(key)
+    assert store.faults_injected == 1
+    assert read_snapshot(store._snap_path(key))[2] == "bad"
+    # One-shot: the next snapshot is clean again.
+    store.snapshot_fragment(key)
+    assert store.faults_injected == 1
+    assert read_snapshot(store._snap_path(key))[2] == "ok"
+    store.close()
+
+
+def test_snapshot_guard_refuses_blocked_overwrite(tmp_path):
+    """flush() on a node holding a quarantined-unavailable shard must
+    NOT launder the corruption into a clean-looking empty snapshot."""
+    from pilosa_tpu.storage.faults import corrupt_file
+
+    d = str(tmp_path / "data")
+    h, store = make_holder(d)
+    h.create_index("i").create_field("f").import_bits([1] * 5, range(5))
+    store.close()
+    snap = os.path.join(d, "i", "f", "standard", "0.snap")
+    corrupt_file(snap, "bitflip")
+
+    h2, store2 = make_holder(d)
+    key = ("i", "f", "standard", 0)
+    assert store2.quarantine.get(key)["state"] == "unavailable"
+    store2.flush()  # must skip the blocked key
+    assert not os.path.exists(snap)
+    assert store2.verify_snapshot(key) == "missing"
+    store2.close()
