@@ -901,6 +901,69 @@ def bench_cluster(extra):
 
 
 # ---------------------------------------------------------------------------
+# config 7: backup / restore throughput
+# ---------------------------------------------------------------------------
+
+
+def bench_backup(extra):
+    """Backup + restore MB/s through the real subsystem: a 2-node
+    cluster with durable stores is captured into a LocalDirArchive and
+    rebuilt onto a fresh 2-node cluster."""
+    import shutil
+    import tempfile
+
+    from pilosa_tpu.backup import BackupWriter, LocalDirArchive, RestoreJob
+    from pilosa_tpu.cluster.harness import LocalCluster
+    from pilosa_tpu.config import SHARD_WIDTH
+
+    tmp = tempfile.mkdtemp(prefix="pilosa-bench-backup-")
+    try:
+        n_shards = 8
+        rng = np.random.default_rng(7)
+        dirs = [os.path.join(tmp, f"src{i}") for i in range(2)]
+        lc = LocalCluster(2, replica_n=1, data_dirs=dirs)
+        lc.create_index("bk")
+        lc.create_field("bk", "f")
+        n_bits = 1_000_000
+        rows = rng.integers(0, 64, n_bits).astype(np.uint64)
+        cols = _rand_positions(rng, n_bits, n_shards * SHARD_WIDTH)
+        shard_of = (cols // np.uint64(SHARD_WIDTH)).astype(np.int64)
+        cl0 = lc.nodes[0].cluster
+        groups = cl0.shards_by_node(cl0.nodes, "bk", list(range(n_shards)))
+        node_by_id = {cn.id: cn for cn in lc.nodes}
+        for node_id, shs in groups.items():
+            mask = np.isin(shard_of, shs)
+            node_by_id[node_id].handle_import_request(
+                "bk", "f", rows=rows[mask], cols=cols[mask])
+        for cn in lc.nodes:
+            cn.store.flush()
+
+        archive = LocalDirArchive(os.path.join(tmp, "archive"))
+        n0 = lc[0]
+        w = BackupWriter(n0.holder, n0.cluster, lc.client, n0.store,
+                         archive)
+        t0 = time.perf_counter()
+        manifest = w.run()
+        dt = time.perf_counter() - t0
+        stored = sum(e["size"] for e in manifest["files"])
+        extra["backup_mb"] = round(stored / 1e6, 2)
+        extra["backup_mb_s"] = round(stored / 1e6 / dt, 1)
+
+        dirs2 = [os.path.join(tmp, f"dst{i}") for i in range(2)]
+        lc2 = LocalCluster(2, replica_n=1, data_dirs=dirs2)
+        n = lc2[0]
+        t0 = time.perf_counter()
+        res = RestoreJob(n.holder, n.cluster, lc2.client, archive,
+                         manifest["id"], store=n.store).run()
+        dt = time.perf_counter() - t0
+        extra["restore_mb_s"] = round(res["bytes"] / 1e6 / dt, 1)
+        for cn in lc.nodes + lc2.nodes:
+            cn.store.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
 
 
 def main() -> None:
@@ -908,7 +971,8 @@ def main() -> None:
 
     want = (set(c.strip() for c in CONFIGS.split(","))
             if CONFIGS != "all"
-            else {"star", "topn", "bsi", "time", "cluster", "oversub"})
+            else {"star", "topn", "bsi", "time", "cluster", "oversub",
+                  "backup"})
     extra: dict = {"backend": jax.default_backend(),
                    "devices": len(jax.devices())}
 
@@ -941,7 +1005,8 @@ def main() -> None:
         qps, cpu_qps = bench_star_trace(extra)
     for name, fn in (("topn", bench_topn), ("bsi", bench_bsi),
                      ("time", bench_time), ("cluster", bench_cluster),
-                     ("oversub", bench_oversubscribed)):
+                     ("oversub", bench_oversubscribed),
+                     ("backup", bench_backup)):
         if name in want:
             t0 = time.perf_counter()
             try:

@@ -1,0 +1,46 @@
+"""Backup, restore, and point-in-time recovery.
+
+The checkpointing layer over the durability engine: cluster-consistent
+archives of every fragment's CRC-verified snapshot + WAL segment plus
+schema, key translation, and attr stores, written through a small
+``ArchiveStore`` interface (local directory today, object store later).
+
+- ``BackupWriter``   — full + incremental capture, coordinated across
+  the cluster so each shard is archived exactly once from a healthy
+  (non-quarantined) replica, rate-limited through the QoS internal
+  class.
+- ``RestoreJob``     — manifest-driven rebuild of a fresh (possibly
+  differently sized) cluster, resharded through the placement layer,
+  CRC-verified on ingest, atomic (all-or-nothing per restore).
+- ``verify_archive`` — offline archive check (manifest completeness,
+  per-file CRCs, snapshot footers, WAL chain continuity).
+
+Reference: ctl/backup.go / ctl/restore.go (operator-driven disaster
+recovery over the Holder→fragment tree).
+"""
+
+from .archive import (
+    ArchiveStore,
+    BackupError,
+    LocalDirArchive,
+    MANIFEST_NAME,
+    new_backup_id,
+    resolve_files,
+)
+from .restore import RestoreJob, select_backup_at
+from .verify import verify_archive
+from .writer import BackupWriter, capture_fragment
+
+__all__ = [
+    "ArchiveStore",
+    "BackupError",
+    "BackupWriter",
+    "LocalDirArchive",
+    "MANIFEST_NAME",
+    "RestoreJob",
+    "capture_fragment",
+    "new_backup_id",
+    "resolve_files",
+    "select_backup_at",
+    "verify_archive",
+]

@@ -37,6 +37,9 @@ _DEFAULTS = {
     # WAL records per fragment before a background snapshot triggers
     # (reference MaxOpN, fragment.go:84).
     "max_op_n": 10_000,
+    # Cap on preserved *.quarantine evidence files per fragment; the
+    # oldest are pruned after a successful scrub repair (0 keeps all).
+    "quarantine_keep_n": 0,
     "join": "",
     "tls_cert": "",
     "tls_key": "",
@@ -126,6 +129,8 @@ def cmd_server(args) -> int:
         cfg["scrub_interval"] = args.scrub_interval
     if args.max_op_n is not None:
         cfg["max_op_n"] = args.max_op_n
+    if args.quarantine_keep_n is not None:
+        cfg["quarantine_keep_n"] = args.quarantine_keep_n
 
     from pilosa_tpu.server.node import ServerNode
     node = ServerNode(
@@ -154,6 +159,7 @@ def cmd_server(args) -> int:
         qos_slow_query_ms=float(cfg["qos_slow_query_ms"]),
         qos_warmup=str(cfg["qos_warmup"]),
         qos_warmup_shards=str(cfg["qos_warmup_shards"]),
+        quarantine_keep_n=int(cfg["quarantine_keep_n"]),
     )
     node.open()  # starts the (single) serve loop in the background
     print(f"pilosa-tpu serving at {node.address}", file=sys.stderr)
@@ -328,12 +334,25 @@ def cmd_check(args) -> int:
     """Offline integrity check of a data dir (ctl/check.go:30): verify
     snapshot footer CRCs, WAL op checksums (torn tail vs mid-file
     corruption), and jsonl line frames; report quarantined evidence
-    files. ``--repair`` sweeps stale ``*.tmp`` crash leftovers. Exits
-    non-zero when any file is BAD."""
+    files. ``--repair`` sweeps stale ``*.tmp`` crash leftovers.
+    ``--archive`` additionally (or instead) verifies a backup archive
+    directory end to end. Exits non-zero when anything is BAD."""
     from pilosa_tpu.storage.integrity import LineCorruptError, parse_line
     from pilosa_tpu.storage.wal import scan_wal
+    if not args.data_dir and not getattr(args, "archive", None):
+        print("check: a data dir or --archive is required", file=sys.stderr)
+        return 1
     bad = 0
-    for root, _, files in os.walk(args.data_dir):
+    if getattr(args, "archive", None):
+        from pilosa_tpu.backup import verify_archive
+        res = verify_archive(args.archive)
+        for prob in res["problems"]:
+            print(f"BAD archive {prob}")
+            bad += 1
+        if res["ok"]:
+            print(f"ok archive {args.archive} ({res['checked']} files, "
+                  f"{res.get('backups', 0)} backup(s) verified)")
+    for root, _, files in os.walk(args.data_dir or ""):
         for fn in sorted(files):
             p = os.path.join(root, fn)
             if fn.endswith(".wal"):
@@ -384,7 +403,7 @@ def cmd_check(args) -> int:
                 else:
                     print(f"ok jsonl {p} ({n_ok} verified, "
                           f"{n_legacy} unframed)")
-            elif fn.endswith(".quarantine"):
+            elif fn.endswith(".quarantine") or ".quarantine." in fn:
                 print(f"quarantined {p} (preserved corruption evidence)")
             elif fn.endswith(".tmp"):
                 if getattr(args, "repair", False):
@@ -398,6 +417,92 @@ def cmd_check(args) -> int:
                     print(f"stale tmp {p} (crash leftover; "
                           f"--repair removes)")
     return 1 if bad else 0
+
+
+def _get(host: str, path: str, tls: bool = False, ctx=None) -> dict:
+    with urllib.request.urlopen(f"{_base_url(host, tls)}{path}",
+                                timeout=60, context=ctx) as resp:
+        return json.loads(resp.read() or b"{}")
+
+
+def _poll_job(host: str, status_path: str, tls, ctx, what: str) -> int:
+    """Follow a background backup/restore to completion via its status
+    endpoint; prints the final status JSON and exits non-zero on
+    failure."""
+    import time
+    st = {}
+    while True:
+        st = _get(host, status_path, tls=tls, ctx=ctx)
+        state = st.get("state")
+        if state in ("done", "failed", "idle"):
+            break
+        print(f"\r{what}: {state} {st.get('doneFragments', 0)}"
+              f"/{st.get('totalFragments', 0)} fragments",
+              end="", file=sys.stderr)
+        time.sleep(0.2)
+    print(file=sys.stderr)
+    if state != "done":
+        print(f"{what} {st.get('id', '')} failed: "
+              f"{st.get('error', 'unknown error')}", file=sys.stderr)
+        return 1
+    print(json.dumps(st, indent=2))
+    return 0
+
+
+def cmd_backup(args) -> int:
+    """Drive a cluster backup through a node's /backup endpoint and
+    wait for completion. The archive path is resolved on the SERVER, so
+    point it at a directory the node can write (shared mount etc.)."""
+    tls, ctx = _tls_args(args)
+    body: dict = {"archive": args.archive}
+    if args.parent:
+        body["parent"] = args.parent
+    try:
+        resp = _post(args.host, "/backup", json.dumps(body).encode(),
+                     tls=tls, ctx=ctx)
+    except urllib.error.HTTPError as e:
+        print(f"backup: {e.read().decode(errors='replace')}",
+              file=sys.stderr)
+        return 1
+    print(f"backup {resp.get('id')} started", file=sys.stderr)
+    return _poll_job(args.host, "/backup/status", tls, ctx, "backup")
+
+
+def cmd_restore(args) -> int:
+    """Restore a backup onto the cluster behind --host (any size) and
+    wait for completion; --pitr-ops caps WAL replay for point-in-time
+    recovery and --force overwrites clashing live indexes."""
+    tls, ctx = _tls_args(args)
+    body: dict = {"archive": args.archive}
+    if args.id:
+        body["id"] = args.id
+    if args.force:
+        body["force"] = True
+    if args.pitr_ops is not None:
+        body["pitrOps"] = args.pitr_ops
+    try:
+        resp = _post(args.host, "/restore", json.dumps(body).encode(),
+                     tls=tls, ctx=ctx)
+    except urllib.error.HTTPError as e:
+        print(f"restore: {e.read().decode(errors='replace')}",
+              file=sys.stderr)
+        return 1
+    print(f"restore of {resp.get('id')} started", file=sys.stderr)
+    return _poll_job(args.host, "/restore/status", tls, ctx, "restore")
+
+
+def cmd_backup_verify(args) -> int:
+    """Offline end-to-end verification of a backup archive directory:
+    manifests, parent chains, per-file CRCs, snapshot footers, WAL
+    records, and meta line frames. Exits 1 on any damage."""
+    from pilosa_tpu.backup import verify_archive
+    res = verify_archive(args.archive, backup_id=args.id)
+    for prob in res["problems"]:
+        print(f"BAD {prob}")
+    verdict = "ok" if res["ok"] else f"{len(res['problems'])} problem(s)"
+    print(f"{args.archive}: {res['checked']} file(s) in "
+          f"{res.get('backups', 1)} backup(s): {verdict}")
+    return 0 if res["ok"] else 1
 
 
 def cmd_inspect(args) -> int:
@@ -433,6 +538,9 @@ def cmd_generate_config(args) -> int:
           'scrub-interval = 60.0\n'
           '# WAL records per fragment before a snapshot triggers\n'
           'max-op-n = 10000\n'
+          '# preserved *.quarantine evidence files per fragment '
+          '(0 keeps all)\n'
+          'quarantine-keep-n = 0\n'
           'tls-cert = ""\n'
           'tls-key = ""\n'
           'tls-ca-cert = ""\n'
@@ -484,6 +592,10 @@ def main(argv: list[str] | None = None) -> int:
     s.add_argument("--max-op-n", type=int, default=None,
                    help="WAL records per fragment before a snapshot "
                         "triggers")
+    s.add_argument("--quarantine-keep-n", type=int, default=None,
+                   help="preserved *.quarantine evidence files per "
+                        "fragment; oldest pruned after a successful "
+                        "repair (0 keeps all)")
     s.add_argument("--trace-endpoint", default="",
                    help="OTLP/HTTP collector URL for trace export")
     s.add_argument("--config", default=None)
@@ -514,10 +626,49 @@ def main(argv: list[str] | None = None) -> int:
     s.set_defaults(fn=cmd_export)
 
     s = sub.add_parser("check", help="offline data-dir consistency check")
-    s.add_argument("data_dir")
+    s.add_argument("data_dir", nargs="?", default="")
     s.add_argument("--repair", action="store_true",
                    help="sweep stale .tmp crash leftovers")
+    s.add_argument("--archive", default=None,
+                   help="also verify a backup archive directory")
     s.set_defaults(fn=cmd_check)
+
+    s = sub.add_parser("backup", help="back up the cluster to an archive")
+    s.add_argument("--host", default="127.0.0.1:10101",
+                   help="host:port, or a full http(s)://host:port URL")
+    s.add_argument("--tls", action="store_true",
+                   help="use https (implied by an https:// --host)")
+    s.add_argument("--tls-skip-verify", action="store_true")
+    s.add_argument("--parent", default=None,
+                   help="parent backup id: capture an incremental "
+                        "against it")
+    s.add_argument("archive", help="archive directory (on the server)")
+    s.set_defaults(fn=cmd_backup)
+
+    s = sub.add_parser("restore",
+                       help="restore a backup onto the cluster")
+    s.add_argument("--host", default="127.0.0.1:10101",
+                   help="host:port, or a full http(s)://host:port URL")
+    s.add_argument("--tls", action="store_true",
+                   help="use https (implied by an https:// --host)")
+    s.add_argument("--tls-skip-verify", action="store_true")
+    s.add_argument("--id", default=None,
+                   help="backup id (default: newest complete backup)")
+    s.add_argument("--force", action="store_true",
+                   help="overwrite live indexes with the same names")
+    s.add_argument("--pitr-ops", type=int, default=None,
+                   help="cap per-fragment WAL replay at this op offset "
+                        "(point-in-time recovery)")
+    s.add_argument("archive", help="archive directory (on the server)")
+    s.set_defaults(fn=cmd_restore)
+
+    s = sub.add_parser("backup-verify",
+                       help="offline archive verification")
+    s.add_argument("--id", default=None,
+                   help="verify one backup id (default: all complete "
+                        "backups in the archive)")
+    s.add_argument("archive")
+    s.set_defaults(fn=cmd_backup_verify)
 
     s = sub.add_parser("inspect", help="data-dir fragment stats")
     s.add_argument("data_dir")

@@ -51,6 +51,12 @@ class DirtyShards:
             out, self._shards = self._shards, set()
             return out
 
+    def peek(self) -> set[tuple]:
+        """Non-destructive view (the backup coordinator consults the set
+        without stealing the scrubber's work)."""
+        with self._lock:
+            return set(self._shards)
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._shards)
@@ -221,6 +227,7 @@ class Scrubber:
                 self.store.snapshot_fragment(key)
                 if self.store.verify_snapshot(key) == "ok":
                     self.store.quarantine.release(key)
+                    self.store.prune_quarantine_evidence(key)
                     return True
             return False
         idx = self.holder.index(index)
@@ -247,6 +254,7 @@ class Scrubber:
         self._log("scrub: repaired %s/%s/%s/%d from %d replica(s)",
                   index, field, view, shard, len(replicas))
         self.store.quarantine.release(key)
+        self.store.prune_quarantine_evidence(key)
         return True
 
     def _merge_with_replicas(self, frag, key: tuple, replicas,

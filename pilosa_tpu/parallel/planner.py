@@ -107,6 +107,14 @@ class MeshPlanner:
         #: caching, not result caching).
         self._plan_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
         self.PLAN_CACHE_SIZE = 128
+        #: structural shapes real traffic compiled for — (index name,
+        #: call text, shard count) -> hit count, recency-ordered. The
+        #: seed list for warmup-from-observed-traffic: ServerNode
+        #: persists it at shutdown and the next boot's WarmupService
+        #: replays it, so restart warmup covers what THIS node's
+        #: traffic actually runs, not just the canonical set.
+        self._observed: "OrderedDict[tuple, int]" = OrderedDict()
+        self.OBSERVED_SIZE = 256
 
     # ------------------------------------------------------------------
     # public API
@@ -171,6 +179,14 @@ class MeshPlanner:
                 self._plan_cache[plan_key] = (leaves, fn)
                 while len(self._plan_cache) > self.PLAN_CACHE_SIZE:
                     self._plan_cache.popitem(last=False)
+                # Record the executable form (with the Count wrapper):
+                # warmup replays these strings through the Executor, and
+                # only a Count() reaches prepare_count again.
+                okey = (idx.name, f"Count({c})", len(shards))
+                self._observed[okey] = self._observed.get(okey, 0) + 1
+                self._observed.move_to_end(okey)
+                while len(self._observed) > self.OBSERVED_SIZE:
+                    self._observed.popitem(last=False)
         arrays = [self._fetch_leaf(idx, leaf, tuple(shards))
                   for leaf in leaves]
         return fn, arrays
@@ -466,6 +482,14 @@ class MeshPlanner:
                 del self._filter_host_cache[key]
             for key in [k for k in self._plan_cache if k[0] == index_name]:
                 del self._plan_cache[key]
+
+    def observed_traffic(self) -> list[dict]:
+        """The structural query shapes this planner compiled for, oldest
+        first — what ServerNode persists to warmup.json at shutdown so
+        the next boot can precompile the programs real traffic hit."""
+        with self._cache_lock:
+            return [{"index": i, "query": q, "shards": s, "count": n}
+                    for (i, q, s), n in self._observed.items()]
 
     def close(self) -> None:
         """Release caches and stop the batcher's resolver thread."""

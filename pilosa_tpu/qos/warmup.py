@@ -75,14 +75,23 @@ class WarmupService:
     """
 
     def __init__(self, planner, kinds=DEFAULT_KINDS,
-                 shard_counts=DEFAULT_SHARD_COUNTS, stats=None):
+                 shard_counts=DEFAULT_SHARD_COUNTS, stats=None,
+                 observed=None, observed_schema=None):
         self.planner = planner
         self.kinds = tuple(k for k in kinds if k in DEFAULT_KINDS)
         self.shard_counts = tuple(sorted({int(s) for s in shard_counts
                                           if int(s) > 0})) or (1,)
         self._stats = stats
+        #: query shapes observed by the previous incarnation's planner
+        #: (warmup.json entries: index/query/shards) replayed after the
+        #: canonical set, over ``observed_schema`` — the persisted
+        #: schema, so field structure (BSI depth, keys) compiles the
+        #: same programs live traffic will hit.
+        self.observed = list(observed or [])
+        self.observed_schema = list(observed_schema or [])
         self.programs_compiled = 0
         self.queries_run = 0
+        self.replayed = 0
         self.errors = 0
         self.seconds = 0.0
         self.done = threading.Event()
@@ -103,6 +112,8 @@ class WarmupService:
             if self._stats is not None:
                 self._stats.count("qos.warmupRuns", 1)
                 self._stats.count("qos.warmupPrograms", self.programs_compiled)
+                if self.replayed:
+                    self._stats.count("qos.warmupReplayed", self.replayed)
                 self._stats.timing("qos.warmupSeconds", self.seconds)
             logger.info(
                 "kernel warmup: %d programs compiled (%d queries, %d errors)"
@@ -148,5 +159,48 @@ class WarmupService:
             drop = getattr(self.planner, "drop_index", None)
             if drop is not None:
                 drop(SCRATCH_INDEX)
+        self._replay_observed()
         self.programs_compiled = \
             len(getattr(self.planner, "_fn_cache", {})) - before
+
+    def _replay_observed(self) -> None:
+        """Replay the previous incarnation's observed traffic shapes
+        (warmup.json) through the planner: same private-Holder trick as
+        the canonical set, but over the persisted schema, so a restarted
+        node precompiles the programs its OWN workload runs."""
+        from pilosa_tpu.exec.executor import Executor
+
+        if not self.observed or self.planner is None:
+            return
+        replay = Holder()
+        try:
+            replay.apply_schema(self.observed_schema)
+        except Exception:
+            logger.exception("warmup replay: persisted schema unusable")
+            return
+        ex = Executor(replay, planner=self.planner, result_cache=False)
+        names = set()
+        try:
+            for entry in self.observed:
+                try:
+                    iname = entry["index"]
+                    query = entry["query"]
+                    n = max(1, int(entry.get("shards", 1)))
+                except (KeyError, TypeError, ValueError):
+                    continue
+                if replay.index(iname) is None:
+                    continue
+                names.add(iname)
+                try:
+                    ex.execute(iname, query, shards=list(range(n)))
+                    self.queries_run += 1
+                    self.replayed += 1
+                except Exception:
+                    self.errors += 1
+                    logger.exception("warmup replay failed: %s (%s, "
+                                     "shards=%d)", query, iname, n)
+        finally:
+            drop = getattr(self.planner, "drop_index", None)
+            if drop is not None:
+                for iname in names:
+                    drop(iname)

@@ -412,6 +412,35 @@ class HTTPInternalClient:
         resp = self._request(node, "GET", "/schema")
         return resp["indexes"]
 
+    def backup_keys(self, node) -> list:
+        """Fragment keys a peer holds durable files for (backup
+        coordinator enumeration)."""
+        resp = self._request(node, "GET", "/internal/backup/keys")
+        return resp.get("keys", [])
+
+    def backup_fragment(self, node, index, field, view, shard) -> dict:
+        """One fragment's verified (snap, wal) pair from a peer. A 503
+        means that copy is quarantined — surface the typed error so the
+        coordinator fails over to a replica."""
+        import base64
+        q = urllib.parse.urlencode({"index": index, "field": field,
+                                    "view": view, "shard": shard})
+        try:
+            resp = self._request(node, "GET",
+                                 f"/internal/backup/fragment?{q}")
+        except NodeHTTPError as e:
+            if e.code == 503 and "quarantined" in str(e):
+                from pilosa_tpu.storage.quarantine import ShardCorruptError
+                raise ShardCorruptError() from e
+            raise
+        return {
+            "snap": (base64.b64decode(resp["snap"])
+                     if resp.get("snap") else None),
+            "wal": (base64.b64decode(resp["wal"])
+                    if resp.get("wal") else None),
+            "ops": int(resp.get("ops") or 0),
+        }
+
     def attr_blocks(self, node, index, field):
         path = f"/internal/attr/blocks?index={index}"
         if field:

@@ -619,6 +619,76 @@ def _build_routes(api: API):
         api.delete_view(pv["index"], pv["field"], pv["view"])
         return 200, {}
 
+    # backup / restore (operator surface + internal capture RPC)
+    def post_backup(pv, params, body):
+        handler = getattr(api, "backup_handler", None)
+        if handler is None:
+            return 400, {"error": "backup not configured on this node "
+                                  "(no data dir)"}
+        req = jbody(body)
+        if params.get("archive"):
+            req.setdefault("archive", params["archive"])
+        if params.get("parent"):
+            req.setdefault("parent", params["parent"])
+        return 200, handler(req)
+
+    def get_backup_status(pv, params, body):
+        handler = getattr(api, "backup_status_handler", None)
+        if handler is None:
+            return 200, {"state": "idle"}
+        return 200, handler()
+
+    def post_restore(pv, params, body):
+        handler = getattr(api, "restore_handler", None)
+        if handler is None:
+            return 400, {"error": "restore not configured on this node "
+                                  "(no data dir)"}
+        req = jbody(body)
+        if params.get("archive"):
+            req.setdefault("archive", params["archive"])
+        if params.get("id"):
+            req.setdefault("id", params["id"])
+        if params.get("force") in ("1", "true"):
+            req.setdefault("force", True)
+        return 200, handler(req)
+
+    def get_restore_status(pv, params, body):
+        handler = getattr(api, "restore_status_handler", None)
+        if handler is None:
+            return 200, {"state": "idle"}
+        return 200, handler()
+
+    def get_backup_keys(pv, params, body):
+        """Fragment keys this node holds durable files for (backup
+        coordinator enumeration over HTTP)."""
+        store = getattr(api, "store", None)
+        if store is None:
+            return 200, {"keys": []}
+        return 200, {"keys": [list(k) for k in store.all_fragment_keys()]}
+
+    def get_backup_fragment(pv, params, body):
+        """One fragment's verified (snap, wal) pair, base64-wrapped in
+        JSON. ShardCorruptError propagates to the dispatch ladder's 503
+        so the coordinator fails over to a replica."""
+        store = getattr(api, "store", None)
+        if store is None:
+            raise FragmentNotFoundError()
+        from pilosa_tpu.backup.writer import capture_fragment
+        key = (params["index"], params["field"], params["view"],
+               int(params["shard"]))
+        try:
+            pair = capture_fragment(store, key)
+        except LookupError:
+            raise FragmentNotFoundError()
+        import base64
+        return 200, {
+            "snap": (base64.b64encode(pair["snap"]).decode()
+                     if pair["snap"] is not None else None),
+            "wal": (base64.b64encode(pair["wal"]).decode()
+                    if pair["wal"] is not None else None),
+            "ops": pair["ops"],
+        }
+
     def get_fragment_nodes(pv, params, body):
         index = params.get("index")
         shard = params.get("shard")
@@ -662,6 +732,12 @@ def _build_routes(api: API):
         (r"/debug/profile", {"GET": get_debug_profile}),
         (r"/debug/heap", {"GET": get_debug_heap}),
         (r"/recalculate-caches", {"POST": post_recalculate}),
+        (r"/backup", {"POST": post_backup}),
+        (r"/backup/status", {"GET": get_backup_status}),
+        (r"/restore", {"POST": post_restore}),
+        (r"/restore/status", {"GET": get_restore_status}),
+        (r"/internal/backup/keys", {"GET": get_backup_keys}),
+        (r"/internal/backup/fragment", {"GET": get_backup_fragment}),
         (r"/internal/shards/max", {"GET": get_shards_max}),
         (r"/internal/availability", {"GET": get_availability}),
         (r"/internal/translate/keys", {"POST": post_translate_keys}),

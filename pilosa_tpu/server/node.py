@@ -67,7 +67,8 @@ class ServerNode:
                  qos_default_deadline: float = 0.0,
                  qos_slow_query_ms: float = 500.0,
                  qos_warmup: str = "",
-                 qos_warmup_shards: str = "1,8,32"):
+                 qos_warmup_shards: str = "1,8,32",
+                 quarantine_keep_n: int = 0):
         host, _, port = bind.partition(":")
         self.host, self.port = host or "127.0.0.1", int(port or 10101)
         # Node identity IS the address — member ids are built the same
@@ -214,6 +215,7 @@ class ServerNode:
             from pilosa_tpu.storage.diskstore import DiskStore
             kw = {} if max_op_n is None else {"max_op_n": max_op_n}
             self.store = DiskStore(data_dir, self.holder, stats=self.stats,
+                                   quarantine_keep_n=quarantine_keep_n,
                                    **kw)
             self.store.open()
         else:
@@ -237,6 +239,18 @@ class ServerNode:
                 self.holder, self.cluster,
                 self.cluster.client if self.cluster is not None else None,
                 self.store, stats=self.stats, admission=self.qos)
+        # Backup/restore driver hooks (POST /backup, /restore). One run
+        # of each at a time; jobs run off the request thread and
+        # /backup/status, /restore/status read their live progress.
+        self._backup_gate = threading.Lock()
+        self._restore_gate = threading.Lock()
+        self._backup_writer = None
+        self._restore_job = None
+        if self.store is not None:
+            self.api.backup_handler = self.handle_backup
+            self.api.backup_status_handler = self.backup_status
+            self.api.restore_handler = self.handle_restore
+            self.api.restore_status_handler = self.restore_status
 
     def _wire_topology_persistence(self, data_dir: str) -> None:
         """Durable topology (reference .topology file, cluster.go:1657):
@@ -360,8 +374,11 @@ class ServerNode:
             shard_counts = [int(s) for s in
                             str(self._qos_warmup_shards).split(",")
                             if s.strip()]
+            observed, observed_schema = self._load_observed_traffic()
             self.warmup = WarmupService(self.executor.planner, kinds=kinds,
                                         shard_counts=shard_counts,
+                                        observed=observed,
+                                        observed_schema=observed_schema,
                                         stats=self.stats)
             self.warmup.start()
 
@@ -586,6 +603,7 @@ class ServerNode:
         if getattr(self, "runtime_monitor", None) is not None:
             self.runtime_monitor.close()
         if self.executor.planner is not None:
+            self._save_observed_traffic()
             self.executor.planner.close()
         if self.store is not None:
             self.store.close()
@@ -844,3 +862,143 @@ class ServerNode:
             f.import_bits(req["rowIDs"], req["columnIDs"], ts,
                           clear=req.get("clear", False))
             self.holder.index(index).add_existence(req["columnIDs"])
+
+    # -- backup / restore --------------------------------------------------
+
+    def handle_backup(self, req: dict) -> dict:
+        """POST /backup: start a cluster backup into the archive
+        directory named in the request; returns the backup id
+        immediately (poll /backup/status for completion)."""
+        from pilosa_tpu.backup import (
+            BackupError,
+            BackupWriter,
+            LocalDirArchive,
+            new_backup_id,
+        )
+        req = req or {}
+        root = req.get("archive")
+        if not root:
+            raise BackupError(
+                "backup: 'archive' (directory path) is required")
+        parent = req.get("parent") or None
+        archive = LocalDirArchive(root)
+        if parent and not archive.has_manifest(parent):
+            raise BackupError(
+                f"backup: parent {parent!r} not found in archive")
+        if not self._backup_gate.acquire(blocking=False):
+            raise BackupError("backup already in progress")
+        backup_id = new_backup_id("incremental" if parent else "full")
+        writer = BackupWriter(
+            self.holder, self.cluster,
+            self.cluster.client if self.cluster is not None else None,
+            self.store, archive, stats=self.stats, admission=self.qos)
+        writer.progress = {"state": "starting", "id": backup_id}
+        self._backup_writer = writer
+
+        def run():
+            try:
+                writer.run(backup_id=backup_id, parent=parent)
+            except Exception:
+                pass  # progress carries state=failed + the error text
+            finally:
+                self._backup_gate.release()
+
+        threading.Thread(target=run, name="backup", daemon=True).start()
+        return {"id": backup_id, "state": "started"}
+
+    def backup_status(self) -> dict:
+        w = self._backup_writer
+        return dict(w.progress) if w is not None else {"state": "idle"}
+
+    def handle_restore(self, req: dict) -> dict:
+        """POST /restore: rebuild the backed-up indexes onto THIS
+        cluster (any size) from the archive; returns immediately (poll
+        /restore/status). ``id`` defaults to the newest complete backup;
+        ``pitrOps`` caps WAL replay for point-in-time recovery."""
+        import time as _time
+
+        from pilosa_tpu.backup import (
+            BackupError,
+            LocalDirArchive,
+            RestoreJob,
+            select_backup_at,
+        )
+        req = req or {}
+        root = req.get("archive")
+        if not root:
+            raise BackupError(
+                "restore: 'archive' (directory path) is required")
+        archive = LocalDirArchive(root)
+        backup_id = req.get("id")
+        if not backup_id:
+            m = select_backup_at(archive, _time.time())
+            if m is None:
+                raise BackupError(
+                    "restore: no complete backup in archive")
+            backup_id = m["id"]
+        elif not archive.has_manifest(backup_id):
+            raise BackupError(
+                f"restore: backup {backup_id!r} not found in archive")
+        pitr = req.get("pitrOps")
+        if not self._restore_gate.acquire(blocking=False):
+            raise BackupError("restore already in progress")
+        job = RestoreJob(
+            self.holder, self.cluster,
+            self.cluster.client if self.cluster is not None else None,
+            archive, backup_id, store=self.store, stats=self.stats,
+            force=bool(req.get("force")),
+            pitr_ops=int(pitr) if pitr is not None else None)
+        job.progress = {"state": "starting", "id": backup_id}
+        self._restore_job = job
+
+        def run():
+            try:
+                job.run()
+            except Exception:
+                pass  # progress carries state=failed + the error text
+            finally:
+                self._restore_gate.release()
+
+        threading.Thread(target=run, name="restore", daemon=True).start()
+        return {"id": backup_id, "state": "started"}
+
+    def restore_status(self) -> dict:
+        j = self._restore_job
+        return dict(j.progress) if j is not None else {"state": "idle"}
+
+    # -- warmup-from-observed-traffic --------------------------------------
+
+    def _save_observed_traffic(self) -> None:
+        """Persist the planner's observed structural query shapes (plus
+        the schema they compile against) so the next boot's warmup
+        precompiles what THIS node's traffic actually ran."""
+        import json as _json
+        import os as _os
+        planner = self.executor.planner
+        if not self.data_dir or planner is None:
+            return
+        observed = getattr(planner, "observed_traffic", lambda: [])()
+        if not observed:
+            return
+        path = _os.path.join(self.data_dir, "warmup.json")
+        try:
+            tmp = f"{path}.{_os.getpid()}.tmp"
+            with open(tmp, "w") as f:
+                _json.dump({"version": 1, "entries": observed,
+                            "schema": self.holder.schema()}, f)
+            _os.replace(tmp, path)
+        except OSError:
+            pass  # warmup hints are best-effort; never block shutdown
+
+    def _load_observed_traffic(self) -> tuple[list, list]:
+        import json as _json
+        import os as _os
+        if not self.data_dir:
+            return [], []
+        try:
+            with open(_os.path.join(self.data_dir, "warmup.json")) as f:
+                doc = _json.load(f)
+            return (list(doc.get("entries", [])),
+                    list(doc.get("schema", [])))
+        except (OSError, ValueError):
+            return [], []

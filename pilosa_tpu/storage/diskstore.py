@@ -86,10 +86,15 @@ class DiskStore:
 
     def __init__(self, data_dir: str, holder: Holder,
                  max_op_n: int = MAX_OP_N, snapshot_workers: int = 2,
-                 fsync_appends: bool = False, stats=None, logger=None):
+                 fsync_appends: bool = False, stats=None, logger=None,
+                 quarantine_keep_n: int = 0):
         self.data_dir = data_dir
         self.holder = holder
         self.max_op_n = max_op_n
+        #: cap on accumulated ``*.quarantine`` evidence files per
+        #: fragment, pruned oldest-first after a successful scrub repair;
+        #: 0 keeps everything (the historical behaviour).
+        self.quarantine_keep_n = quarantine_keep_n
         #: fsync every WAL record (strict durability; default matches the
         #: reference's buffered op-log writes).
         self.fsync_appends = fsync_appends
@@ -524,6 +529,42 @@ class DiskStore:
                 for vname, v in f.views.items():
                     for shard in v.fragments:
                         yield (iname, fname, vname, shard)
+
+    def all_fragment_keys(self) -> list[tuple]:
+        """Every (index, field, view, shard) this node holds — the
+        public enumeration the backup coordinator walks."""
+        return sorted(self._all_keys())
+
+    def prune_quarantine_evidence(self, key: tuple) -> int:
+        """Enforce ``quarantine_keep_n`` on one fragment's accumulated
+        ``*.quarantine`` evidence files, oldest (by mtime) first. Called
+        after a successful scrub repair — while an entry is still open
+        the evidence is live forensics and is never touched. Returns the
+        number of files removed; 0 when unlimited (keep_n == 0)."""
+        if self.quarantine_keep_n <= 0:
+            return 0
+        import glob
+        files = []
+        for base in (self._snap_path(key), self._wal_path(key)):
+            files.extend(glob.glob(glob.escape(base) + ".quarantine*"))
+        excess = len(files) - self.quarantine_keep_n
+        if excess <= 0:
+            return 0
+        files.sort(key=lambda p: (os.path.getmtime(p), p))
+        pruned = 0
+        for path in files[:excess]:
+            try:
+                os.remove(path)
+                pruned += 1
+            except OSError:
+                continue
+        if pruned:
+            self.stats.count("integrity.evidencePruned", pruned)
+            self.logger.printf(
+                "integrity: pruned %d quarantine evidence file(s) for "
+                "%s (keep-n=%d)", pruned,
+                "/".join(str(p) for p in key), self.quarantine_keep_n)
+        return pruned
 
     # -- flush / close -----------------------------------------------------
 

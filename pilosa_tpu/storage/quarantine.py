@@ -60,8 +60,17 @@ class QuarantineRegistry:
     def quarantine_file(self, key: tuple, path: str, reason: str,
                         state: str = STATE_UNAVAILABLE) -> str | None:
         """Rename ``path`` aside (never delete) and register ``key``.
-        Returns the quarantined path, or None when the rename failed."""
+        Returns the quarantined path, or None when the rename failed.
+
+        Repeat quarantines of the same file take numbered suffixes
+        (``.quarantine.1``, ``.quarantine.2`` …) so later evidence never
+        clobbers earlier evidence; the store's ``--quarantine-keep-n``
+        pruner is what bounds the accumulation."""
         qpath = path + ".quarantine"
+        n = 0
+        while os.path.exists(qpath):
+            n += 1
+            qpath = f"{path}.quarantine.{n}"
         try:
             os.replace(path, qpath)
         except OSError:

@@ -147,6 +147,27 @@ def scan_wal(path: str) -> dict:
             "torn": torn, "corrupt": corrupt}
 
 
+def iter_wal_records(data: bytes):
+    """Yield ``(code, rows, cols)`` from raw WAL bytes, stopping cleanly
+    at a torn tail — the shared decode loop behind WalReader and the
+    backup subsystem's archived-segment replay (restore/PITR run it over
+    bytes fetched from an archive, where no file path exists)."""
+    off = 0
+    while off + _HEADER.size <= len(data):
+        magic, code, n_rows, n_cols, crc = _HEADER.unpack_from(data, off)
+        body_len = 8 * (n_rows + n_cols)
+        end = off + _HEADER.size + body_len
+        if magic != _MAGIC or end > len(data):
+            break  # torn tail
+        payload = data[off + _HEADER.size: end]
+        if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+            break
+        rows = np.frombuffer(payload[: 8 * n_rows], dtype=np.uint64)
+        cols = np.frombuffer(payload[8 * n_rows:], dtype=np.uint64)
+        yield code, rows, cols
+        off = end
+
+
 class WalReader:
     """Replays records; stops cleanly at a torn tail."""
 
@@ -158,17 +179,4 @@ class WalReader:
             return
         with open(self.path, "rb") as f:
             data = f.read()
-        off = 0
-        while off + _HEADER.size <= len(data):
-            magic, code, n_rows, n_cols, crc = _HEADER.unpack_from(data, off)
-            body_len = 8 * (n_rows + n_cols)
-            end = off + _HEADER.size + body_len
-            if magic != _MAGIC or end > len(data):
-                break  # torn tail
-            payload = data[off + _HEADER.size: end]
-            if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
-                break
-            rows = np.frombuffer(payload[: 8 * n_rows], dtype=np.uint64)
-            cols = np.frombuffer(payload[8 * n_rows:], dtype=np.uint64)
-            yield code, rows, cols
-            off = end
+        yield from iter_wal_records(data)

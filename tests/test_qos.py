@@ -8,6 +8,7 @@ must 504 without launching any work.
 """
 
 import json
+import os
 import threading
 import time
 import urllib.error
@@ -518,6 +519,96 @@ def test_planner_drop_index(jax_cpu):
     # surviving index still queries fine
     (got,) = ex.execute("b", "Count(Row(f=1))", shards=[0], cache=False)
     assert got == 1
+
+
+def test_planner_records_observed_traffic(jax_cpu):
+    """Plan-cache misses record the executable query shape (index,
+    Count(...) text, shard count) for warmup-from-observed-traffic."""
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.exec import Executor
+    from pilosa_tpu.parallel import MeshPlanner
+
+    h = Holder()
+    idx = h.create_index("i")
+    idx.create_field("f").set_bit(1, 5)
+    idx.create_field("g").set_bit(1, 5)
+    planner = MeshPlanner(h)
+    ex = Executor(h, planner=planner)
+    ex.execute("i", "Count(Row(f=1))", shards=[0, 1])
+    ex.execute("i", "Count(Intersect(Row(f=1), Row(g=1)))", shards=[0, 1])
+    got = {(e["index"], e["query"], e["shards"])
+           for e in planner.observed_traffic()}
+    assert ("i", "Count(Row(f=1))", 2) in got
+    assert ("i", "Count(Intersect(Row(f=1), Row(g=1)))", 2) in got
+    # a plan-cache HIT must not grow the list (same shape, same epoch)
+    before = len(planner.observed_traffic())
+    ex.execute("i", "Count(Row(f=1))", shards=[0, 1], cache=False)
+    assert len(planner.observed_traffic()) == before
+
+
+def test_warmup_replays_observed_traffic(jax_cpu):
+    """A restarted node's warmup replays the previous incarnation's
+    recorded shapes over the persisted schema, so real traffic finds
+    its exact program warm — and the replay's scratch index leaves
+    nothing behind in the planner's data caches."""
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.exec import Executor
+    from pilosa_tpu.parallel import MeshPlanner
+
+    # "previous incarnation": run traffic, capture observed + schema
+    h1 = Holder()
+    idx = h1.create_index("real")
+    idx.create_field("f").set_bit(1, 5)
+    idx.create_field("g").set_bit(1, 5)
+    p1 = MeshPlanner(h1)
+    Executor(h1, planner=p1).execute(
+        "real", "Count(Intersect(Row(f=1), Row(g=1)))", shards=[0, 1])
+    observed = p1.observed_traffic()
+    schema = h1.schema()
+    p1.close()
+
+    # "restarted node": fresh planner, warmup fed the persisted hints
+    h2 = Holder()
+    p2 = MeshPlanner(h2)
+    w = WarmupService(p2, kinds=(), shard_counts=(), observed=observed,
+                      observed_schema=schema)
+    out = w.run()
+    assert out["errors"] == 0, out
+    assert w.replayed >= 1
+    assert p2.cache_stats()["entries"] == 0  # scratch data dropped
+    warmed = len(p2._fn_cache)
+    assert warmed > 0
+
+    idx2 = h2.create_index("real")
+    idx2.create_field("f").set_bit(1, 5)
+    idx2.create_field("g").set_bit(1, 5)
+    ex = Executor(h2, planner=p2)
+    (got,) = ex.execute("real", "Count(Intersect(Row(f=1), Row(g=1)))",
+                        shards=[0, 1])
+    assert got == 1
+    # load-bearing: the real query's program was already compiled
+    assert len(p2._fn_cache) == warmed
+
+
+def test_node_persists_and_reloads_observed_traffic(tmp_path, jax_cpu):
+    """ServerNode writes warmup.json on close (entries + schema) and
+    _load_observed_traffic round-trips it at the next boot."""
+    d = str(tmp_path / "n0")
+    n = ServerNode(bind="127.0.0.1:0", data_dir=d)
+    n.open()
+    try:
+        idx = n.holder.create_index("i")
+        idx.create_field("f").set_bit(1, 5)
+        n.executor.execute("i", "Count(Row(f=1))", shards=[0])
+    finally:
+        n.close()
+    assert os.path.exists(os.path.join(d, "warmup.json"))
+
+    n2 = ServerNode(bind="127.0.0.1:0", data_dir=d)
+    entries, schema = n2._load_observed_traffic()
+    assert any(e["index"] == "i" and e["query"] == "Count(Row(f=1))"
+               for e in entries)
+    assert any(s.get("name") == "i" for s in schema)
 
 
 # ---------------------------------------------------------------------------
