@@ -17,6 +17,11 @@ Configs (BASELINE.json):
   4. time-quantum   Row(f, from, to) + Count over YMDH views.
   5. cluster        4-node in-process cluster (PQL-serialized node
                     boundary): GroupBy + Count over a sharded index.
+  8. overload       3-node replicated cluster at 4x admission
+                    oversubscription with one slow (gray) peer: admitted
+                    p50/p99, shed rate, hedge fire/win rate, and breaker
+                    transitions — the overload-resilience layer under
+                    its design load.
 
 CPU baseline: the reference publishes no absolute numbers and this image
 has no Go toolchain, so the baseline is measured here as the strongest
@@ -39,6 +44,7 @@ import os
 import math
 import statistics
 import sys
+import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 
@@ -964,6 +970,108 @@ def bench_backup(extra):
 
 
 # ---------------------------------------------------------------------------
+# config 8: overload resilience — 4x oversubscription with a slow peer
+# ---------------------------------------------------------------------------
+
+
+def bench_overload(extra):
+    """The acceptance scenario for the overload-resilience layer: a
+    3-node replica_n=2 cluster where node1 serves every query leg
+    slower than the request deadline (a gray failure), driven by 4x
+    more client threads than the admission gate admits. Interactive
+    latency must stay bounded (excess load is SHED, not queued), hedged
+    reads must absorb the slow peer (zero client-visible failures), and
+    its circuit breaker must open."""
+    from pilosa_tpu.cluster.breaker import BreakerRegistry, HedgePolicy
+    from pilosa_tpu.cluster.harness import LocalCluster
+    from pilosa_tpu.config import SHARD_WIDTH
+    from pilosa_tpu.qos import (AdaptiveLimit, AdmissionController, Deadline,
+                                DeadlineExceededError, QueryShedError,
+                                reset_current_deadline, set_current_deadline)
+
+    n_shards = 8
+    lc = LocalCluster(3, replica_n=2)
+    reg = BreakerRegistry(threshold=3, cooldown=1.0)
+    lc.client.breakers = reg
+    for cn in lc.nodes:
+        cn.cluster.hedge = HedgePolicy(delay_s=0.05, burst=32)
+    lc.create_index("ov")
+    lc.create_field("ov", "f")
+    for s in range(n_shards):
+        lc.query("ov", f"Set({s * SHARD_WIDTH + 5}, f=1)")
+    (oracle,) = lc.query("ov", "Count(Row(f=1))", cache=False)
+    lc.query("ov", "Count(Row(f=1))", cache=False)  # warm compiles
+
+    # node1 is slower than the deadline on every query leg — the
+    # breaker (not the failure detector) must take it out of the path.
+    lc.slow("node1", 0.6)
+    adaptive = AdaptiveLimit(ceiling=4)
+    ctl = AdmissionController(max_concurrent=4, max_queue=8,
+                              adaptive=adaptive)
+    sheds = misses = failures = 0
+    lat = []
+    lock = threading.Lock()
+
+    def one_query():
+        nonlocal sheds, misses, failures
+        tok = set_current_deadline(Deadline(timeout=0.5))
+        t0 = time.perf_counter()
+        try:
+            with ctl.admit("interactive"):
+                (got,) = lc.query("ov", "Count(Row(f=1))", cache=False)
+            dt = time.perf_counter() - t0
+            with lock:
+                assert got == oracle, (got, oracle)
+                lat.append(dt)
+        except QueryShedError:
+            with lock:
+                sheds += 1
+        except DeadlineExceededError:
+            with lock:
+                misses += 1
+        except Exception:
+            with lock:
+                failures += 1
+        finally:
+            reset_current_deadline(tok)
+
+    n_ops = 128  # 16 threads = 4x the gate's max_concurrent
+    with ThreadPoolExecutor(max_workers=16) as pool:
+        list(pool.map(lambda _: one_query(), range(n_ops)))
+    # Abandoned slow legs surface their ConnectionError (and feed the
+    # breaker) only after burning their remaining deadline — let the
+    # in-flight ones settle before reading the counters.
+    time.sleep(0.8)
+    lc.fast("node1")
+
+    hs = lc.nodes[0].cluster.hedge.snapshot()
+    opens = sum(p["opens"] for p in reg.snapshot()["peers"].values())
+    extra["overload_ops"] = n_ops
+    extra["overload_admitted"] = len(lat)
+    extra["overload_shed"] = sheds
+    extra["overload_shed_rate"] = round(sheds / n_ops, 3)
+    extra["overload_deadline_misses"] = misses
+    extra["overload_failures"] = failures
+    if lat:
+        extra["overload_admitted_p50_ms"] = round(
+            statistics.median(lat) * 1e3, 3)
+        extra["overload_admitted_p99_ms"] = round(_p99(lat), 3)
+    extra["overload_hedge_fired"] = hs["fired"]
+    extra["overload_hedge_won"] = hs["won"]
+    if hs["fired"]:
+        extra["overload_hedge_win_rate"] = round(hs["won"] / hs["fired"], 3)
+    extra["overload_breaker_opens"] = opens
+    extra["overload_adaptive_limit_final"] = adaptive.limit
+    for cn in lc.nodes:
+        cn.cluster.close()
+    # The layer's contract, enforced: the slow peer never surfaces as a
+    # client-visible failure, and its breaker actually opened.
+    assert failures == 0, f"{failures} queries failed via the slow peer"
+    assert opens >= 1, "slow peer's breaker never opened"
+    assert hs["fired"] >= 1, "hedge never fired against the slow peer"
+
+
+# ---------------------------------------------------------------------------
 
 
 def main() -> None:
@@ -972,7 +1080,7 @@ def main() -> None:
     want = (set(c.strip() for c in CONFIGS.split(","))
             if CONFIGS != "all"
             else {"star", "topn", "bsi", "time", "cluster", "oversub",
-                  "backup"})
+                  "backup", "overload"})
     extra: dict = {"backend": jax.default_backend(),
                    "devices": len(jax.devices())}
 
@@ -1006,7 +1114,8 @@ def main() -> None:
     for name, fn in (("topn", bench_topn), ("bsi", bench_bsi),
                      ("time", bench_time), ("cluster", bench_cluster),
                      ("oversub", bench_oversubscribed),
-                     ("backup", bench_backup)):
+                     ("backup", bench_backup),
+                     ("overload", bench_overload)):
         if name in want:
             t0 = time.perf_counter()
             try:

@@ -66,6 +66,26 @@ _DEFAULTS = {
     # bucket, so steady traffic never pays the cold XLA compile.
     "qos_warmup": "count,topn,bsi",
     "qos_warmup_shards": "1,8,32",
+    # Overload resilience. Adaptive concurrency: qos_max_concurrent is
+    # the CEILING; the operative limit is measured (AIMD over admitted
+    # queue-wait/latency). Per-tenant token buckets (req/s per API key
+    # or index; 0 disables; rejections are 429 + Retry-After, distinct
+    # from the gate's 503 shed).
+    "qos_adaptive": True,
+    "qos_tenant_rate": 0.0,
+    "qos_tenant_burst": 0.0,
+    # Per-peer circuit breakers on the inter-node client: this many
+    # consecutive connection failures / deadline overruns open the
+    # breaker (0 disables); after the cooldown one half-open probe
+    # re-closes it.
+    "breaker_threshold": 5,
+    "breaker_cooldown": 5.0,
+    # Hedged reads on replicated legs: a backup request to the next
+    # replica after hedge_delay_ms (0 = measured p95), first success
+    # wins, bounded to ~hedge_budget_pct% of primary legs.
+    "hedge": True,
+    "hedge_delay_ms": 0.0,
+    "hedge_budget_pct": 5.0,
 }
 
 
@@ -131,6 +151,22 @@ def cmd_server(args) -> int:
         cfg["max_op_n"] = args.max_op_n
     if args.quarantine_keep_n is not None:
         cfg["quarantine_keep_n"] = args.quarantine_keep_n
+    if args.qos_adaptive is not None:
+        cfg["qos_adaptive"] = args.qos_adaptive == "on"
+    if args.qos_tenant_rate is not None:
+        cfg["qos_tenant_rate"] = args.qos_tenant_rate
+    if args.qos_tenant_burst is not None:
+        cfg["qos_tenant_burst"] = args.qos_tenant_burst
+    if args.breaker_threshold is not None:
+        cfg["breaker_threshold"] = args.breaker_threshold
+    if args.breaker_cooldown is not None:
+        cfg["breaker_cooldown"] = args.breaker_cooldown
+    if args.hedge is not None:
+        cfg["hedge"] = args.hedge == "on"
+    if args.hedge_delay_ms is not None:
+        cfg["hedge_delay_ms"] = args.hedge_delay_ms
+    if args.hedge_budget_pct is not None:
+        cfg["hedge_budget_pct"] = args.hedge_budget_pct
 
     from pilosa_tpu.server.node import ServerNode
     node = ServerNode(
@@ -160,6 +196,14 @@ def cmd_server(args) -> int:
         qos_warmup=str(cfg["qos_warmup"]),
         qos_warmup_shards=str(cfg["qos_warmup_shards"]),
         quarantine_keep_n=int(cfg["quarantine_keep_n"]),
+        qos_adaptive=bool(cfg["qos_adaptive"]),
+        qos_tenant_rate=float(cfg["qos_tenant_rate"]),
+        qos_tenant_burst=float(cfg["qos_tenant_burst"]),
+        breaker_threshold=int(cfg["breaker_threshold"]),
+        breaker_cooldown=float(cfg["breaker_cooldown"]),
+        hedge=bool(cfg["hedge"]),
+        hedge_delay_ms=float(cfg["hedge_delay_ms"]),
+        hedge_budget_pct=float(cfg["hedge_budget_pct"]),
     )
     node.open()  # starts the (single) serve loop in the background
     print(f"pilosa-tpu serving at {node.address}", file=sys.stderr)
@@ -555,7 +599,22 @@ def cmd_generate_config(args) -> int:
           'qos-slow-query-ms = 500.0\n'
           '# kernel warmup at boot ("" disables)\n'
           'qos-warmup = "count,topn,bsi"\n'
-          'qos-warmup-shards = "1,8,32"')
+          'qos-warmup-shards = "1,8,32"\n'
+          '# adaptive concurrency: qos-max-concurrent is the ceiling,\n'
+          '# the operative limit is measured (AIMD)\n'
+          'qos-adaptive = true\n'
+          '# per-tenant token bucket, requests/s per API key or index\n'
+          '# (0 disables; rejections are 429 + Retry-After)\n'
+          'qos-tenant-rate = 0.0\n'
+          'qos-tenant-burst = 0.0\n'
+          '# per-peer circuit breaker: consecutive failures to open\n'
+          '# (0 disables), cooldown before the half-open probe\n'
+          'breaker-threshold = 5\n'
+          'breaker-cooldown = 5.0\n'
+          '# hedged reads on replicated legs (delay 0 = measured p95)\n'
+          'hedge = true\n'
+          'hedge-delay-ms = 0.0\n'
+          'hedge-budget-pct = 5.0')
     return 0
 
 
@@ -596,6 +655,26 @@ def main(argv: list[str] | None = None) -> int:
                    help="preserved *.quarantine evidence files per "
                         "fragment; oldest pruned after a successful "
                         "repair (0 keeps all)")
+    s.add_argument("--qos-adaptive", choices=("on", "off"), default=None,
+                   help="measured concurrency limit under the "
+                        "qos-max-concurrent ceiling (default on)")
+    s.add_argument("--qos-tenant-rate", type=float, default=None,
+                   help="per-tenant request rate, req/s per API key or "
+                        "index (0 disables; rejections are 429)")
+    s.add_argument("--qos-tenant-burst", type=float, default=None,
+                   help="per-tenant burst size (0 = 2x rate)")
+    s.add_argument("--breaker-threshold", type=int, default=None,
+                   help="consecutive peer failures that open its "
+                        "circuit breaker (0 disables)")
+    s.add_argument("--breaker-cooldown", type=float, default=None,
+                   help="seconds an open breaker waits before its "
+                        "half-open probe")
+    s.add_argument("--hedge", choices=("on", "off"), default=None,
+                   help="hedged reads on replicated legs (default on)")
+    s.add_argument("--hedge-delay-ms", type=float, default=None,
+                   help="fixed hedge delay, ms (0 = measured p95)")
+    s.add_argument("--hedge-budget-pct", type=float, default=None,
+                   help="hedges as a %% of primary legs (default 5)")
     s.add_argument("--trace-endpoint", default="",
                    help="OTLP/HTTP collector URL for trace export")
     s.add_argument("--config", default=None)

@@ -93,6 +93,11 @@ class LocalClient:
         #: node ids currently "down" (fault injection — the pumba pause
         #: analog, internal/clustertests/cluster_test.go:69).
         self.down: set[str] = set()
+        #: node id -> injected per-query latency in seconds (the
+        #: slow-peer / gray-failure fault: alive, just sick).
+        self.slow: dict[str, float] = {}
+        #: optional BreakerRegistry, same contract as the HTTP client's.
+        self.breakers = None
 
     def register(self, node_id: str, server: Any) -> None:
         self.peers[node_id] = server
@@ -106,6 +111,21 @@ class LocalClient:
         return peer
 
     def query_node(self, node, index, query, shards, remote=True):
+        if self.breakers is not None:
+            self.breakers.check(node.id)
+        try:
+            result = self._query_node(node, index, query, shards, remote)
+        except ConnectionError:
+            # Down peer or (below) a slow peer that blew the deadline:
+            # both feed the breaker, mirroring the HTTP client.
+            if self.breakers is not None:
+                self.breakers.record_failure(node.id)
+            raise
+        if self.breakers is not None:
+            self.breakers.record_success(node.id)
+        return result
+
+    def _query_node(self, node, index, query, shards, remote=True):
         peer = self._peer(node)
         # Cross the serialization boundary the way the HTTP transport
         # does (X-Deadline, server/httpclient.py): don't dispatch an
@@ -114,6 +134,20 @@ class LocalClient:
         # doesn't travel over the wire either).
         from pilosa_tpu.qos import deadline as qos_deadline
         dl = qos_deadline.current_deadline()
+        delay = self.slow.get(node.id, 0.0)
+        if delay > 0.0:
+            # The sick-peer fault: the request "takes" this long. With
+            # a deadline in force this turns into the same timeout the
+            # HTTP transport surfaces (ConnectionError), exercising the
+            # breaker/hedge path; without one it's just slow.
+            import time as _time
+            if dl is not None:
+                rem = dl.remaining()
+                if rem is not None and rem <= delay:
+                    _time.sleep(max(0.0, rem))
+                    raise ConnectionError(
+                        f"node {node.id} timed out (slow-peer fault)")
+            _time.sleep(delay)
         if dl is None:
             return peer.handle_query(index, query, shards, remote)
         dl.check()

@@ -9,7 +9,10 @@ Reference: cluster.go (struct :186, state machine :47-50, partitionNodes
 from __future__ import annotations
 
 import threading
-from concurrent.futures import ThreadPoolExecutor
+import time
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FuturesTimeoutError
+from concurrent.futures import wait as futures_wait
 from typing import Any, Callable
 
 from pilosa_tpu.config import DEFAULT_PARTITION_N
@@ -85,6 +88,12 @@ class Cluster:
         #: CONCURRENT cluster queries overlap all their remote hops.
         self._fanout_pool = None
         self._fanout_lock = threading.Lock()
+        #: optional HedgePolicy (cluster/breaker.py): when set and the
+        #: index is replicated, remote read legs that outlast the p95
+        #: delay fire one budgeted backup request to the next replica
+        #: and the first success wins (Dean & Barroso hedged requests).
+        self.hedge = None
+        self._hedge_pool = None
 
     #: shared fan-out pool size — bounds total in-flight remote
     #: sub-queries, not per-query fan-out.
@@ -99,12 +108,27 @@ class Cluster:
                         thread_name_prefix="fanout")
         return self._fanout_pool
 
+    def _hedge_executor(self):
+        """Separate pool for hedged legs: a hedged task occupies a
+        fan-out slot while it awaits its primary/backup legs, so running
+        those legs on the SAME bounded pool could deadlock (every slot
+        waiting on a leg that cannot be scheduled)."""
+        if self._hedge_pool is None:
+            with self._fanout_lock:
+                if self._hedge_pool is None:
+                    self._hedge_pool = ThreadPoolExecutor(
+                        max_workers=2 * self.FANOUT_POOL_SIZE,
+                        thread_name_prefix="hedge")
+        return self._hedge_pool
+
     def close(self) -> None:
-        """Release the fan-out pool (idempotent)."""
+        """Release the fan-out pools (idempotent)."""
         with self._fanout_lock:
             pool, self._fanout_pool = self._fanout_pool, None
-        if pool is not None:
-            pool.shutdown(wait=False, cancel_futures=True)
+            hpool, self._hedge_pool = self._hedge_pool, None
+        for p in (pool, hpool):
+            if p is not None:
+                p.shutdown(wait=False, cancel_futures=True)
 
     # -- membership --------------------------------------------------------
 
@@ -280,6 +304,29 @@ class Cluster:
                 raise ShardUnavailableError()
         return out
 
+    def _hedge_backup_groups(self, nodes: list[Node], index: str,
+                             node_id: str,
+                             shards: list[int]) -> dict[str | None, list[int]]:
+        """Split one primary node's shard batch by each shard's next
+        live replica (the hedge target). Shards without another live
+        owner map under None — they still run, just unhedged."""
+        live = {n.id for n in nodes}
+        blocked: set = set()
+        if self.blocked_shards_fn is not None:
+            blocked = self.blocked_shards_fn(index) or set()
+        groups: dict[str | None, list[int]] = {}
+        for shard in shards:
+            backup = None
+            for owner in self.shard_nodes(index, shard):
+                if owner.id == node_id or owner.id not in live:
+                    continue
+                if owner.id == self.local_id and shard in blocked:
+                    continue  # our copy is quarantined: useless backup
+                backup = owner.id
+                break
+            groups.setdefault(backup, []).append(shard)
+        return groups
+
     # -- distributed map/reduce (reference mapReduce executor.go:2455) -----
 
     def map_reduce(self, executor, idx, shards: list[int], c, opt,
@@ -330,8 +377,48 @@ class Cluster:
 
         def run_remote(node_id: str, node_shards: list[int]):
             node = self.node_by_id(node_id)
-            return _with_trace(lambda: self.client.query_node(
+            t0 = time.perf_counter()
+            res = _with_trace(lambda: self.client.query_node(
                 node, idx.name, pql, node_shards, remote=True)[0])
+            if self.hedge is not None:
+                # Successful remote legs feed the p95 the hedge delay
+                # derives from.
+                self.hedge.observe(time.perf_counter() - t0)
+            return res
+
+        def run_remote_hedged(node_id: str, backup_id: str | None,
+                              node_shards: list[int]):
+            """Primary leg with a budgeted backup to ``backup_id`` after
+            the hedge delay; first success wins. Runs on the fan-out
+            pool; both legs run on the dedicated hedge pool."""
+            hedge = self.hedge
+            hedge.note_primary()
+            hpool = self._hedge_executor()
+            primary = hpool.submit(run_remote, node_id, node_shards)
+            delay = hedge.delay()
+            if delay is not None and backup_id is not None:
+                try:
+                    return primary.result(timeout=delay)
+                except FuturesTimeoutError:
+                    pass  # primary is in the tail: consider hedging
+                if hedge.try_fire():
+                    backup = hpool.submit(
+                        run_local if backup_id == self.local_id
+                        else lambda s: run_remote(backup_id, s),
+                        node_shards)
+                    legs = {primary, backup}
+                    while legs:
+                        done, legs = futures_wait(
+                            legs, return_when=FIRST_COMPLETED)
+                        for fut in done:
+                            if fut.exception() is None:
+                                if fut is backup:
+                                    hedge.record_win()
+                                return fut.result()
+                    # Both legs failed; surface the PRIMARY's error so
+                    # the failover wave remaps off the primary node.
+                    raise primary.exception()
+            return primary.result()
 
         while pending:
             # Cancel the whole fan-out (including failover retry waves)
@@ -366,6 +453,16 @@ class Cluster:
                 for node_id, node_shards in groups.items():
                     if node_id == self.local_id:
                         local_shards = node_shards
+                    elif self.hedge is not None and self.replica_n > 1:
+                        # Hedged legs group by common backup owner so
+                        # a backup leg queries exactly the shards its
+                        # node can actually serve.
+                        subs = self._hedge_backup_groups(
+                            nodes, idx.name, node_id, node_shards)
+                        for backup_id, sub in subs.items():
+                            fut = pool.submit(run_remote_hedged, node_id,
+                                              backup_id, sub)
+                            tasks.append((node_id, sub, fut))
                     else:
                         fut = pool.submit(run_remote, node_id, node_shards)
                         tasks.append((node_id, node_shards, fut))

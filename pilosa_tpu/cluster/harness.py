@@ -318,3 +318,14 @@ class LocalCluster:
             if n is not None:
                 n.state = "READY"
                 cn.cluster._update_state()
+
+    def slow(self, node_id: str, delay_s: float) -> None:
+        """Fault injection: gray failure — the peer stays in the ring
+        (membership probes still pass) but every query to it takes
+        ``delay_s``. The breaker/hedge layer, not the failure detector,
+        must route around it."""
+        self.client.slow[node_id] = delay_s
+
+    def fast(self, node_id: str) -> None:
+        """Heal a slow-peer fault."""
+        self.client.slow.pop(node_id, None)

@@ -7,6 +7,7 @@ re-exported too but imports the executor lazily (inside its run), so
 cycle.
 """
 
+from .adaptive import AdaptiveLimit
 from .admission import (
     CLASS_BATCH,
     CLASS_INTERACTIVE,
@@ -28,10 +29,12 @@ from .deadline import (
     reset_current_deadline,
     set_current_deadline,
 )
+from .quota import QuotaExceededError, TenantQuotas
 from .slowlog import SlowQueryLog
 from .warmup import DEFAULT_KINDS, DEFAULT_SHARD_COUNTS, WarmupService
 
 __all__ = [
+    "AdaptiveLimit",
     "AdmissionController",
     "CLASS_BATCH",
     "CLASS_INTERACTIVE",
@@ -44,7 +47,9 @@ __all__ = [
     "DeadlineExceededError",
     "QOS_CLASSES",
     "QueryShedError",
+    "QuotaExceededError",
     "SlowQueryLog",
+    "TenantQuotas",
     "WarmupService",
     "check_current",
     "current_deadline",

@@ -83,8 +83,11 @@ class AdmissionController:
                  weights: dict[str, int] | None = None,
                  internal_reserve: int = 4,
                  default_deadline: float = 0.0,
-                 stats=None, slow_log=None):
+                 stats=None, slow_log=None, adaptive=None):
         self.max_concurrent = int(max_concurrent)
+        #: Optional AdaptiveLimit: when set, the public concurrency
+        #: limit is its measured value (max_concurrent is the ceiling).
+        self.adaptive = adaptive
         self.max_queue = max(0, int(max_queue))
         self.weights = dict(DEFAULT_WEIGHTS)
         if weights:
@@ -105,10 +108,18 @@ class AdmissionController:
 
     # -- scheduling ---------------------------------------------------
 
+    def _current_limit(self) -> int:
+        if self.adaptive is not None:
+            return min(self.max_concurrent, self.adaptive.limit)
+        return self.max_concurrent
+
     def _limit_for(self, cls: str) -> int:
         if cls == CLASS_INTERNAL:
+            # The reserve rides above the *ceiling*, not the adaptive
+            # value: remote fan-out legs must stay deadlock-free even
+            # when the public limit has backed off to its floor.
             return self.max_concurrent + self.internal_reserve
-        return self.max_concurrent
+        return self._current_limit()
 
     def _queued(self) -> int:
         return sum(len(q) for q in self._queues.values())
@@ -208,11 +219,19 @@ class AdmissionController:
     def admit(self, cls: str, deadline: Deadline | None = None):
         if deadline is None:
             deadline = current_deadline()
+        t0 = time.perf_counter()
         self.acquire(cls, deadline)
+        t1 = time.perf_counter()
         try:
             yield
         finally:
             self.release()
+            # Feed the gradient limit from public classes only: the
+            # internal reserve rides above the adaptive limit, so its
+            # latency says nothing about the gate this tunes.
+            if self.adaptive is not None and self.max_concurrent > 0 \
+                    and normalize_class(cls) != CLASS_INTERNAL:
+                self.adaptive.observe(t1 - t0, time.perf_counter() - t1)
 
     # -- observability ------------------------------------------------
 
@@ -230,7 +249,7 @@ class AdmissionController:
     def snapshot(self) -> dict:
         with self._cv:
             queued = {c: len(q) for c, q in self._queues.items()}
-        return {
+        out = {
             "active": self._active,
             "queued": queued,
             "queuedTotal": sum(queued.values()),
@@ -239,11 +258,17 @@ class AdmissionController:
             "deadlineMiss": self._deadline_miss_total,
             "maxConcurrent": self.max_concurrent,
             "maxQueue": self.max_queue,
+            "limit": self._current_limit(),
         }
+        if self.adaptive is not None:
+            out["adaptive"] = self.adaptive.snapshot()
+        return out
 
     def export_gauges(self, stats) -> None:
         snap = self.snapshot()
         stats.gauge("qos.active", float(snap["active"]))
         stats.gauge("qos.queueDepth", float(snap["queuedTotal"]))
+        if self.adaptive is not None:
+            stats.gauge("qos.adaptiveLimit", float(snap["limit"]))
         for c, n in snap["queued"].items():
             stats.with_tags(f"class:{c}").gauge("qos.queueDepth", float(n))

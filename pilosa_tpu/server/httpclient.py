@@ -54,6 +54,12 @@ class HTTPInternalClient:
         self._ssl_ctx = None
         self.timeout = timeout
         self.ca_cert = ca_cert
+        #: Optional BreakerRegistry (cluster.breaker). When set, every
+        #: request consults the peer's breaker first — an open breaker
+        #: fast-fails with BreakerOpenError (a ConnectionError) so the
+        #: executor's replica failover kicks in without burning a
+        #: socket timeout on a known-sick peer.
+        self.breakers = None
         # Verification policy (reference tls.skip-verify,
         # server/config.go): with a CA bundle, verify by default; the
         # CERT_NONE fallback is only for CA-less (self-signed) clusters
@@ -112,6 +118,8 @@ class HTTPInternalClient:
         Retry-After hint as the floor — and never sleeping past the
         active deadline.
         """
+        if self.breakers is not None:
+            self.breakers.check(node.id)
         attempt = 0
         while True:
             req = urllib.request.Request(self._url(node, path), data=body,
@@ -130,11 +138,16 @@ class HTTPInternalClient:
                 with urllib.request.urlopen(
                         req, timeout=self._deadline_timeout(),
                         context=self._ctx(req.full_url)) as resp:
+                    if self.breakers is not None:
+                        self.breakers.record_success(node.id)
                     return resp.read(), resp.headers.get("Content-Type", "")
             except urllib.error.HTTPError as e:
                 # The peer is alive but rejected the request — application
                 # error, NOT a connection failure (failover must not
-                # trigger).
+                # trigger, and the breaker must not feed: a shedding
+                # peer is healthy, just busy).
+                if self.breakers is not None:
+                    self.breakers.record_success(node.id)
                 detail = e.read().decode(errors="replace")
                 if e.code == 404:
                     raise LookupError(f"{node.id}: {detail}") from e
@@ -154,6 +167,12 @@ class HTTPInternalClient:
                                     f"node {node.id} HTTP {e.code}: {detail}",
                                     retry_after=retry_after) from e
             except (urllib.error.URLError, OSError) as e:
+                # Connection failures AND deadline overruns (socket
+                # timeout surfaces as OSError) both feed the breaker:
+                # a peer too slow to answer within budget is as useless
+                # as one that refuses the dial.
+                if self.breakers is not None:
+                    self.breakers.record_failure(node.id)
                 raise ConnectionError(f"node {node.id} unreachable: {e}") \
                     from e
 
@@ -178,10 +197,16 @@ class HTTPInternalClient:
 
     def _request(self, node: Node, method: str, path: str,
                  body: bytes | None = None,
-                 content_type: str = "application/json") -> Any:
+                 content_type: str = "application/json",
+                 retry_503: bool | None = None) -> Any:
+        # GETs are idempotent by contract and always retry a shed;
+        # POST callers must opt in explicitly (reads like /query and
+        # key translation are safe, imports and messages are not).
+        if retry_503 is None:
+            retry_503 = method == "GET"
         data, _ = self._request_raw(node, method, path, body,
                                     content_type=content_type,
-                                    retry_503=(method == "GET"))
+                                    retry_503=retry_503)
         return json.loads(data) if data else {}
 
     def _post_import(self, node: Node, req: dict,
@@ -248,7 +273,10 @@ class HTTPInternalClient:
             if "error" in resp:
                 raise RuntimeError(resp["error"])
             return [wire.decode_result(r) for r in resp["results"]]
-        resp = self._request(node, "POST", path, query.encode())
+        # Forwarded reads are idempotent POSTs: a shed leg may back off
+        # and retry within the deadline budget, same as the remote path.
+        resp = self._request(node, "POST", path, query.encode(),
+                             retry_503=True)
         if "error" in resp:
             raise RuntimeError(resp["error"])
         return resp["results"]
@@ -376,9 +404,12 @@ class HTTPInternalClient:
             return False
 
     def translate_keys(self, node, index, field, keys):
+        # Key translation creates-or-returns the same ids on every
+        # call: idempotent, so a shed may back off and retry.
         body = json.dumps({"index": index, "field": field,
                            "keys": list(keys)}).encode()
-        resp = self._request(node, "POST", "/internal/translate/keys", body)
+        resp = self._request(node, "POST", "/internal/translate/keys", body,
+                             retry_503=True)
         return resp["ids"]
 
     def translate_entries(self, node, index, field, after_id):

@@ -28,7 +28,12 @@ from pilosa_tpu.errors import (
     QueryError,
 )
 from pilosa_tpu.pql import ParseError
-from pilosa_tpu.qos import DeadlineExceededError, QueryShedError, normalize_class
+from pilosa_tpu.qos import (
+    DeadlineExceededError,
+    QueryShedError,
+    QuotaExceededError,
+    normalize_class,
+)
 from pilosa_tpu.qos import deadline as qos_deadline
 from pilosa_tpu.server.api import API
 from pilosa_tpu.storage.quarantine import ShardCorruptError
@@ -126,6 +131,7 @@ def _make_handler(api: API):
             params = {k: v[0] for k, v in parse_qs(parsed.query).items()}
             params["_accept"] = self.headers.get("Accept", "")
             params["_qos_class"] = self.headers.get("X-Qos-Class", "")
+            params["_api_key"] = self.headers.get("X-API-Key", "")
             length = int(self.headers.get("Content-Length") or 0)
             body = self.rfile.read(length) if length else b""
             for pattern, methods in routes:
@@ -154,6 +160,13 @@ def _make_handler(api: API):
                     # instead of queueing unboundedly.
                     status, payload = 503, {"error": str(e)}
                     headers = {"Retry-After": str(int(e.retry_after))}
+                except QuotaExceededError as e:
+                    # 429, NOT 503: the TENANT is over its own budget —
+                    # the node is fine, so retrying a replica won't help;
+                    # slowing down will.
+                    status, payload = 429, {"error": str(e)}
+                    headers = {"Retry-After":
+                               str(max(1, int(e.retry_after + 0.5)))}
                 except DeadlineExceededError as e:
                     status, payload = 504, {"error": str(e)}
                 except _CONFLICTS as e:
@@ -311,6 +324,13 @@ def _build_routes(api: API):
                 and qos_deadline.current_deadline() is None):
             dtoken = qos_deadline.set_current_deadline(
                 qos_deadline.Deadline(timeout=qos_ctl.default_deadline))
+        # Chaos fault hook: a "slow peer" serves every query late but
+        # stays alive to membership probes (gray failure; the breaker
+        # and hedge layer, not the failure detector, must route around
+        # it). Set via POST /internal/fault.
+        fault_slow = getattr(api, "fault_slow_s", 0.0)
+        if fault_slow > 0:
+            time.sleep(fault_slow)
         status = "ok"
         t0 = time.perf_counter()
         try:
@@ -320,6 +340,13 @@ def _build_routes(api: API):
                 # abandoned the request, and answering 200 here would
                 # make expiry behavior depend on cache residency.
                 qos_deadline.check_current()
+                # Per-tenant quota BEFORE admission: an over-budget
+                # tenant must not occupy a queue slot. Remote fan-out
+                # legs are exempt — the coordinator already charged the
+                # tenant once.
+                quotas = getattr(api, "quotas", None)
+                if quotas is not None and not remote:
+                    quotas.check(params.get("_api_key") or pv["index"])
                 if qos_ctl is not None:
                     with qos_ctl.admit(cls):
                         resp = api.query(
@@ -346,9 +373,14 @@ def _build_routes(api: API):
             except _NOT_FOUND + (ApiMethodNotAllowedError,):
                 status = "error"
                 raise
-            except (QueryShedError, DeadlineExceededError) as e:
-                status = ("shed" if isinstance(e, QueryShedError)
-                          else "deadline")
+            except (QueryShedError, DeadlineExceededError,
+                    QuotaExceededError) as e:
+                if isinstance(e, QueryShedError):
+                    status = "shed"
+                elif isinstance(e, QuotaExceededError):
+                    status = "quota"
+                else:
+                    status = "deadline"
                 raise
             except ShardCorruptError:
                 # Re-raise past the PilosaError catch: the dispatch
@@ -362,7 +394,7 @@ def _build_routes(api: API):
             if dtoken is not None:
                 qos_deadline.reset_current_deadline(dtoken)
             slow_log = getattr(qos_ctl, "slow_log", None)
-            if slow_log is not None and status != "shed":
+            if slow_log is not None and status not in ("shed", "quota"):
                 slow_log.observe(pv["index"], body.decode(errors="replace"),
                                  (time.perf_counter() - t0) * 1000.0,
                                  qos_class=cls, status=status)
@@ -427,6 +459,38 @@ def _build_routes(api: API):
                             if slow_log is not None else None),
             "admission": qos_ctl.snapshot(),
         }
+
+    def get_debug_overload(pv, params, body):
+        """One view of the whole overload-resilience layer: adaptive
+        admission limit, per-tenant quota buckets, per-peer breaker
+        states, and the hedge budget — the first stop when the cluster
+        is shedding or routing around a sick peer."""
+        qos_ctl = getattr(api, "qos", None)
+        quotas = getattr(api, "quotas", None)
+        cluster = getattr(api, "cluster", None)
+        breakers = None
+        hedge = None
+        if cluster is not None:
+            breakers = getattr(cluster.client, "breakers", None)
+            hedge = getattr(cluster, "hedge", None)
+        return 200, {
+            "admission": qos_ctl.snapshot() if qos_ctl is not None else None,
+            "adaptive": (qos_ctl.adaptive.snapshot()
+                         if qos_ctl is not None
+                         and qos_ctl.adaptive is not None else None),
+            "quotas": quotas.snapshot() if quotas is not None else None,
+            "breakers": breakers.snapshot() if breakers is not None else None,
+            "hedge": hedge.snapshot() if hedge is not None else None,
+        }
+
+    def post_fault(pv, params, body):
+        """Chaos fault injection (tests/bench only): currently the
+        slow-peer gray failure — {"slowMs": N} delays every subsequent
+        /query on this node by N ms; 0 heals it."""
+        req = jbody(body)
+        if "slowMs" in req:
+            api.fault_slow_s = max(0.0, float(req["slowMs"]) / 1000.0)
+        return 200, {"slowMs": getattr(api, "fault_slow_s", 0.0) * 1000.0}
 
     def get_debug_quarantine(pv, params, body):
         """Corruption quarantine view: which fragments failed integrity
@@ -727,6 +791,7 @@ def _build_routes(api: API):
         (r"/metrics", {"GET": get_metrics}),
         (r"/debug/vars", {"GET": get_debug_vars}),
         (r"/debug/slow-queries", {"GET": get_debug_slow_queries}),
+        (r"/debug/overload", {"GET": get_debug_overload}),
         (r"/debug/quarantine", {"GET": get_debug_quarantine}),
         (r"/debug/threads", {"GET": get_debug_threads}),
         (r"/debug/profile", {"GET": get_debug_profile}),
@@ -758,5 +823,6 @@ def _build_routes(api: API):
         (r"/internal/import", {"POST": post_internal_import}),
         (r"/internal/nodes", {"GET": get_nodes}),
         (r"/internal/probe", {"GET": get_internal_probe}),
+        (r"/internal/fault", {"POST": post_fault}),
     ]
     return [(re.compile("^" + p + "$"), methods) for p, methods in table]

@@ -68,7 +68,15 @@ class ServerNode:
                  qos_slow_query_ms: float = 500.0,
                  qos_warmup: str = "",
                  qos_warmup_shards: str = "1,8,32",
-                 quarantine_keep_n: int = 0):
+                 quarantine_keep_n: int = 0,
+                 qos_adaptive: bool = False,
+                 qos_tenant_rate: float = 0.0,
+                 qos_tenant_burst: float = 0.0,
+                 breaker_threshold: int = 0,
+                 breaker_cooldown: float = 5.0,
+                 hedge: bool = False,
+                 hedge_delay_ms: float = 0.0,
+                 hedge_budget_pct: float = 5.0):
         host, _, port = bind.partition(":")
         self.host, self.port = host or "127.0.0.1", int(port or 10101)
         # Node identity IS the address — member ids are built the same
@@ -153,7 +161,18 @@ class ServerNode:
         # max_concurrent=0 (the constructor default) leaves the gate
         # open — metrics/slow-log only — so embedded/test nodes keep the
         # old dispatch behavior unless explicitly configured.
-        from pilosa_tpu.qos import AdmissionController, SlowQueryLog
+        from pilosa_tpu.qos import (
+            AdaptiveLimit,
+            AdmissionController,
+            SlowQueryLog,
+            TenantQuotas,
+        )
+        adaptive = None
+        if qos_adaptive and qos_max_concurrent > 0:
+            # qos-max-concurrent becomes the CEILING; the operative
+            # limit is measured (probe up / multiplicative back-off).
+            adaptive = AdaptiveLimit(ceiling=qos_max_concurrent,
+                                     stats=self.stats)
         self.qos = AdmissionController(
             max_concurrent=qos_max_concurrent,
             max_queue=qos_max_queue,
@@ -162,8 +181,35 @@ class ServerNode:
             default_deadline=qos_default_deadline,
             stats=self.stats,
             slow_log=SlowQueryLog(threshold_ms=qos_slow_query_ms,
-                                  stats=self.stats))
+                                  stats=self.stats),
+            adaptive=adaptive)
         self.api.qos = self.qos
+        # Per-tenant token buckets above class admission (429 vs the
+        # gate's 503: "you are over YOUR limit" vs "I am over mine").
+        self.quotas = None
+        if qos_tenant_rate > 0:
+            self.quotas = TenantQuotas(rate_per_s=qos_tenant_rate,
+                                       burst=qos_tenant_burst or None,
+                                       stats=self.stats)
+        self.api.quotas = self.quotas
+        # Overload plumbing on the inter-node path: per-peer circuit
+        # breakers in the transport, hedged read legs in map_reduce.
+        if self.cluster is not None:
+            if breaker_threshold > 0:
+                from pilosa_tpu.cluster.breaker import BreakerRegistry
+                self.cluster.client.breakers = BreakerRegistry(
+                    threshold=breaker_threshold,
+                    cooldown=breaker_cooldown,
+                    stats=self.stats)
+            if hedge and replica_n > 1:
+                from pilosa_tpu.cluster.breaker import HedgePolicy
+                self.cluster.hedge = HedgePolicy(
+                    delay_s=hedge_delay_ms / 1000.0,
+                    budget_pct=hedge_budget_pct,
+                    stats=self.stats)
+        #: chaos/fault hook: injected per-query latency (seconds) on
+        #: this node's /query handling — the slow-peer gray failure.
+        self.api.fault_slow_s = 0.0
         self._qos_warmup = qos_warmup
         self._qos_warmup_shards = qos_warmup_shards
         self.warmup = None

@@ -51,16 +51,18 @@ def _free_ports(n):
 def _spawn(addr, peers, data_dir, join=None, log_path=None):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
-    env["PILOSA_TPU_ANTI_ENTROPY_INTERVAL"] = "1.0"
-    env["PILOSA_TPU_CHECK_NODES_INTERVAL"] = "0.5"
+    # setdefault: a drill that exported its own knob before building
+    # the Soak wins over these soak-tuned values.
+    env.setdefault("PILOSA_TPU_ANTI_ENTROPY_INTERVAL", "1.0")
+    env.setdefault("PILOSA_TPU_CHECK_NODES_INTERVAL", "0.5")
     # A join target killed+restarted mid-apply never ACKs and the
     # failure detector may never see it down; a short ACK deadline
     # fails the wedged job and frees the resize gate for the joiner's
     # next announce.
-    env["PILOSA_TPU_RESIZE_ACK_TIMEOUT"] = "15"
+    env.setdefault("PILOSA_TPU_RESIZE_ACK_TIMEOUT", "15")
     # Fast scrub so disk corruption injected mid-soak is found and
     # repaired within the heal window.
-    env["PILOSA_TPU_SCRUB_INTERVAL"] = "1.0"
+    env.setdefault("PILOSA_TPU_SCRUB_INTERVAL", "1.0")
     argv = [sys.executable, "-m", "pilosa_tpu.cli", "server",
             "--bind", addr, "--replica-n", "2", "--no-planner",
             "--data-dir", data_dir]
@@ -116,6 +118,9 @@ class Soak:
                 log_path=str(tmp_path / f"n{i}.log"))
         for a in self.addrs:
             _wait_up(a)
+        #: nodes currently under a slow-peer fault (best effort: a
+        #: kill/restart clears the fault server-side on its own).
+        self.slowed: set[int] = set()
         #: intended bit state: (row, col) -> bool (last write wins).
         self.intent: dict[tuple[int, int], bool] = {}
         #: bits whose last operation ERRORED client-side: the server may
@@ -203,6 +208,33 @@ class Soak:
         except Exception:
             pass  # no active job / gate: fine
 
+    def act_slow_peer(self):
+        """Gray failure: the victim keeps answering membership probes
+        but serves every query late. The breaker/hedge layer — not the
+        failure detector — has to route around it."""
+        alive = [i for i in self.victims() if i not in self.paused]
+        if not alive:
+            return
+        i = self.rng.choice(alive)
+        ms = self.rng.randrange(50, 300)
+        try:
+            _post(self.addrs[i], "/internal/fault",
+                  json.dumps({"slowMs": ms}), timeout=10)
+            self.slowed.add(i)
+        except Exception:
+            pass  # victim died under us: fine
+
+    def act_fast_peer(self):
+        if not self.slowed:
+            return
+        i = self.rng.choice(sorted(self.slowed))
+        try:
+            _post(self.addrs[i], "/internal/fault",
+                  json.dumps({"slowMs": 0}), timeout=10)
+        except Exception:
+            pass
+        self.slowed.discard(i)
+
     def act_corrupt_snapshot(self):
         """Disk rot under a LIVE node: bit-flip one of its published
         snapshots. The scrubber's re-verification (1s interval) or the
@@ -284,6 +316,7 @@ class Soak:
         (4, "act_query"), (1, "act_kill"), (2, "act_restart"),
         (1, "act_pause"), (2, "act_resume"), (1, "act_remove_node"),
         (1, "act_resize_abort"), (1, "act_corrupt_snapshot"),
+        (1, "act_slow_peer"), (1, "act_fast_peer"),
     )
 
     def run_chaos(self, seconds: float):
@@ -297,6 +330,15 @@ class Soak:
         for i in sorted(self.paused):
             os.kill(self.procs[i].pid, signal.SIGCONT)
         self.paused.clear()
+        # Clear slow-peer faults everywhere (a restarted process forgot
+        # its fault already; posting 0 to a dead node is harmless).
+        for i in list(self.procs):
+            try:
+                _post(self.addrs[i], "/internal/fault",
+                      json.dumps({"slowMs": 0}), timeout=10)
+            except Exception:
+                pass
+        self.slowed.clear()
         for _ in range(3):  # act_restart fills at most one slot per call
             self.act_restart()
         for i, p in list(self.procs.items()):
@@ -554,5 +596,99 @@ def test_corrupt_snapshot_recovery_across_restart(tmp_path):
                       for root, _d, files in os.walk(soak.dirs[1])
                       for fn in files if fn.endswith(".quarantine")]
             assert qfiles, "corrupt snapshot was not quarantined"
+    finally:
+        soak.close()
+
+
+@pytest.mark.slow
+def test_slow_peer_breaker_recovery(tmp_path):
+    """Deterministic slow-peer drill on real server processes: node1
+    keeps answering membership probes but serves every query 10s late,
+    while entry queries carry a 2s default deadline. Hedged reads keep
+    answers fast (zero client-visible failures), the abandoned slow
+    legs open node1's circuit breaker at the coordinator, the failure
+    detector does NOT evict the gray node, and after the heal a
+    half-open probe re-closes the breaker."""
+    knobs = {
+        "PILOSA_TPU_BREAKER_THRESHOLD": "3",
+        "PILOSA_TPU_BREAKER_COOLDOWN": "2",
+        "PILOSA_TPU_HEDGE_DELAY_MS": "100",
+        "PILOSA_TPU_QOS_DEFAULT_DEADLINE": "2.0",
+        # The soak default (1s) interleaves successful anti-entropy
+        # calls to the sick peer between the slow query legs, resetting
+        # the consecutive-failure streak before it can reach the
+        # threshold — exactly what this drill must observe latching.
+        "PILOSA_TPU_ANTI_ENTROPY_INTERVAL": "30",
+    }
+    os.environ.update(knobs)
+    try:
+        soak = Soak(tmp_path, 777)
+    finally:
+        for k in knobs:
+            del os.environ[k]
+
+    def overload(addr):
+        return json.loads(urllib.request.urlopen(
+            f"http://{addr}/debug/overload", timeout=10).read())
+
+    def breaker_state(addr, peer):
+        peers = (overload(addr).get("breakers") or {}).get("peers", {})
+        return peers.get(peer, {}).get("state", "closed")
+
+    try:
+        _post(soak.addrs[0], "/index/i")
+        _post(soak.addrs[0], "/index/i/field/f")
+        # bits on all three shards so every query fans out cluster-wide
+        pairs = [(r, shard * (1 << 20) + 10 * i + r)
+                 for shard in range(3) for r in range(N_ROWS)
+                 for i in range(5)]
+        q = " ".join(f"Set({c}, f={r})" for r, c in pairs)
+        _post(soak.addrs[0], "/index/i/query", q, timeout=60)
+        want = {r: sum(1 for rr, _ in pairs if rr == r)
+                for r in range(N_ROWS)}
+
+        _post(soak.addrs[1], "/internal/fault",
+              json.dumps({"slowMs": 10000}))
+        # Under the fault: every query must still succeed, and fast —
+        # the hedge fires at 100ms and a replica answers.
+        failures = 0
+        for n in range(12):
+            r = n % N_ROWS
+            try:
+                got = _post(soak.addrs[0], "/index/i/query?noCache=true",
+                            f"Count(Row(f={r}))", timeout=30)["results"][0]
+                assert got == want[r], (r, got, want[r])
+            except (urllib.error.URLError, OSError, TimeoutError):
+                failures += 1
+        assert failures == 0, f"{failures} queries failed via slow peer"
+        # The abandoned legs overran the 2s deadline: breaker opens.
+        deadline = time.time() + 90
+        state = breaker_state(soak.addrs[0], soak.addrs[1])
+        while state != "open" and time.time() < deadline:
+            _post(soak.addrs[0], "/index/i/query?noCache=true",
+                  "Count(Row(f=0))", timeout=30)
+            time.sleep(0.3)
+            state = breaker_state(soak.addrs[0], soak.addrs[1])
+        assert state == "open", f"breaker never opened (state={state})"
+        # Gray failure: membership probes still pass, so node1 must
+        # still be a full member of the coordinator's ring.
+        st = _status(soak.addrs[0])
+        assert st["state"] == "NORMAL"
+        assert soak.addrs[1] in {n["id"] for n in st["nodes"]}
+
+        # Heal; after the 2s cooldown one half-open probe re-closes it.
+        _post(soak.addrs[1], "/internal/fault", json.dumps({"slowMs": 0}))
+        deadline = time.time() + 90
+        state = breaker_state(soak.addrs[0], soak.addrs[1])
+        while state != "closed" and time.time() < deadline:
+            _post(soak.addrs[0], "/index/i/query?noCache=true",
+                  "Count(Row(f=0))", timeout=30)
+            time.sleep(0.5)
+            state = breaker_state(soak.addrs[0], soak.addrs[1])
+        assert state == "closed", f"breaker never re-closed ({state})"
+        # and the healed peer serves queries directly again
+        got = _post(soak.addrs[1], "/index/i/query?noCache=true",
+                    "Count(Row(f=0))", timeout=30)["results"][0]
+        assert got == want[0]
     finally:
         soak.close()

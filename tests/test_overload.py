@@ -1,0 +1,550 @@
+"""Overload-resilience tests: adaptive concurrency, per-tenant quotas,
+per-peer circuit breakers, and hedged reads.
+
+The adaptive/quota/breaker/hedge-budget units are driven with fake
+clocks or sample counts — no sleeps, fully deterministic. The
+integration tests drive the real HTTP edge (429 + Retry-After contract,
+/debug/overload) and the in-process LocalCluster (hedge wins against a
+slow peer; breaker opens and re-closes around a heal).
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.cluster.breaker import (
+    BreakerOpenError,
+    BreakerRegistry,
+    CircuitBreaker,
+    HedgePolicy,
+)
+from pilosa_tpu.qos import (
+    CLASS_INTERACTIVE,
+    CLASS_INTERNAL,
+    AdaptiveLimit,
+    AdmissionController,
+    Deadline,
+    QuotaExceededError,
+    TenantQuotas,
+    reset_current_deadline,
+    set_current_deadline,
+)
+from pilosa_tpu.server.node import ServerNode
+
+
+# ---------------------------------------------------------------------------
+# Adaptive concurrency limit
+# ---------------------------------------------------------------------------
+
+
+def test_adaptive_limit_rises_under_light_load():
+    a = AdaptiveLimit(ceiling=16, window=4)
+    start = a.limit
+    for _ in range(3 * 4):
+        a.observe(0.0, 0.01)  # no queue wait, flat latency
+    assert a.limit == start + 3
+    assert a.snapshot()["increases"] == 3
+
+
+def test_adaptive_limit_backs_off_on_queue_wait():
+    a = AdaptiveLimit(ceiling=16, window=4, backoff=0.8)
+    before = a.limit
+    for _ in range(4):
+        a.observe(0.1, 0.01)  # 100ms queue wait = congestion
+    assert a.limit == int(before * 0.8)
+    assert a.snapshot()["decreases"] == 1
+
+
+def test_adaptive_limit_backs_off_on_latency_growth():
+    a = AdaptiveLimit(ceiling=16, window=4, latency_ratio=1.5)
+    for _ in range(4):
+        a.observe(0.0, 0.01)  # establish the baseline
+    lifted = a.limit
+    for _ in range(4):
+        a.observe(0.0, 0.05)  # 5x service time, still no queue wait
+    assert a.limit < lifted
+
+
+def test_adaptive_limit_floor_and_ceiling():
+    a = AdaptiveLimit(ceiling=4, floor=1, window=2)
+    for _ in range(40):
+        a.observe(0.5, 0.1)  # permanent congestion
+    assert a.limit == 1  # never below the floor
+    for _ in range(40):
+        a.observe(0.0, 0.1)  # recovered: probes back up
+    assert a.limit == 4  # never above the ceiling
+
+
+def test_admission_gate_follows_adaptive_limit():
+    """With the adaptive limit backed off to 1, a max_concurrent=4 gate
+    admits exactly one public query — but internal legs still ride the
+    reserve above the CEILING (deadlock guard intact)."""
+    a = AdaptiveLimit(ceiling=4, window=2)
+    for _ in range(20):
+        a.observe(0.5, 0.1)
+    assert a.limit == 1
+    ctl = AdmissionController(max_concurrent=4, max_queue=4,
+                              internal_reserve=1, adaptive=a)
+    assert ctl.snapshot()["limit"] == 1
+    ctl.acquire(CLASS_INTERACTIVE)
+    # second public request queues (would admit under the static gate)
+    with pytest.raises(Exception):
+        ctl.acquire(CLASS_INTERACTIVE, deadline=Deadline(timeout=0.05))
+    # internal reserve is above the ceiling, not the adaptive value
+    got = threading.Event()
+
+    def internal():
+        with ctl.admit(CLASS_INTERNAL):
+            got.set()
+
+    t = threading.Thread(target=internal)
+    t.start()
+    assert got.wait(2), "internal leg blocked by the adaptive limit"
+    t.join(5)
+    ctl.release()
+
+
+def test_admission_feeds_adaptive_from_public_classes_only():
+    a = AdaptiveLimit(ceiling=8, window=4)
+    ctl = AdmissionController(max_concurrent=8, adaptive=a)
+    for _ in range(3):
+        with ctl.admit(CLASS_INTERNAL):
+            pass
+    assert a.snapshot()["pending"] == 0  # internal legs don't feed it
+    with ctl.admit(CLASS_INTERACTIVE):
+        pass
+    assert a.snapshot()["pending"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Per-tenant quotas
+# ---------------------------------------------------------------------------
+
+
+def test_quota_exhaustion_and_refill():
+    clk = [0.0]
+    q = TenantQuotas(rate_per_s=1.0, burst=2, clock=lambda: clk[0])
+    q.check("t1")
+    q.check("t1")
+    with pytest.raises(QuotaExceededError) as ei:
+        q.check("t1")
+    assert ei.value.retry_after == pytest.approx(1.0)
+    assert q.snapshot()["rejected"] == 1
+    clk[0] = 1.5  # 1.5 tokens refilled
+    q.check("t1")
+    with pytest.raises(QuotaExceededError):
+        q.check("t1")
+
+
+def test_quota_tenant_isolation():
+    clk = [0.0]
+    q = TenantQuotas(rate_per_s=1.0, burst=1, clock=lambda: clk[0])
+    q.check("flooder")
+    with pytest.raises(QuotaExceededError):
+        q.check("flooder")
+    q.check("bystander")  # unaffected by the flooder's exhaustion
+
+
+def test_quota_burst_caps_refill():
+    clk = [0.0]
+    q = TenantQuotas(rate_per_s=10.0, burst=3, clock=lambda: clk[0])
+    clk[0] = 100.0  # ages don't accumulate past the burst
+    for _ in range(3):
+        q.check("t")
+    with pytest.raises(QuotaExceededError):
+        q.check("t")
+
+
+def test_quota_tenant_table_bounded():
+    from pilosa_tpu.qos.quota import MAX_TENANTS
+    q = TenantQuotas(rate_per_s=1.0, burst=5, clock=lambda: 0.0)
+    for i in range(MAX_TENANTS + 10):
+        q.check(f"tenant-{i}")
+    assert q.snapshot()["tenants"] <= MAX_TENANTS
+
+
+def test_quota_empty_tenant_is_unmetered():
+    q = TenantQuotas(rate_per_s=1.0, burst=1, clock=lambda: 0.0)
+    for _ in range(10):
+        q.check("")  # no tenant identity -> no bucket
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_state_machine():
+    t = [0.0]
+    br = CircuitBreaker(threshold=3, cooldown=5.0, clock=lambda: t[0])
+    assert br.state == "closed"
+    br.record_failure()
+    br.record_failure()
+    assert br.state == "closed"  # under threshold
+    assert br.record_failure() is True  # the opening transition
+    assert br.state == "open"
+    assert br.allow() == (False, 5.0)
+    t[0] = 5.1
+    assert br.state == "half-open"
+    ok, _ = br.allow()
+    assert ok  # the single half-open probe
+    assert br.allow()[0] is False  # everyone else keeps fast-failing
+    br.record_failure()  # failed probe restarts the cooldown
+    assert br.state == "open"
+    assert br.record_failure() is False  # re-failing while open: no event
+    t[0] = 10.2
+    ok, _ = br.allow()
+    assert ok
+    br.record_success()
+    assert br.state == "closed"
+    assert br.opens == 1
+
+
+def test_breaker_success_resets_failure_streak():
+    br = CircuitBreaker(threshold=3, cooldown=5.0, clock=lambda: 0.0)
+    br.record_failure()
+    br.record_failure()
+    br.record_success()  # streak broken: consecutive failures only
+    br.record_failure()
+    br.record_failure()
+    assert br.state == "closed"
+
+
+def test_breaker_registry_fast_fails_as_connection_error():
+    """BreakerOpenError IS a ConnectionError — the executor's existing
+    replica-failover catch absorbs fast-fails with zero changes."""
+    t = [0.0]
+    reg = BreakerRegistry(threshold=1, cooldown=5.0, clock=lambda: t[0])
+    reg.record_failure("p1")
+    with pytest.raises(ConnectionError) as ei:
+        reg.check("p1")
+    assert isinstance(ei.value, BreakerOpenError)
+    assert ei.value.peer_id == "p1"
+    reg.check("p2")  # other peers unaffected
+    snap = reg.snapshot()
+    assert snap["peers"]["p1"]["state"] == "open"
+
+
+def test_breaker_open_counts_in_stats():
+    from pilosa_tpu.obs import MemoryStats
+    stats = MemoryStats()
+    reg = BreakerRegistry(threshold=2, cooldown=5.0, stats=stats)
+    reg.record_failure("p1")
+    reg.record_failure("p1")
+    reg.record_failure("p1")  # already open: no second transition
+    assert stats.counter_value("cluster.breakerOpen", "peer:p1") == 1
+
+
+def test_httpclient_breaker_opens_on_unreachable_peer():
+    """Connection failures trip the breaker; the next call fast-fails
+    without dialing (instant, not a socket timeout)."""
+    import socket
+
+    from pilosa_tpu.cluster.node import URI, Node
+    from pilosa_tpu.server.httpclient import HTTPInternalClient
+
+    # grab a port nothing listens on
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    node = Node(id="deadpeer", uri=URI(host="127.0.0.1", port=port))
+    client = HTTPInternalClient(timeout=1.0)
+    client.breakers = BreakerRegistry(threshold=2, cooldown=30.0)
+    for _ in range(2):
+        with pytest.raises(ConnectionError):
+            client._request_raw(node, "GET", "/version")
+    t0 = time.perf_counter()
+    with pytest.raises(BreakerOpenError):
+        client._request_raw(node, "GET", "/version")
+    assert time.perf_counter() - t0 < 0.1  # fast-fail, no dial
+    assert client.breakers.state("deadpeer") == "open"
+
+
+# ---------------------------------------------------------------------------
+# Hedge policy
+# ---------------------------------------------------------------------------
+
+
+def test_hedge_budget_enforcement():
+    """Hedges never exceed burst + budget_pct% of primary legs."""
+    h = HedgePolicy(delay_s=0.01, budget_pct=5.0, burst=2)
+    for _ in range(20):
+        h.note_primary()
+    fired = sum(1 for _ in range(50) if h.try_fire())
+    # 2 burst + 5% of 20 primaries = 3
+    assert fired == 3
+    snap = h.snapshot()
+    assert snap["fired"] == 3 and snap["primaries"] == 20
+
+
+def test_hedge_budget_accrues_with_traffic():
+    h = HedgePolicy(delay_s=0.01, budget_pct=10.0, burst=0)
+    assert h.try_fire() is False  # no traffic, no budget
+    for _ in range(10):
+        h.note_primary()
+    assert h.try_fire() is True  # 10% of 10 = 1 hedge earned
+    assert h.try_fire() is False
+
+
+def test_hedge_delay_fixed_vs_p95():
+    h = HedgePolicy(delay_s=0.25)
+    assert h.delay() == 0.25  # fixed override wins, no samples needed
+    m = HedgePolicy(delay_s=0.0, min_samples=4)
+    assert m.delay() is None  # not enough signal yet
+    for v in (0.01, 0.01, 0.01, 0.5):
+        m.observe(v)
+    assert m.delay() == 0.5  # p95 of the window targets the tail
+
+
+# ---------------------------------------------------------------------------
+# 503 retry on idempotent POST legs (satellite)
+# ---------------------------------------------------------------------------
+
+
+class _PostSheddingHandler(
+        __import__("http.server", fromlist=["x"]).BaseHTTPRequestHandler):
+    """503 + Retry-After for the first ``fail_n`` POSTs, then 200 with a
+    query-shaped body."""
+
+    hits: list = []
+    fail_n = 2
+
+    def do_POST(self):
+        self.rfile.read(int(self.headers.get("Content-Length") or 0))
+        n = len(self.hits)
+        self.hits.append(self.path)
+        if n < self.fail_n:
+            body = b'{"error": "shed"}'
+            self.send_response(503)
+            self.send_header("Retry-After", "0")
+        else:
+            body = b'{"results": [7]}'
+            self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture
+def post_shedding_node():
+    from http.server import ThreadingHTTPServer
+
+    from pilosa_tpu.cluster.node import URI, Node
+
+    _PostSheddingHandler.hits = []
+    _PostSheddingHandler.fail_n = 2
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _PostSheddingHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield Node(id="shedder",
+               uri=URI(host="127.0.0.1", port=srv.server_address[1]))
+    srv.shutdown()
+    t.join(5)
+
+
+def test_query_post_retries_503(post_shedding_node):
+    """The /query read leg is an idempotent POST: it rides out transient
+    sheds with the same bounded backoff GETs get."""
+    from pilosa_tpu.server.httpclient import HTTPInternalClient
+
+    client = HTTPInternalClient(timeout=5.0)
+    results = client.query_node(post_shedding_node, "i", "Count(Row(f=1))",
+                                None, remote=False)
+    assert results == [7]
+    assert len(_PostSheddingHandler.hits) == 3  # 2 sheds + 1 success
+
+
+def test_non_idempotent_post_does_not_retry(post_shedding_node):
+    """Cluster messages may not be re-sent on a shed: exactly one
+    attempt, error surfaced to the caller."""
+    from pilosa_tpu.server.httpclient import HTTPInternalClient, NodeHTTPError
+
+    client = HTTPInternalClient(timeout=5.0)
+    with pytest.raises(NodeHTTPError) as ei:
+        client.send_message(post_shedding_node, {"type": "noop"})
+    assert ei.value.code == 503
+    assert len(_PostSheddingHandler.hits) == 1
+
+
+# ---------------------------------------------------------------------------
+# HTTP edge: 429 quota contract + /debug/overload
+# ---------------------------------------------------------------------------
+
+
+def _req(base, method, path, body=None, headers=None):
+    data = body.encode() if isinstance(body, str) else body
+    r = urllib.request.Request(base + path, data=data, method=method)
+    for k, v in (headers or {}).items():
+        r.add_header(k, v)
+    try:
+        with urllib.request.urlopen(r, timeout=30) as resp:
+            return resp.status, json.loads(resp.read() or b"{}"), resp.headers
+    except urllib.error.HTTPError as e:
+        payload = e.read()
+        try:
+            parsed = json.loads(payload)
+        except json.JSONDecodeError:
+            parsed = {"raw": payload.decode()}
+        return e.code, parsed, e.headers
+
+
+@pytest.fixture
+def quota_node():
+    n = ServerNode(bind="127.0.0.1:0", use_planner=False,
+                   qos_max_concurrent=4, qos_adaptive=True,
+                   qos_tenant_rate=0.01, qos_tenant_burst=2.0)
+    n.open()
+    base = f"http://127.0.0.1:{n.port}"
+    _req(base, "POST", "/index/i")
+    _req(base, "POST", "/index/i/field/f")
+    yield n, base
+    n.close()
+
+
+def test_http_quota_429_with_retry_after(quota_node):
+    """Quota exhaustion is 429 + Retry-After (the tenant's fault),
+    distinct from the 503 shed (the node's fault); other tenants keep
+    flowing."""
+    n, base = quota_node
+    q = "/index/i/query?noCache=true"
+    key = {"X-API-Key": "tenant-a"}
+    for _ in range(2):  # burst = 2
+        status, _, _ = _req(base, "POST", q, "Count(Row(f=1))", headers=key)
+        assert status == 200
+    status, payload, headers = _req(base, "POST", q, "Count(Row(f=1))",
+                                    headers=key)
+    assert status == 429, payload
+    assert int(headers["Retry-After"]) >= 1
+    # a different API key has its own bucket
+    status, _, _ = _req(base, "POST", q, "Count(Row(f=1))",
+                        headers={"X-API-Key": "tenant-b"})
+    assert status == 200
+    # without a key, the tenant is the index — also its own bucket
+    status, _, _ = _req(base, "POST", q, "Count(Row(f=1))")
+    assert status == 200
+    assert n.quotas.snapshot()["rejected"] == 1
+    assert n.stats.counter_value("qos.quotaRejected", "tenant:tenant-a") == 1
+
+
+def test_http_remote_legs_exempt_from_quota(quota_node):
+    """remote=true fan-out legs are not re-charged (the coordinator
+    already paid)."""
+    n, base = quota_node
+    key = {"X-API-Key": "tenant-c"}
+    for _ in range(5):
+        status, payload, _ = _req(
+            base, "POST", "/index/i/query?noCache=true&remote=true&shards=0",
+            "Count(Row(f=1))", headers=key)
+        assert status == 200, payload
+
+
+def test_http_debug_overload_route(quota_node):
+    n, base = quota_node
+    _req(base, "POST", "/index/i/query?noCache=true", "Count(Row(f=1))",
+         headers={"X-API-Key": "t"})
+    status, payload, _ = _req(base, "GET", "/debug/overload")
+    assert status == 200
+    assert payload["admission"]["maxConcurrent"] == 4
+    # adaptive is on: the operative limit rides under the ceiling
+    assert payload["adaptive"] is not None
+    assert 1 <= payload["adaptive"]["limit"] <= 4
+    assert payload["admission"]["limit"] == payload["adaptive"]["limit"]
+    assert payload["quotas"]["ratePerS"] == pytest.approx(0.01)
+    assert payload["quotas"]["tenants"] >= 1
+    # standalone node: no cluster, so no breakers/hedge sections
+    assert payload["breakers"] is None and payload["hedge"] is None
+
+
+# ---------------------------------------------------------------------------
+# LocalCluster integration: hedge wins, breaker recovery
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def overload_cluster():
+    from pilosa_tpu.cluster.harness import LocalCluster
+    from pilosa_tpu.config import SHARD_WIDTH
+
+    lc = LocalCluster(3, replica_n=2)
+    lc.create_index("i")
+    lc.create_field("i", "f")
+    for s in range(8):
+        lc.query("i", f"Set({s * SHARD_WIDTH + 5}, f=1)")
+    yield lc
+    for cn in lc.nodes:
+        cn.cluster.close()
+
+
+def test_hedged_read_beats_slow_peer(overload_cluster):
+    """With one peer serving every query 300ms late, a hedged read
+    returns at the hedge delay, not the peer's latency — and the win is
+    counted."""
+    from pilosa_tpu.cluster.breaker import HedgePolicy
+
+    lc = overload_cluster
+    for cn in lc.nodes:
+        cn.cluster.hedge = HedgePolicy(delay_s=0.03, burst=16)
+    lc.slow("node1", 0.3)
+    tok = set_current_deadline(Deadline(timeout=5.0))
+    try:
+        t0 = time.perf_counter()
+        (got,) = lc.query("i", "Count(Row(f=1))", cache=False)
+        dt = time.perf_counter() - t0
+    finally:
+        reset_current_deadline(tok)
+    assert got == 8
+    assert dt < 0.25, f"hedge did not absorb the slow peer ({dt:.3f}s)"
+    snap = lc.nodes[0].cluster.hedge.snapshot()
+    assert snap["fired"] >= 1 and snap["won"] >= 1
+
+
+@pytest.mark.slow
+def test_breaker_recovery_on_local_cluster(overload_cluster):
+    """Slow-peer drill in miniature: deadline overruns open the sick
+    peer's breaker, queries keep succeeding (hedge + failover), and a
+    half-open probe re-closes it after the heal."""
+    from pilosa_tpu.cluster.breaker import BreakerRegistry, HedgePolicy
+
+    lc = overload_cluster
+    reg = BreakerRegistry(threshold=3, cooldown=0.5)
+    lc.client.breakers = reg
+    for cn in lc.nodes:
+        cn.cluster.hedge = HedgePolicy(delay_s=0.02, burst=32)
+    lc.slow("node1", 0.4)
+    failures = 0
+    for _ in range(8):
+        tok = set_current_deadline(Deadline(timeout=0.2))
+        try:
+            (got,) = lc.query("i", "Count(Row(f=1))", cache=False)
+            assert got == 8
+        except Exception:
+            failures += 1
+        finally:
+            reset_current_deadline(tok)
+    assert failures == 0, "queries failed due to the slow peer"
+    # the abandoned primary legs overran their deadlines -> breaker open
+    deadline = time.time() + 5
+    while reg.state("node1") != "open" and time.time() < deadline:
+        time.sleep(0.05)
+    assert reg.state("node1") == "open"
+    # heal; after the cooldown one probe re-closes it
+    lc.fast("node1")
+    time.sleep(0.6)
+    for _ in range(3):
+        tok = set_current_deadline(Deadline(timeout=5.0))
+        try:
+            lc.query("i", "Count(Row(f=1))", cache=False)
+        finally:
+            reset_current_deadline(tok)
+        if reg.state("node1") == "closed":
+            break
+        time.sleep(0.6)
+    assert reg.state("node1") == "closed"
