@@ -86,6 +86,10 @@ _DEFAULTS = {
     "hedge": True,
     "hedge_delay_ms": 0.0,
     "hedge_budget_pct": 5.0,
+    # Chaos fault injection (POST /internal/fault): OFF unless the
+    # operator opts in — the route lets any client that can reach the
+    # port inject per-query latency, so it must never ship armed.
+    "chaos_faults": False,
 }
 
 
@@ -167,6 +171,8 @@ def cmd_server(args) -> int:
         cfg["hedge_delay_ms"] = args.hedge_delay_ms
     if args.hedge_budget_pct is not None:
         cfg["hedge_budget_pct"] = args.hedge_budget_pct
+    if args.chaos_faults:
+        cfg["chaos_faults"] = True
 
     from pilosa_tpu.server.node import ServerNode
     node = ServerNode(
@@ -204,6 +210,7 @@ def cmd_server(args) -> int:
         hedge=bool(cfg["hedge"]),
         hedge_delay_ms=float(cfg["hedge_delay_ms"]),
         hedge_budget_pct=float(cfg["hedge_budget_pct"]),
+        chaos_faults=bool(cfg["chaos_faults"]),
     )
     node.open()  # starts the (single) serve loop in the background
     print(f"pilosa-tpu serving at {node.address}", file=sys.stderr)
@@ -614,7 +621,9 @@ def cmd_generate_config(args) -> int:
           '# hedged reads on replicated legs (delay 0 = measured p95)\n'
           'hedge = true\n'
           'hedge-delay-ms = 0.0\n'
-          'hedge-budget-pct = 5.0')
+          'hedge-budget-pct = 5.0\n'
+          '# chaos fault injection route (tests only; never production)\n'
+          '# chaos-faults = false')
     return 0
 
 
@@ -675,6 +684,9 @@ def main(argv: list[str] | None = None) -> int:
                    help="fixed hedge delay, ms (0 = measured p95)")
     s.add_argument("--hedge-budget-pct", type=float, default=None,
                    help="hedges as a %% of primary legs (default 5)")
+    s.add_argument("--chaos-faults", action="store_true",
+                   help="mount POST /internal/fault (chaos testing "
+                        "only; never on production nodes)")
     s.add_argument("--trace-endpoint", default="",
                    help="OTLP/HTTP collector URL for trace export")
     s.add_argument("--config", default=None)

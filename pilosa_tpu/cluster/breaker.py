@@ -51,6 +51,7 @@ class CircuitBreaker:
         self._failures = 0
         self._opened_at: float | None = None
         self._probing = False
+        self._probe_started = 0.0
         self._opens = 0
         self._lock = threading.Lock()
 
@@ -70,13 +71,21 @@ class CircuitBreaker:
 
     def allow(self) -> tuple[bool, float]:
         """(admit?, seconds-until-next-probe). At most one in-flight
-        probe while half-open; everyone else keeps fast-failing."""
+        probe while half-open; everyone else keeps fast-failing. A
+        probe lease that was never resolved (its thread died without
+        reaching record_success/record_failure/abort) expires after one
+        cooldown, so a lost probe can't fast-fail the peer forever."""
         with self._lock:
             if self._opened_at is None:
                 return True, 0.0
-            elapsed = self.clock() - self._opened_at
-            if elapsed >= self.cooldown and not self._probing:
+            now = self.clock()
+            elapsed = now - self._opened_at
+            if elapsed >= self.cooldown:
+                if self._probing and \
+                        now - self._probe_started < self.cooldown:
+                    return False, 0.0
                 self._probing = True
+                self._probe_started = now
                 return True, 0.0
             return False, max(0.0, self.cooldown - elapsed)
 
@@ -84,6 +93,15 @@ class CircuitBreaker:
         with self._lock:
             self._failures = 0
             self._opened_at = None
+            self._probing = False
+
+    def abort(self) -> None:
+        """Release a claimed half-open probe WITHOUT recording an
+        outcome: the probe never reached the peer (e.g. the caller's
+        own deadline expired before dialing), so it proves nothing
+        about peer health. The cooldown is not restarted — the next
+        request may immediately claim a fresh probe."""
+        with self._lock:
             self._probing = False
 
     def record_failure(self) -> bool:
@@ -135,6 +153,11 @@ class BreakerRegistry:
 
     def record_success(self, peer_id: str) -> None:
         self._breaker(peer_id).record_success()
+
+    def abort(self, peer_id: str) -> None:
+        """Release a probe claimed by check() without an outcome (the
+        request never reached the peer)."""
+        self._breaker(peer_id).abort()
 
     def record_failure(self, peer_id: str) -> None:
         if self._breaker(peer_id).record_failure():

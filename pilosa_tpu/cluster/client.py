@@ -111,18 +111,31 @@ class LocalClient:
         return peer
 
     def query_node(self, node, index, query, shards, remote=True):
-        if self.breakers is not None:
-            self.breakers.check(node.id)
+        if self.breakers is None:
+            return self._query_node(node, index, query, shards, remote)
+        self.breakers.check(node.id)
+        # Mirror the HTTP client's bookkeeping exactly: EVERY outcome
+        # resolves the breaker (a claimed half-open probe left
+        # unresolved would fast-fail the peer forever). ConnectionError
+        # (down peer, slow peer that blew the deadline) is a failure;
+        # our own deadline expiring before/while dispatching proves
+        # nothing, so it releases the probe without an outcome; any
+        # other exception is an ALIVE peer answering with an
+        # application error (query RuntimeError, ShardCorruptError,
+        # QueryShedError) — a success, same as the HTTP path's 503.
+        from pilosa_tpu.qos.deadline import DeadlineExceededError
         try:
             result = self._query_node(node, index, query, shards, remote)
         except ConnectionError:
-            # Down peer or (below) a slow peer that blew the deadline:
-            # both feed the breaker, mirroring the HTTP client.
-            if self.breakers is not None:
-                self.breakers.record_failure(node.id)
+            self.breakers.record_failure(node.id)
             raise
-        if self.breakers is not None:
+        except DeadlineExceededError:
+            self.breakers.abort(node.id)
+            raise
+        except BaseException:
             self.breakers.record_success(node.id)
+            raise
+        self.breakers.record_success(node.id)
         return result
 
     def _query_node(self, node, index, query, shards, remote=True):

@@ -392,11 +392,15 @@ class Cluster:
             the hedge delay; first success wins. Runs on the fan-out
             pool; both legs run on the dedicated hedge pool."""
             hedge = self.hedge
-            hedge.note_primary()
             hpool = self._hedge_executor()
             primary = hpool.submit(run_remote, node_id, node_shards)
             delay = hedge.delay()
             if delay is not None and backup_id is not None:
+                # Only hedge-ELIGIBLE legs feed the budget: a leg with
+                # no live backup or no delay estimate can never hedge,
+                # and counting it would inflate the allowance past
+                # ~budget_pct% of the traffic that actually can.
+                hedge.note_primary()
                 try:
                     return primary.result(timeout=delay)
                 except FuturesTimeoutError:

@@ -121,60 +121,74 @@ class HTTPInternalClient:
         if self.breakers is not None:
             self.breakers.check(node.id)
         attempt = 0
-        while True:
-            req = urllib.request.Request(self._url(node, path), data=body,
-                                         method=method)
-            if body is not None:
-                req.add_header("Content-Type", content_type)
-            if accept is not None:
-                req.add_header("Accept", accept)
-            from pilosa_tpu.obs.tracing import inject_http_headers
-            headers: dict = {}
-            inject_http_headers(headers)
-            _inject_deadline(headers)
-            for k, v in headers.items():
-                req.add_header(k, v)
-            try:
-                with urllib.request.urlopen(
-                        req, timeout=self._deadline_timeout(),
-                        context=self._ctx(req.full_url)) as resp:
+        try:
+            while True:
+                req = urllib.request.Request(self._url(node, path), data=body,
+                                             method=method)
+                if body is not None:
+                    req.add_header("Content-Type", content_type)
+                if accept is not None:
+                    req.add_header("Accept", accept)
+                from pilosa_tpu.obs.tracing import inject_http_headers
+                headers: dict = {}
+                inject_http_headers(headers)
+                _inject_deadline(headers)
+                for k, v in headers.items():
+                    req.add_header(k, v)
+                try:
+                    with urllib.request.urlopen(
+                            req, timeout=self._deadline_timeout(),
+                            context=self._ctx(req.full_url)) as resp:
+                        if self.breakers is not None:
+                            self.breakers.record_success(node.id)
+                        return (resp.read(),
+                                resp.headers.get("Content-Type", ""))
+                except urllib.error.HTTPError as e:
+                    # The peer is alive but rejected the request —
+                    # application error, NOT a connection failure
+                    # (failover must not trigger, and the breaker must
+                    # not feed: a shedding peer is healthy, just busy).
                     if self.breakers is not None:
                         self.breakers.record_success(node.id)
-                    return resp.read(), resp.headers.get("Content-Type", "")
-            except urllib.error.HTTPError as e:
-                # The peer is alive but rejected the request — application
-                # error, NOT a connection failure (failover must not
-                # trigger, and the breaker must not feed: a shedding
-                # peer is healthy, just busy).
-                if self.breakers is not None:
-                    self.breakers.record_success(node.id)
-                detail = e.read().decode(errors="replace")
-                if e.code == 404:
-                    raise LookupError(f"{node.id}: {detail}") from e
-                retry_after = None
-                if e.code == 503:
-                    try:
-                        retry_after = float(e.headers.get("Retry-After"))
-                    except (TypeError, ValueError):
-                        retry_after = None
-                    if retry_503 and attempt < RETRY_503_ATTEMPTS:
-                        delay = self._backoff_delay(attempt, retry_after)
-                        if delay is not None:
-                            time.sleep(delay)
-                            attempt += 1
-                            continue
-                raise NodeHTTPError(e.code,
-                                    f"node {node.id} HTTP {e.code}: {detail}",
-                                    retry_after=retry_after) from e
-            except (urllib.error.URLError, OSError) as e:
-                # Connection failures AND deadline overruns (socket
-                # timeout surfaces as OSError) both feed the breaker:
-                # a peer too slow to answer within budget is as useless
-                # as one that refuses the dial.
-                if self.breakers is not None:
-                    self.breakers.record_failure(node.id)
-                raise ConnectionError(f"node {node.id} unreachable: {e}") \
-                    from e
+                    detail = e.read().decode(errors="replace")
+                    if e.code == 404:
+                        raise LookupError(f"{node.id}: {detail}") from e
+                    retry_after = None
+                    if e.code == 503:
+                        try:
+                            retry_after = float(e.headers.get("Retry-After"))
+                        except (TypeError, ValueError):
+                            retry_after = None
+                        if retry_503 and attempt < RETRY_503_ATTEMPTS:
+                            delay = self._backoff_delay(attempt, retry_after)
+                            if delay is not None:
+                                time.sleep(delay)
+                                attempt += 1
+                                continue
+                    raise NodeHTTPError(
+                        e.code, f"node {node.id} HTTP {e.code}: {detail}",
+                        retry_after=retry_after) from e
+                except (urllib.error.URLError, OSError) as e:
+                    # Connection failures AND deadline overruns (socket
+                    # timeout surfaces as OSError) both feed the breaker:
+                    # a peer too slow to answer within budget is as
+                    # useless as one that refuses the dial.
+                    if self.breakers is not None:
+                        self.breakers.record_failure(node.id)
+                    raise ConnectionError(
+                        f"node {node.id} unreachable: {e}") from e
+        except (ConnectionError, NodeHTTPError, LookupError):
+            raise  # breaker outcome already recorded above
+        except BaseException:
+            # Escaped before any outcome was recorded — e.g. the active
+            # deadline expired before dialing (DeadlineExceededError
+            # from _deadline_timeout). That proves nothing about the
+            # peer, so release a claimed half-open probe instead of
+            # leaving it wedged (a stuck lease would fast-fail the peer
+            # until process restart).
+            if self.breakers is not None:
+                self.breakers.abort(node.id)
+            raise
 
     @staticmethod
     def _backoff_delay(attempt: int, retry_after: float | None) -> float | None:

@@ -484,9 +484,11 @@ def _build_routes(api: API):
         }
 
     def post_fault(pv, params, body):
-        """Chaos fault injection (tests/bench only): currently the
-        slow-peer gray failure — {"slowMs": N} delays every subsequent
-        /query on this node by N ms; 0 heals it."""
+        """Chaos fault injection: currently the slow-peer gray failure
+        — {"slowMs": N} delays every subsequent /query on this node by
+        N ms; 0 heals it. Only mounted when the node was started with
+        chaos faults enabled (--chaos-faults / PILOSA_TPU_CHAOS_FAULTS)
+        — a one-request degradation lever must not ship armed."""
         req = jbody(body)
         if "slowMs" in req:
             api.fault_slow_s = max(0.0, float(req["slowMs"]) / 1000.0)
@@ -823,6 +825,7 @@ def _build_routes(api: API):
         (r"/internal/import", {"POST": post_internal_import}),
         (r"/internal/nodes", {"GET": get_nodes}),
         (r"/internal/probe", {"GET": get_internal_probe}),
-        (r"/internal/fault", {"POST": post_fault}),
     ]
+    if getattr(api, "chaos_faults", False):
+        table.append((r"/internal/fault", {"POST": post_fault}))
     return [(re.compile("^" + p + "$"), methods) for p, methods in table]

@@ -76,7 +76,8 @@ class ServerNode:
                  breaker_cooldown: float = 5.0,
                  hedge: bool = False,
                  hedge_delay_ms: float = 0.0,
-                 hedge_budget_pct: float = 5.0):
+                 hedge_budget_pct: float = 5.0,
+                 chaos_faults: bool = False):
         host, _, port = bind.partition(":")
         self.host, self.port = host or "127.0.0.1", int(port or 10101)
         # Node identity IS the address — member ids are built the same
@@ -209,7 +210,10 @@ class ServerNode:
                     stats=self.stats)
         #: chaos/fault hook: injected per-query latency (seconds) on
         #: this node's /query handling — the slow-peer gray failure.
+        #: POST /internal/fault can only arm it when the operator
+        #: opted in (chaos_faults); the route is not mounted otherwise.
         self.api.fault_slow_s = 0.0
+        self.api.chaos_faults = bool(chaos_faults)
         self._qos_warmup = qos_warmup
         self._qos_warmup_shards = qos_warmup_shards
         self.warmup = None

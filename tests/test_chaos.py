@@ -63,6 +63,9 @@ def _spawn(addr, peers, data_dir, join=None, log_path=None):
     # Fast scrub so disk corruption injected mid-soak is found and
     # repaired within the heal window.
     env.setdefault("PILOSA_TPU_SCRUB_INTERVAL", "1.0")
+    # The slow-peer drills drive POST /internal/fault; the route is
+    # only mounted when chaos faults are explicitly enabled.
+    env.setdefault("PILOSA_TPU_CHAOS_FAULTS", "1")
     argv = [sys.executable, "-m", "pilosa_tpu.cli", "server",
             "--bind", addr, "--replica-n", "2", "--no-planner",
             "--data-dir", data_dir]

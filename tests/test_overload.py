@@ -265,6 +265,93 @@ def test_httpclient_breaker_opens_on_unreachable_peer():
     assert client.breakers.state("deadpeer") == "open"
 
 
+def test_breaker_probe_abort_releases_lease():
+    """An aborted half-open probe (it never reached the peer) releases
+    the single probe slot without restarting the cooldown — the next
+    request may immediately claim a fresh probe."""
+    t = [0.0]
+    br = CircuitBreaker(threshold=1, cooldown=5.0, clock=lambda: t[0])
+    br.record_failure()
+    t[0] = 5.1
+    ok, _ = br.allow()
+    assert ok  # probe claimed
+    assert br.allow()[0] is False
+    br.abort()
+    ok, _ = br.allow()
+    assert ok  # lease released: a new probe goes out right away
+    br.record_success()
+    assert br.state == "closed"
+
+
+def test_breaker_stale_probe_lease_expires():
+    """A probe whose thread died without ever resolving (no success,
+    failure, or abort) must not wedge the breaker open forever: the
+    lease expires after one cooldown and a new probe is granted."""
+    t = [0.0]
+    br = CircuitBreaker(threshold=1, cooldown=5.0, clock=lambda: t[0])
+    br.record_failure()
+    t[0] = 5.1
+    assert br.allow()[0] is True  # probe claimed, then lost
+    assert br.allow()[0] is False
+    t[0] = 10.3  # one full cooldown after the stale claim
+    assert br.allow()[0] is True  # expired lease: re-probe allowed
+    br.record_success()
+    assert br.state == "closed"
+
+
+def test_httpclient_expired_deadline_releases_breaker_probe():
+    """A DeadlineExceededError raised BEFORE dialing (deadline spent)
+    must not leave the claimed half-open probe dangling — that would
+    fast-fail the peer until process restart."""
+    from pilosa_tpu.cluster.node import URI, Node
+    from pilosa_tpu.qos.deadline import DeadlineExceededError
+    from pilosa_tpu.server.httpclient import HTTPInternalClient
+
+    t = [0.0]
+    node = Node(id="sickpeer", uri=URI(host="127.0.0.1", port=1))
+    client = HTTPInternalClient(timeout=1.0)
+    client.breakers = BreakerRegistry(threshold=1, cooldown=5.0,
+                                      clock=lambda: t[0])
+    client.breakers.record_failure("sickpeer")
+    t[0] = 5.1  # cooldown elapsed: next request claims the probe
+    tok = set_current_deadline(Deadline(timeout=-1.0))  # already expired
+    try:
+        with pytest.raises(DeadlineExceededError):
+            client._request_raw(node, "GET", "/version")
+    finally:
+        reset_current_deadline(tok)
+    # the lease was released: a fresh probe is immediately available
+    assert client.breakers._breaker("sickpeer").allow()[0] is True
+
+
+def test_localclient_app_error_resolves_breaker_probe():
+    """LocalClient mirrors the HTTP client: a peer answering with an
+    APPLICATION error is alive — the half-open probe records success
+    and the breaker re-closes instead of wedging."""
+    from pilosa_tpu.cluster.client import LocalClient
+    from pilosa_tpu.cluster.node import URI, Node
+
+    class AppErrorPeer:
+        def handle_query(self, index, query, shards, remote):
+            raise RuntimeError("bad query")
+
+    t = [0.0]
+    lc = LocalClient()
+    lc.register("p1", AppErrorPeer())
+    lc.breakers = BreakerRegistry(threshold=1, cooldown=5.0,
+                                  clock=lambda: t[0])
+    node = Node(id="p1", uri=URI(host="127.0.0.1", port=1))
+    lc.down.add("p1")
+    with pytest.raises(ConnectionError):
+        lc.query_node(node, "i", "Count(Row(f=1))", [0])
+    assert lc.breakers.state("p1") == "open"
+    lc.down.discard("p1")
+    t[0] = 5.1
+    with pytest.raises(RuntimeError):
+        lc.query_node(node, "i", "Count(Row(f=1))", [0])
+    assert lc.breakers.state("p1") == "closed"
+
+
 # ---------------------------------------------------------------------------
 # Hedge policy
 # ---------------------------------------------------------------------------
