@@ -90,6 +90,16 @@ _DEFAULTS = {
     # operator opts in — the route lets any client that can reach the
     # port inject per-query latency, so it must never ship armed.
     "chaos_faults": False,
+    # Persistent XLA compilation cache directory. "" resolves to
+    # <data-dir>/compile-cache (memory-only when no data dir); "off"
+    # disables. A restarted node reloads every kernel compiled by
+    # prior runs instead of paying the cold trace+compile.
+    "compile_cache_dir": "",
+    # Plan-shape bucketing policy: "pow2" rounds stack heights up to
+    # power-of-two buckets (zero-padded, bit-identical results) so a
+    # never-seen shard count dispatches into an already-compiled
+    # kernel; "none" pads only to the device-mesh multiple.
+    "plan_buckets": "pow2",
 }
 
 
@@ -173,6 +183,10 @@ def cmd_server(args) -> int:
         cfg["hedge_budget_pct"] = args.hedge_budget_pct
     if args.chaos_faults:
         cfg["chaos_faults"] = True
+    if args.compile_cache_dir is not None:
+        cfg["compile_cache_dir"] = args.compile_cache_dir
+    if args.plan_buckets is not None:
+        cfg["plan_buckets"] = args.plan_buckets
 
     from pilosa_tpu.server.node import ServerNode
     node = ServerNode(
@@ -211,6 +225,8 @@ def cmd_server(args) -> int:
         hedge_delay_ms=float(cfg["hedge_delay_ms"]),
         hedge_budget_pct=float(cfg["hedge_budget_pct"]),
         chaos_faults=bool(cfg["chaos_faults"]),
+        compile_cache_dir=str(cfg["compile_cache_dir"]) or None,
+        plan_buckets=str(cfg["plan_buckets"]) or "pow2",
     )
     node.open()  # starts the (single) serve loop in the background
     print(f"pilosa-tpu serving at {node.address}", file=sys.stderr)
@@ -623,7 +639,13 @@ def cmd_generate_config(args) -> int:
           'hedge-delay-ms = 0.0\n'
           'hedge-budget-pct = 5.0\n'
           '# chaos fault injection route (tests only; never production)\n'
-          '# chaos-faults = false')
+          '# chaos-faults = false\n'
+          '# persistent XLA compile cache ("" = <data-dir>/compile-cache,\n'
+          '# "off" disables)\n'
+          'compile-cache-dir = ""\n'
+          '# plan-shape bucketing: "pow2" reuses compiled kernels across\n'
+          '# shard counts, "none" pads only to the device mesh\n'
+          'plan-buckets = "pow2"')
     return 0
 
 
@@ -689,6 +711,13 @@ def main(argv: list[str] | None = None) -> int:
                         "only; never on production nodes)")
     s.add_argument("--trace-endpoint", default="",
                    help="OTLP/HTTP collector URL for trace export")
+    s.add_argument("--compile-cache-dir", default=None,
+                   help="persistent XLA compile cache directory "
+                        '("" = <data-dir>/compile-cache, "off" disables)')
+    s.add_argument("--plan-buckets", choices=("pow2", "none"), default=None,
+                   help="plan-shape bucketing policy: pow2 rounds stack "
+                        "heights to power-of-two buckets so new shard "
+                        "counts reuse compiled kernels (default pow2)")
     s.add_argument("--config", default=None)
     s.set_defaults(fn=cmd_server)
 

@@ -65,6 +65,11 @@ _BITMAP_CALLS = frozenset(
     {"Row", "Range", "Difference", "Intersect", "Union", "Xor", "Not", "Shift"})
 
 
+def _wrap_result(r):
+    """Default finisher for execute_async's dispatch paths: a resolved
+    scalar becomes the single-call results list."""
+    return [r]
+
 
 @dataclass
 class ExecOptions:
@@ -300,6 +305,15 @@ class Executor:
                 if idx is not None and self.planner.supports(
                         q.calls[0].children[0]):
                     fast = (q, idx)
+            elif (len(q.calls) == 1
+                  and q.calls[0].name in ("Sum", "Min", "Max")):
+                # BSI aggregates dispatch async too: device program
+                # enqueued now, base fold applied when the batcher wave
+                # lands — same shape as the Count path below.
+                idx = self.holder.index(index_name)
+                if idx is not None and self.planner.supports_aggregate(
+                        idx, q.calls[0]):
+                    fast = (q, idx)
         if fast is None:
             try:
                 fut.set_result(self.execute(index_name, query, shards, opt,
@@ -324,7 +338,26 @@ class Executor:
                     fut.set_result(hit)
                     return fut
             call = self._translate_call(idx, q.calls[0])
-            if shards:
+            finish = _wrap_result  # Count: resolve to [int]
+            if call.name in ("Sum", "Min", "Max"):
+                field_name, _ = call.string_arg("field")
+                base = idx.field(field_name).bsi_group.base
+                name = call.name
+
+                def finish(pair, _b=base, _n=name):  # noqa: F811
+                    total, cnt = pair
+                    if cnt == 0:
+                        return [ValCount()]
+                    if _n == "Sum":
+                        return [ValCount(total + cnt * _b, cnt)]
+                    return [ValCount(total + _b, cnt)]
+
+                if name == "Sum":
+                    inner = self.planner.dispatch_sum(idx, call, shards)
+                else:
+                    inner = self.planner.dispatch_min_max(
+                        idx, call, shards, name == "Min")
+            elif shards:
                 fn, arrays = self.planner.prepare_count(
                     idx, call.children[0], shards)
                 if raw is not None:
@@ -354,7 +387,7 @@ class Executor:
 
         def _done(f):
             try:
-                results = [f.result()]
+                results = finish(f.result())
             except Exception as e:
                 fut.set_exception(e)
                 return

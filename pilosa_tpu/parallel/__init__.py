@@ -7,7 +7,8 @@ whole PQL call tree compiles to ONE XLA program, and cross-shard reductions
 (Count/Sum/TopN merges) become ICI collectives inside that program.
 """
 
+from pilosa_tpu.parallel import compile_cache
 from pilosa_tpu.parallel.mesh import make_mesh, shard_spec
 from pilosa_tpu.parallel.planner import MeshPlanner
 
-__all__ = ["make_mesh", "shard_spec", "MeshPlanner"]
+__all__ = ["make_mesh", "shard_spec", "MeshPlanner", "compile_cache"]

@@ -1,0 +1,125 @@
+"""Persistent XLA compilation cache wiring.
+
+Every kernel the planner compiles — stack assembly, fused count, BSI
+aggregates — is a pure function of padded array shapes, so a restarted
+node re-deriving the exact same programs pays full trace+compile cost
+for zero new information. JAX ships an on-disk compilation cache that
+memoizes backend_compile across processes; this module turns it on
+under the holder's data directory and exposes deterministic hit/miss
+counters so warmup, /debug/vars, bench.py, and CI can all assert the
+cache actually did its job instead of trusting wall-clock deltas.
+
+The JAX knobs are process-global, so ``enable`` is idempotent: the
+first call fixes the directory, later calls (second ServerNode in one
+test process) just attach additional stats sinks. Defaults are tuned
+for this workload: the stock ``min_compile_time_secs`` of 1.0 would
+skip every kernel we have (they compile in milliseconds on CPU), so
+both persistence thresholds are dropped to zero. All failures are
+swallowed — a node must boot even on a read-only filesystem or a JAX
+build without the cache.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_EVENT_HIT = "/jax/compilation_cache/cache_hits"
+_EVENT_REQUEST = "/jax/compilation_cache/compile_requests_use_cache"
+
+_lock = threading.Lock()
+_enabled_dir: str | None = None
+_listener_installed = False
+_counters = {"hits": 0, "requests": 0}
+# External Stats objects (ServerNode.stats) that mirror the counters so
+# they surface on /debug/vars without the node polling this module.
+_sinks: list = []
+
+
+def _listener(event: str, **kwargs) -> None:
+    if event == _EVENT_HIT:
+        name = "compileCache.hits"
+        key = "hits"
+    elif event == _EVENT_REQUEST:
+        name = "compileCache.requests"
+        key = "requests"
+    else:
+        return
+    with _lock:
+        _counters[key] += 1
+        sinks = list(_sinks)
+    for s in sinks:
+        try:
+            s.count(name, 1)
+        except Exception:
+            pass  # a broken sink must not poison compilation
+
+
+def enable(cache_dir: str, stats=None) -> bool:
+    """Point JAX's persistent compilation cache at ``cache_dir``.
+
+    Returns True when the cache is active (this call or a prior one).
+    ``stats`` (a Stats-protocol object) is registered as a counter sink
+    either way. Never raises.
+    """
+    global _enabled_dir, _listener_installed
+    if stats is not None:
+        with _lock:
+            if stats not in _sinks:
+                _sinks.append(stats)
+    if not cache_dir:
+        return _enabled_dir is not None
+    with _lock:
+        already = _enabled_dir
+    if already is not None:
+        return True
+    try:
+        import os
+
+        import jax
+        from jax._src import monitoring
+
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # The stock thresholds (1.0 s / small-entry floor) exist for
+        # giant ML programs; our kernels compile in milliseconds and
+        # every one of them is on the cold path, so persist them all.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                          -1)
+        # JAX initializes its cache singleton at most once per process,
+        # on the first compile. Anything that compiled before this call
+        # (module-import constant folding, another subsystem's jit)
+        # froze it with an empty path — reset so the next compile
+        # re-initializes against our directory.
+        from jax._src import compilation_cache
+
+        compilation_cache.reset_cache()
+        with _lock:
+            if not _listener_installed:
+                monitoring.register_event_listener(_listener)
+                _listener_installed = True
+            _enabled_dir = cache_dir
+        return True
+    except Exception:
+        return False
+
+
+def stats() -> dict:
+    """Snapshot: {'enabled', 'dir', 'hits', 'requests'}."""
+    with _lock:
+        return {
+            "enabled": _enabled_dir is not None,
+            "dir": _enabled_dir or "",
+            "hits": _counters["hits"],
+            "requests": _counters["requests"],
+        }
+
+
+def detach(stats_obj) -> None:
+    """Drop a previously attached stats sink (node close)."""
+    with _lock:
+        try:
+            _sinks.remove(stats_obj)
+        except ValueError:
+            pass

@@ -62,10 +62,15 @@ class MeshPlanner:
     DEFAULT_CACHE_BYTES = 4 << 30
 
     def __init__(self, holder, mesh=None,
-                 max_cache_bytes: int = DEFAULT_CACHE_BYTES):
+                 max_cache_bytes: int = DEFAULT_CACHE_BYTES,
+                 bucket_policy: str = "pow2"):
         self.holder = holder
         self.mesh = mesh if mesh is not None else make_mesh()
         self.n_devices = int(np.prod(self.mesh.devices.shape))
+        #: plan-shape bucketing policy ("pow2" | "none"): stack heights
+        #: round up to power-of-two buckets so a never-seen shard count
+        #: dispatches into an already-compiled program (see _pad).
+        self.bucket_policy = bucket_policy
         #: LRU of (index, field, view, row_id, shards) ->
         #: (epoch, gens, [S, W] device array); bounded by max_cache_bytes.
         #: Epoch-stamped: a hit is ONE integer compare against the index's
@@ -258,8 +263,19 @@ class MeshPlanner:
         """Global (sum-of-base-offsets, count) in one device program; the
         executor applies the BSI base (reference fragment.sum :1111 under
         executeSum :406)."""
+        return self.dispatch_sum(idx, c, shards).result()
+
+    def dispatch_sum(self, idx: Index, c: Call, shards: list[int]):
+        """Async Sum: enqueue the device program and return a
+        Future[(total, count)]. The host fold runs on the batcher's
+        resolver thread when the transfer wave lands, so the calling
+        thread is free to plan/reduce other work — the executor syncs
+        only at result materialization."""
+        from concurrent.futures import Future
         if not shards:
-            return 0, 0
+            fut: Future = Future()
+            fut.set_result((0, 0))
+            return fut
         _, exists, sign, stack, filt, depth = self._bsi_inputs(idx, c, shards)
         cnt, pos, neg = self._replicate_small(
             *bsi_ops.sum_counts(exists, sign, stack, filt, depth))
@@ -267,20 +283,34 @@ class MeshPlanner:
         # copies pipeline, so total latency is ~one transfer round-trip
         # instead of three sequential ones (r2's 3x sum latency).
         _copy_async(cnt, pos, neg)
-        count = int(np.asarray(cnt, dtype=np.int64).sum())
-        pos = np.asarray(pos, dtype=np.int64).sum(axis=-1)
-        neg = np.asarray(neg, dtype=np.int64).sum(axis=-1)
-        total = sum((1 << i) * (int(pos[i]) - int(neg[i]))
-                    for i in range(depth))
-        return total, count
+
+        def fold(cnt_host):
+            count = int(cnt_host.astype(np.int64).sum())
+            p = np.asarray(pos, dtype=np.int64).sum(axis=-1)
+            n = np.asarray(neg, dtype=np.int64).sum(axis=-1)
+            total = sum((1 << i) * (int(p[i]) - int(n[i]))
+                        for i in range(depth))
+            return total, count
+
+        return self.batcher.submit(cnt, fold)
 
     def execute_min_max(self, idx: Index, c: Call, shards: list[int],
                         is_min: bool):
         """Global (value, count) pre-base: every shard's extremum computed
         in one stacked program (the shape-polymorphic bit-serial descent of
         ops.bsi), host-folded with the reference's smaller/larger rule."""
+        return self.dispatch_min_max(idx, c, shards, is_min).result()
+
+    def dispatch_min_max(self, idx: Index, c: Call, shards: list[int],
+                         is_min: bool):
+        """Async Min/Max: Future[(value, count)] pre-base; like
+        dispatch_sum, the per-shard fold rides the batcher's resolver
+        thread instead of blocking the dispatching thread."""
+        from concurrent.futures import Future
         if not shards:
-            return 0, 0
+            fut: Future = Future()
+            fut.set_result((0, 0))
+            return fut
         _, exists, sign, stack, filt, depth = self._bsi_inputs(idx, c, shards)
         cons_cnt, alt_cnt, a, b = _agg_min_max(exists, sign, stack, filt,
                                                depth, is_min)
@@ -290,27 +320,33 @@ class MeshPlanner:
         # One pipelined transfer wave for all eight outputs (r2 paid ~8
         # sequential round-trips here: Min was 2.5x slower than Sum).
         _copy_async(cons_cnt, alt_cnt, *a, *b)
-        cons_cnt = np.asarray(cons_cnt)
-        alt_cnt = np.asarray(alt_cnt)
-        # lo/hi stay scalar when no magnitude bit reached their half
-        # (e.g. hi for depth<=32); broadcast to per-shard vectors.
-        a = tuple(np.broadcast_to(np.asarray(x), cons_cnt.shape) for x in a)
-        b = tuple(np.broadcast_to(np.asarray(x), cons_cnt.shape) for x in b)
-        best_val, best_cnt = 0, 0
-        for s in range(len(shards)):
-            if cons_cnt[s] == 0:
-                continue
-            if alt_cnt[s] > 0:
-                v = bsi_ops._join_u64(a[0][s], a[1][s])
-                cnt = int(a[2][s])
-                v = -v if is_min else v
-            else:
-                v = bsi_ops._join_u64(b[0][s], b[1][s])
-                cnt = int(b[2][s])
-                v = v if is_min else -v
-            if best_cnt == 0 or (v < best_val if is_min else v > best_val):
-                best_val, best_cnt = v, cnt
-        return best_val, best_cnt
+        n_shards = len(shards)
+
+        def fold(cons_host):
+            cc = cons_host
+            ac = np.asarray(alt_cnt)
+            # lo/hi stay scalar when no magnitude bit reached their half
+            # (e.g. hi for depth<=32); broadcast to per-shard vectors.
+            av = tuple(np.broadcast_to(np.asarray(x), cc.shape) for x in a)
+            bv = tuple(np.broadcast_to(np.asarray(x), cc.shape) for x in b)
+            best_val, best_cnt = 0, 0
+            for s in range(n_shards):
+                if cc[s] == 0:
+                    continue
+                if ac[s] > 0:
+                    v = bsi_ops._join_u64(av[0][s], av[1][s])
+                    cnt = int(av[2][s])
+                    v = -v if is_min else v
+                else:
+                    v = bsi_ops._join_u64(bv[0][s], bv[1][s])
+                    cnt = int(bv[2][s])
+                    v = v if is_min else -v
+                if best_cnt == 0 or (v < best_val if is_min
+                                     else v > best_val):
+                    best_val, best_cnt = v, cnt
+            return best_val, best_cnt
+
+        return self.batcher.submit(cons_cnt, fold)
 
     # ------------------------------------------------------------------
     # TopN batched counts. Filterless: each fragment's generation-cached
@@ -502,7 +538,9 @@ class MeshPlanner:
             return {"bytes": self._cache_bytes,
                     "budget_bytes": self.max_cache_bytes,
                     "entries": len(self._stack_cache),
-                    "evictions": self._cache_evictions}
+                    "evictions": self._cache_evictions,
+                    "bucket_policy": self.bucket_policy,
+                    "programs": len(self._fn_cache)}
 
     # ------------------------------------------------------------------
     # tree → structural signature + leaf list
@@ -630,7 +668,19 @@ class MeshPlanner:
     # ------------------------------------------------------------------
 
     def _pad(self, s: int) -> int:
-        return pad_to_multiple(s, self.n_devices)
+        """Stack height for ``s`` shards. Always a multiple of
+        n_devices (mesh layout contract); under the default "pow2"
+        bucket policy the per-device multiple also rounds up to the
+        next power of two, collapsing the space of distinct [S_pad, W]
+        program shapes to O(log S). Padding rows are zero blocks —
+        bit-identical results, because every consumer either sums
+        popcounts (zero rows contribute 0) or slices only the real
+        shard slots (execute_bitmap, the Min/Max host fold, TopN)."""
+        s_pad = pad_to_multiple(s, self.n_devices)
+        if self.bucket_policy == "pow2" and s_pad > 0:
+            m = s_pad // self.n_devices
+            s_pad = (1 << (m - 1).bit_length()) * self.n_devices
+        return s_pad
 
     def _gens(self, index_name: str, field_name: str, view: str,
               shards: tuple) -> tuple:
@@ -877,14 +927,35 @@ class MeshPlanner:
         self._fn_cache[full_sig] = fn
         return fn
 
+    #: last measured bench A/B (BENCH_r05 ``pallas_vs_xla``): the Pallas
+    #: pair-count delivered 0.415x the XLA-fused path, so "auto" mode
+    #: resolves to XLA until a bench run records a ratio > 1.
+    PALLAS_VS_XLA_MEASURED = 0.415
+
     def _pallas_count_enabled(self) -> bool:
+        """A/B-driven kernel selection. PILOSA_TPU_PALLAS_COUNT:
+        "1" forces Pallas (measurement runs), "auto" consults the
+        recorded bench ratio (PILOSA_TPU_PALLAS_VS_XLA overrides the
+        baked-in measurement) and picks Pallas only when it actually
+        won, anything else keeps the XLA-fused default. Both code paths
+        stay live either way — bench.py re-measures the ratio per run."""
         import os as _os
 
         import jax as _jax
 
         from pilosa_tpu.ops import pallas_kernels as pk
-        return (_os.environ.get("PILOSA_TPU_PALLAS_COUNT", "") == "1"
-                and pk.available() and _jax.default_backend() == "tpu"
+        mode = _os.environ.get("PILOSA_TPU_PALLAS_COUNT", "")
+        if mode == "auto":
+            try:
+                ratio = float(_os.environ.get("PILOSA_TPU_PALLAS_VS_XLA", "")
+                              or self.PALLAS_VS_XLA_MEASURED)
+            except ValueError:
+                ratio = self.PALLAS_VS_XLA_MEASURED
+            if ratio <= 1.0:
+                return False
+        elif mode != "1":
+            return False
+        return (pk.available() and _jax.default_backend() == "tpu"
                 and self.n_devices == 1)
 
     def _pallas_count_program(self, sig: tuple):

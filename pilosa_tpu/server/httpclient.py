@@ -2,17 +2,37 @@
 
 Reference: http/client.go:37 (queries via POST /index/{i}/query with
 remote=true, fragment sync via /internal/fragment/*, messages via
-/internal/cluster/message). JSON bodies; stdlib urllib, no dependencies.
+/internal/cluster/message). JSON bodies; stdlib http.client, no
+dependencies.
+
+Connections are persistent (HTTP/1.1 keep-alive) and pooled per
+(scheme, host, port): the per-request TCP handshake + slow-start was a
+fixed tax on every cluster leg (the reference uses Go's pooling
+http.Transport for the same reason). The pool is shared across threads
+behind one short-critical-section lock so the failure detector can
+invalidate a peer's idle sockets for EVERY thread; a reused socket that
+the peer closed while idle gets ONE transparent retry on a fresh
+connection — only when the failure proves the request never reached
+application code.
+
+Liveness probes never ride the pool: a probe must test the peer's
+ability to ACCEPT connections, and a cached socket only proves the
+socket itself still works. A peer whose listener died (crash, restart,
+failover to a new process on the same address) can keep old sockets
+half-alive long after it stopped being the node at that address — so a
+failed probe also bumps the peer's pool epoch, closing its idle
+connections and preventing in-flight ones from being returned.
 """
 
 from __future__ import annotations
 
+import http.client
 import json
 import random
+import socket
+import threading
 import time
-import urllib.error
 import urllib.parse
-import urllib.request
 from typing import Any
 
 import numpy as np
@@ -45,6 +65,93 @@ RETRY_503_ATTEMPTS = 3
 RETRY_BASE_DELAY = 0.1
 RETRY_MAX_DELAY = 5.0
 
+#: connection failures that, on a REUSED socket, mean the peer closed it
+#: while idle — the request never reached application code, so one
+#: transparent retry on a fresh connection is safe for any method.
+_STALE_CONN_ERRORS = (http.client.RemoteDisconnected,
+                      http.client.BadStatusLine,
+                      http.client.CannotSendRequest,
+                      ConnectionResetError,
+                      BrokenPipeError)
+
+
+class _ConnPool:
+    """Shared keep-alive pool: {(scheme, host, port): idle connections}.
+
+    A checked-out connection is owned exclusively by the borrowing
+    thread (http.client serializes one request at a time), so the lock
+    only guards the idle lists — a dict pop/append, nanoseconds next to
+    a network round-trip.
+
+    Each peer key carries an *epoch*. ``invalidate`` bumps it and closes
+    the idle connections; a connection checked out under an older epoch
+    is closed instead of returned, so a socket that was mid-request to a
+    dead listener can never re-enter the pool.
+    """
+
+    #: idle connections kept per peer — enough for the handful of
+    #: threads (executor legs, syncer, prober) that talk to one peer
+    #: concurrently without hoarding sockets.
+    MAX_IDLE_PER_PEER = 8
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._idle: dict[tuple, list[http.client.HTTPConnection]] = {}
+        self._epoch: dict[tuple, int] = {}
+
+    def get(self, key: tuple):
+        """-> (idle connection or None, current epoch for the key)."""
+        with self._lock:
+            epoch = self._epoch.get(key, 0)
+            conns = self._idle.get(key)
+            return (conns.pop() if conns else None), epoch
+
+    def put(self, key: tuple, conn, epoch: int) -> None:
+        with self._lock:
+            if epoch == self._epoch.get(key, 0):
+                lst = self._idle.setdefault(key, [])
+                if len(lst) < self.MAX_IDLE_PER_PEER:
+                    lst.append(conn)
+                    return
+        # Epoch advanced while this connection was in flight (the peer
+        # failed a liveness probe), or the peer's idle list is full.
+        try:
+            conn.close()
+        except Exception:
+            pass
+
+    def invalidate(self, key: tuple) -> None:
+        with self._lock:
+            self._epoch[key] = self._epoch.get(key, 0) + 1
+            conns = self._idle.pop(key, [])
+        for conn in conns:
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+    def close_all(self) -> None:
+        with self._lock:
+            idle, self._idle = self._idle, {}
+            for key in idle:
+                self._epoch[key] = self._epoch.get(key, 0) + 1
+        for conns in idle.values():
+            for conn in conns:
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+
+
+def _split_url(url: str) -> tuple[str, str, int, str]:
+    parts = urllib.parse.urlsplit(url)
+    scheme = parts.scheme or "http"
+    port = parts.port or (443 if scheme == "https" else 80)
+    path = parts.path or "/"
+    if parts.query:
+        path += "?" + parts.query
+    return scheme, parts.hostname or "", port, path
+
 
 class HTTPInternalClient:
     """Implements the InternalClient protocol against peer HTTP servers."""
@@ -52,6 +159,7 @@ class HTTPInternalClient:
     def __init__(self, timeout: float = 30.0, ca_cert: str | None = None,
                  skip_verify: bool | None = None):
         self._ssl_ctx = None
+        self._pool = _ConnPool()
         self.timeout = timeout
         self.ca_cert = ca_cert
         #: Optional BreakerRegistry (cluster.breaker). When set, every
@@ -105,6 +213,69 @@ class HTTPInternalClient:
             raise DeadlineExceededError("deadline expired before remote call")
         return max(0.05, min(self.timeout, rem))
 
+    def _http(self, url: str, method: str = "GET",
+              body: bytes | None = None, headers: dict | None = None,
+              timeout: float | None = None):
+        """One request over a pooled keep-alive connection.
+
+        Returns (status, response-headers Message, body bytes). Raises
+        the OSError family on connection problems (socket timeouts
+        included) — callers map those to ConnectionError with the peer
+        id attached. A reused socket the peer closed while idle gets one
+        transparent fresh-connection retry; a fresh connection's failure
+        is real and propagates.
+        """
+        scheme, host, port, path = _split_url(url)
+        key = (scheme, host, port)
+        if timeout is None:
+            timeout = self.timeout
+        while True:
+            conn, epoch = self._pool.get(key)
+            reused = conn is not None
+            if conn is None:
+                if scheme == "https":
+                    conn = http.client.HTTPSConnection(
+                        host, port, timeout=timeout, context=self._ctx(url))
+                else:
+                    conn = http.client.HTTPConnection(host, port,
+                                                      timeout=timeout)
+            else:
+                conn.timeout = timeout
+                if conn.sock is not None:
+                    conn.sock.settimeout(timeout)
+            try:
+                conn.request(method, path, body=body, headers=headers or {})
+                resp = conn.getresponse()
+                data = resp.read()
+            except _STALE_CONN_ERRORS:
+                conn.close()
+                if reused:
+                    continue  # idle socket died under us; retry fresh
+                raise
+            except BaseException:
+                # Timeouts and dial failures are real; so is any error
+                # mid-response. Never return a half-used connection to
+                # the pool.
+                conn.close()
+                raise
+            if resp.will_close:
+                conn.close()
+            else:
+                try:
+                    # Cluster legs are latency-bound small messages:
+                    # never let Nagle hold a reply back (~40 ms).
+                    conn.sock.setsockopt(socket.IPPROTO_TCP,
+                                         socket.TCP_NODELAY, 1)
+                except OSError:
+                    pass
+                self._pool.put(key, conn, epoch)
+            return resp.status, resp.msg, data
+
+    def close(self) -> None:
+        """Close every pooled idle connection; in-flight checkouts are
+        closed on return (their epoch is stale)."""
+        self._pool.close_all()
+
     def _request_raw(self, node: Node, method: str, path: str,
                      body: bytes | None = None,
                      accept: str | None = None,
@@ -123,52 +294,19 @@ class HTTPInternalClient:
         attempt = 0
         try:
             while True:
-                req = urllib.request.Request(self._url(node, path), data=body,
-                                             method=method)
-                if body is not None:
-                    req.add_header("Content-Type", content_type)
-                if accept is not None:
-                    req.add_header("Accept", accept)
-                from pilosa_tpu.obs.tracing import inject_http_headers
                 headers: dict = {}
+                if body is not None:
+                    headers["Content-Type"] = content_type
+                if accept is not None:
+                    headers["Accept"] = accept
+                from pilosa_tpu.obs.tracing import inject_http_headers
                 inject_http_headers(headers)
                 _inject_deadline(headers)
-                for k, v in headers.items():
-                    req.add_header(k, v)
                 try:
-                    with urllib.request.urlopen(
-                            req, timeout=self._deadline_timeout(),
-                            context=self._ctx(req.full_url)) as resp:
-                        if self.breakers is not None:
-                            self.breakers.record_success(node.id)
-                        return (resp.read(),
-                                resp.headers.get("Content-Type", ""))
-                except urllib.error.HTTPError as e:
-                    # The peer is alive but rejected the request —
-                    # application error, NOT a connection failure
-                    # (failover must not trigger, and the breaker must
-                    # not feed: a shedding peer is healthy, just busy).
-                    if self.breakers is not None:
-                        self.breakers.record_success(node.id)
-                    detail = e.read().decode(errors="replace")
-                    if e.code == 404:
-                        raise LookupError(f"{node.id}: {detail}") from e
-                    retry_after = None
-                    if e.code == 503:
-                        try:
-                            retry_after = float(e.headers.get("Retry-After"))
-                        except (TypeError, ValueError):
-                            retry_after = None
-                        if retry_503 and attempt < RETRY_503_ATTEMPTS:
-                            delay = self._backoff_delay(attempt, retry_after)
-                            if delay is not None:
-                                time.sleep(delay)
-                                attempt += 1
-                                continue
-                    raise NodeHTTPError(
-                        e.code, f"node {node.id} HTTP {e.code}: {detail}",
-                        retry_after=retry_after) from e
-                except (urllib.error.URLError, OSError) as e:
+                    status, msg, data = self._http(
+                        self._url(node, path), method, body, headers,
+                        timeout=self._deadline_timeout())
+                except OSError as e:
                     # Connection failures AND deadline overruns (socket
                     # timeout surfaces as OSError) both feed the breaker:
                     # a peer too slow to answer within budget is as
@@ -177,6 +315,34 @@ class HTTPInternalClient:
                         self.breakers.record_failure(node.id)
                     raise ConnectionError(
                         f"node {node.id} unreachable: {e}") from e
+                if status < 400:
+                    if self.breakers is not None:
+                        self.breakers.record_success(node.id)
+                    return data, msg.get("Content-Type", "") or ""
+                # The peer is alive but rejected the request —
+                # application error, NOT a connection failure
+                # (failover must not trigger, and the breaker must
+                # not feed: a shedding peer is healthy, just busy).
+                if self.breakers is not None:
+                    self.breakers.record_success(node.id)
+                detail = data.decode(errors="replace")
+                if status == 404:
+                    raise LookupError(f"{node.id}: {detail}")
+                retry_after = None
+                if status == 503:
+                    try:
+                        retry_after = float(msg.get("Retry-After"))
+                    except (TypeError, ValueError):
+                        retry_after = None
+                    if retry_503 and attempt < RETRY_503_ATTEMPTS:
+                        delay = self._backoff_delay(attempt, retry_after)
+                        if delay is not None:
+                            time.sleep(delay)
+                            attempt += 1
+                            continue
+                raise NodeHTTPError(
+                    status, f"node {node.id} HTTP {status}: {detail}",
+                    retry_after=retry_after)
         except (ConnectionError, NodeHTTPError, LookupError):
             raise  # breaker outcome already recorded above
         except BaseException:
@@ -342,41 +508,39 @@ class HTTPInternalClient:
         self._request(node, "POST", path, data)
 
     def fetch_fragment(self, node, index, field, view, shard) -> bytes:
-        req = urllib.request.Request(self._url(
+        url = self._url(
             node, f"/internal/fragment/data?index={index}&field={field}"
-                  f"&view={view}&shard={shard}"))
+                  f"&view={view}&shard={shard}")
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout,
-                                        context=self._ctx(req.full_url)) as resp:
-                return resp.read()
-        except urllib.error.HTTPError as e:
-            raise LookupError(f"{node.id}: {e.read().decode(errors='replace')}")
-        except (urllib.error.URLError, OSError) as e:
+            status, _, data = self._http(url)
+        except OSError as e:
             raise ConnectionError(f"node {node.id} unreachable: {e}") from e
+        if status >= 400:
+            raise LookupError(f"{node.id}: {data.decode(errors='replace')}")
+        return data
 
     def fetch_fragment_chunks(self, node, index, field, view, shard):
         """Streamed fragment transfer: yields bounded roaring blobs via
         the after-row cursor, so neither side ever materializes a whole
         multi-GB fragment (reference WriteTo/ReadFrom tar stream,
-        fragment.go:2436-2557)."""
+        fragment.go:2436-2557). Every chunk rides the same pooled
+        connection — the per-chunk handshake used to dominate small
+        tail chunks."""
         after = 0
         while True:
-            req = urllib.request.Request(self._url(
+            url = self._url(
                 node, f"/internal/fragment/data?index={index}"
                       f"&field={field}&view={view}&shard={shard}"
-                      f"&after={after}"))
+                      f"&after={after}")
             try:
-                with urllib.request.urlopen(
-                        req, timeout=self.timeout,
-                        context=self._ctx(req.full_url)) as resp:
-                    data = resp.read()
-                    next_row = resp.headers.get("X-Pilosa-Next-Row", "")
-            except urllib.error.HTTPError as e:
-                raise LookupError(
-                    f"{node.id}: {e.read().decode(errors='replace')}")
-            except (urllib.error.URLError, OSError) as e:
+                status, msg, data = self._http(url)
+            except OSError as e:
                 raise ConnectionError(
                     f"node {node.id} unreachable: {e}") from e
+            if status >= 400:
+                raise LookupError(
+                    f"{node.id}: {data.decode(errors='replace')}")
+            next_row = msg.get("X-Pilosa-Next-Row") or ""
             yield data
             if not next_row:
                 return
@@ -389,16 +553,33 @@ class HTTPInternalClient:
     PROBE_TIMEOUT = 2.0
 
     def probe(self, node) -> None:
+        """Liveness probe on a FRESH connection, never a pooled one.
+
+        A pooled socket only proves that one socket still works — the
+        probe's job is to prove the peer still *accepts* connections. A
+        crashed-or-restarted listener can leave old keep-alive sockets
+        talking to a stale process on the same address; on probe failure
+        the peer's pooled connections are invalidated so data legs can't
+        keep riding them either.
+        """
         url = self._url(node, "/version")
+        scheme, host, port, path = _split_url(url)
+        timeout = min(self.PROBE_TIMEOUT, self.timeout)
+        if scheme == "https":
+            conn = http.client.HTTPSConnection(
+                host, port, timeout=timeout, context=self._ctx(url))
+        else:
+            conn = http.client.HTTPConnection(host, port, timeout=timeout)
         try:
-            with urllib.request.urlopen(
-                    url, timeout=min(self.PROBE_TIMEOUT, self.timeout),
-                    context=self._ctx(url)):
-                pass
-        except urllib.error.HTTPError:
-            pass  # alive but unhappy still counts as alive
-        except (urllib.error.URLError, OSError) as e:
+            # Any HTTP status counts: alive but unhappy is still alive.
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            resp.read()
+        except OSError as e:
+            self._pool.invalidate((scheme, host, port))
             raise ConnectionError(f"node {node.id} unreachable: {e}") from e
+        finally:
+            conn.close()
 
     def indirect_probe(self, via, target) -> bool:
         """Ask ``via`` to probe ``target`` on our behalf (memberlist's
@@ -410,10 +591,11 @@ class HTTPInternalClient:
                                     "port": target.uri.port})
         url = self._url(via, f"/internal/probe?{q}")
         try:
-            with urllib.request.urlopen(
-                    url, timeout=min(2 * self.PROBE_TIMEOUT, self.timeout),
-                    context=self._ctx(url)) as resp:
-                return bool(json.loads(resp.read() or b"{}").get("ok"))
+            status, _, data = self._http(
+                url, timeout=min(2 * self.PROBE_TIMEOUT, self.timeout))
+            if status >= 400:
+                return False
+            return bool(json.loads(data or b"{}").get("ok"))
         except (OSError, ValueError):
             return False
 

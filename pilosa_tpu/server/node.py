@@ -9,6 +9,7 @@ runs the anti-entropy ticker.
 
 from __future__ import annotations
 
+import os
 import threading
 
 from pilosa_tpu.cluster.cluster import STATE_NORMAL, Cluster
@@ -77,7 +78,9 @@ class ServerNode:
                  hedge: bool = False,
                  hedge_delay_ms: float = 0.0,
                  hedge_budget_pct: float = 5.0,
-                 chaos_faults: bool = False):
+                 chaos_faults: bool = False,
+                 compile_cache_dir: str | None = None,
+                 plan_buckets: str = "pow2"):
         host, _, port = bind.partition(":")
         self.host, self.port = host or "127.0.0.1", int(port or 10101)
         # Node identity IS the address — member ids are built the same
@@ -143,11 +146,27 @@ class ServerNode:
             index_listener = self.dirty.attach
         self.holder = Holder(fragment_listener=self._broadcast_shard,
                              index_listener=index_listener)
+        # Persistent XLA compilation cache: pointed at disk BEFORE the
+        # planner exists, so its very first jit compile already reads
+        # through the cache — a restarted node reuses every kernel
+        # prior runs compiled. None/"" resolves to <data-dir>/
+        # compile-cache (nodes without a data dir stay memory-only);
+        # "off" disables explicitly.
+        if not compile_cache_dir:
+            compile_cache_dir = (os.path.join(data_dir, "compile-cache")
+                                 if data_dir else "")
+        self.compile_cache_dir = "" if compile_cache_dir == "off" \
+            else compile_cache_dir
         planner = None
         if use_planner:
+            if self.compile_cache_dir:
+                from pilosa_tpu.parallel import compile_cache
+                compile_cache.enable(self.compile_cache_dir,
+                                     stats=self.stats)
             try:
                 from pilosa_tpu.parallel import MeshPlanner
-                planner = MeshPlanner(self.holder)
+                planner = MeshPlanner(self.holder,
+                                      bucket_policy=plan_buckets)
             except Exception:
                 planner = None
         self.executor = Executor(self.holder, cluster=self.cluster,
@@ -655,6 +674,14 @@ class ServerNode:
         if self.executor.planner is not None:
             self._save_observed_traffic()
             self.executor.planner.close()
+        # The compile-cache counter sink holds a reference to our stats
+        # object; drop it so short-lived embedded/test nodes don't pile
+        # up in the module-level sink list.
+        try:
+            from pilosa_tpu.parallel import compile_cache
+            compile_cache.detach(self.stats)
+        except Exception:
+            pass
         if self.store is not None:
             self.store.close()
 

@@ -1,0 +1,123 @@
+"""Persistent compile cache: a "second boot" must load compiled
+programs from disk instead of recompiling.
+
+The two-boot cycle is simulated in-process: ``jax.clear_caches()``
+drops every in-memory jit executable (exactly what a restart loses)
+while the on-disk cache survives, so re-running the same computation
+must produce cache *hits* — the deterministic signal the cold-start CI
+job and warmup report on.
+
+The JAX cache knobs are process-global, so these tests share one cache
+directory for the whole module and assert on counter deltas.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pilosa_tpu.parallel import compile_cache
+
+
+@pytest.fixture(scope="module")
+def cache_dir(tmp_path_factory):
+    import pathlib
+
+    d = tmp_path_factory.mktemp("compile-cache")
+    assert compile_cache.enable(str(d), stats=None)
+    # The JAX cache dir is process-global and first-caller-wins; in a
+    # full-suite run an earlier test's ServerNode may have enabled it
+    # already — assert against whatever directory is actually live.
+    return pathlib.Path(compile_cache.stats()["dir"])
+
+
+def _cache_files(d):
+    return [p for p in d.rglob("*") if p.is_file()]
+
+
+def test_enable_reports_state(cache_dir):
+    st = compile_cache.stats()
+    assert st["enabled"]
+    assert st["dir"] == str(cache_dir)
+
+
+def _popcount_sum(x):
+    bits = jnp.unpackbits(x.view(jnp.uint8), axis=-1)
+    return bits.sum()
+
+
+def test_second_boot_hits_disk_cache(cache_dir):
+    # The cache key covers the lowered computation, which includes the
+    # jit name — so "reboot" by re-jitting the SAME function, exactly
+    # what a restarted planner does when it re-traces its kernels.
+    x = jnp.asarray(np.arange(64, dtype=np.uint32))
+    first = int(jax.jit(_popcount_sum)(x))
+    assert _cache_files(cache_dir), "first boot must persist programs"
+    before = compile_cache.stats()
+
+    # "Restart": drop every in-memory executable, keep the disk cache.
+    jax.clear_caches()
+
+    second = int(jax.jit(_popcount_sum)(x))
+    after = compile_cache.stats()
+    assert second == first
+    assert after["hits"] > before["hits"], (before, after)
+    assert after["requests"] > before["requests"]
+
+
+def test_stats_sink_fanout(cache_dir):
+    class Sink:
+        def __init__(self):
+            self.counts = {}
+
+        def count(self, name, n):
+            self.counts[name] = self.counts.get(name, 0) + n
+
+    sink = Sink()
+    assert compile_cache.enable(str(cache_dir), stats=sink)
+    try:
+        def double(x):
+            return x * 2
+
+        y = jnp.asarray([1.0, 2.0])
+        jax.jit(double)(y)
+        jax.clear_caches()
+        jax.jit(double)(y)
+        assert sink.counts.get("compileCache.hits", 0) > 0
+        assert sink.counts.get("compileCache.requests", 0) > 0
+    finally:
+        compile_cache.detach(sink)
+
+
+def test_enable_without_dir_is_noop_query(cache_dir):
+    # Passing an empty dir never flips state; it just answers whether
+    # the cache is already on.
+    assert compile_cache.enable("") is True
+
+
+def test_planner_second_boot_reuses_programs(cache_dir):
+    """End to end: a fresh MeshPlanner (new node, same machine) re-traces
+    its kernels and the persistent cache serves them from disk."""
+    from pilosa_tpu.config import SHARD_WIDTH
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.exec import Executor
+    from pilosa_tpu.parallel import MeshPlanner, make_mesh
+
+    mesh = make_mesh()
+
+    def boot():
+        h = Holder()
+        idx = h.create_index("i")
+        f = idx.create_field("f")
+        f.import_bits([1] * 6, [s * SHARD_WIDTH + 3 for s in range(6)])
+        ex = Executor(h, planner=MeshPlanner(h, mesh))
+        return ex.execute("i", "Count(Row(f=1))")
+
+    first = boot()
+    before = compile_cache.stats()
+    jax.clear_caches()
+    second = boot()
+    after = compile_cache.stats()
+    assert second == first == [6]
+    assert after["hits"] > before["hits"], (before, after)
