@@ -968,6 +968,112 @@ def bench_cluster(extra):
 
 
 # ---------------------------------------------------------------------------
+# config 6b: plan-keyed result cache — hit/miss economics + dashboard qps
+# ---------------------------------------------------------------------------
+
+
+def bench_cache(extra):
+    """Result-cache economics on the repeated-dashboard workload: a
+    fixed panel of read queries re-served by a 2-node cluster while a
+    writer churns ONE shard. Hits must be order(s)-of-magnitude cheaper
+    than the cold path, and selective (per-shard) invalidation must
+    keep the hit ratio high despite the write churn."""
+    from pilosa_tpu.cluster.harness import LocalCluster
+    from pilosa_tpu.config import SHARD_WIDTH
+    from pilosa_tpu.parallel import MeshPlanner, make_mesh
+
+    n_shards = 64
+    cols = n_shards * SHARD_WIDTH
+    rng = np.random.default_rng(31)
+    lc = LocalCluster(2, planner_factory=lambda i: None)
+    for cn in lc.nodes:
+        cn.executor.planner = MeshPlanner(cn.holder, make_mesh())
+    lc.create_index("d")
+    lc.create_field("d", "a")
+    lc.create_field("d", "b")
+    cl0 = lc.nodes[0].cluster
+    groups = cl0.shards_by_node(cl0.nodes, "d", list(range(n_shards)))
+    node_by_id = {cn.id: cn for cn in lc.nodes}
+    n_bits = 2_000_000
+    for fld, n_rows in (("a", 4), ("b", 8)):
+        rows = rng.integers(0, n_rows, n_bits).astype(np.uint64)
+        colsv = _rand_positions(rng, n_bits, cols)
+        shard_of = (colsv // np.uint64(SHARD_WIDTH)).astype(np.int64)
+        for node_id, shs in groups.items():
+            mask = np.isin(shard_of, shs)
+            node_by_id[node_id].handle_import_request(
+                "d", fld, rows=rows[mask], cols=colsv[mask])
+    for cn in lc.nodes:
+        cn.dirty.flush_now()
+
+    panel = [
+        "Count(Row(a=1))",
+        "Count(Intersect(Row(a=1), Row(b=2)))",
+        "TopN(a, n=5)",
+        "Count(Union(Row(a=0), Row(b=3)))",
+        "Count(Row(b=1))",
+    ]
+    for q in panel:  # warm: populate coordinator + remote-leg caches
+        lc.query("d", q)
+
+    # hit vs miss service time on the heaviest panel query
+    q = panel[1]
+    _, hit_p50, _ = _timer(lambda: lc.query("d", q), N_LAT)
+    _, miss_p50, _ = _timer(lambda: lc.query("d", q, cache=False),
+                            max(5, N_LAT // 3))
+    extra["cache_hit_p50_ms"] = round(hit_p50, 4)
+    extra["cache_miss_p50_ms"] = round(miss_p50, 3)
+    extra["cache_hit_speedup"] = round(miss_p50 / max(hit_p50, 1e-9), 1)
+
+    # repeated dashboard, cached vs cold, same workload both times
+    def dashboard():
+        for qq in panel:
+            lc.query("d", qq)
+
+    def dashboard_cold():
+        for qq in panel:
+            lc.query("d", qq, cache=False)
+
+    qps, _, _ = _timer(dashboard, N_LAT, threads=4)
+    extra["cache_dashboard_qps"] = round(qps * len(panel), 1)
+    qps_c, _, _ = _timer(dashboard_cold, max(5, N_LAT // 3), threads=4)
+    extra["cache_dashboard_cold_qps"] = round(qps_c * len(panel), 1)
+    extra["cache_dashboard_qps_gain"] = round(qps / max(qps_c, 1e-9), 1)
+
+    # churn series: one shard takes a write every 4th refresh. Full-span
+    # panel entries invalidate on the coordinator (their stamp covers
+    # the churned shard), but the UNAFFECTED node's leg cache stays
+    # valid, so the refresh is cheaper than fully cold — the per-shard
+    # selectivity payoff in cluster form.
+    ex0 = lc[0].executor
+    h0, m0 = ex0.result_cache.hits, ex0.result_cache.misses
+    churn_shard = 63
+    churn_owner = node_by_id[cl0.shard_nodes("d", churn_shard)[0].id]
+    tick = [0]
+
+    def dashboard_churn():
+        for qq in panel:
+            lc.query("d", qq)
+        tick[0] += 1
+        if tick[0] % 4 == 0:
+            churn_owner.holder.field("d", "a").set_bit(
+                1, churn_shard * SHARD_WIDTH + tick[0])
+            churn_owner.dirty.flush_now()
+
+    qps_w, _, _ = _timer(dashboard_churn, N_LAT, threads=4)
+    extra["cache_dashboard_churn_qps"] = round(qps_w * len(panel), 1)
+    hits = ex0.result_cache.hits - h0
+    misses = ex0.result_cache.misses - m0
+    extra["cache_dashboard_churn_hit_ratio"] = round(
+        hits / max(1, hits + misses), 3)
+    extra["cache_bytes"] = ex0.result_cache.total_bytes
+
+    assert extra["cache_hit_speedup"] >= 10, \
+        f"hit p50 must be >=10x faster than miss: {extra['cache_hit_speedup']}"
+    assert qps > qps_c, "cached dashboard qps must beat the cold path"
+
+
+# ---------------------------------------------------------------------------
 # config 7: backup / restore throughput
 # ---------------------------------------------------------------------------
 
@@ -1140,8 +1246,8 @@ def main() -> None:
 
     want = (set(c.strip() for c in CONFIGS.split(","))
             if CONFIGS != "all"
-            else {"star", "topn", "bsi", "time", "cluster", "oversub",
-                  "backup", "overload"})
+            else {"star", "topn", "bsi", "time", "cluster", "cache",
+                  "oversub", "backup", "overload"})
     extra: dict = {"backend": jax.default_backend(),
                    "devices": len(jax.devices())}
 
@@ -1174,6 +1280,7 @@ def main() -> None:
         qps, cpu_qps = bench_star_trace(extra)
     for name, fn in (("topn", bench_topn), ("bsi", bench_bsi),
                      ("time", bench_time), ("cluster", bench_cluster),
+                     ("cache", bench_cache),
                      ("oversub", bench_oversubscribed),
                      ("backup", bench_backup),
                      ("overload", bench_overload)):

@@ -1,0 +1,31 @@
+"""Plan-keyed result cache with epoch invalidation.
+
+The layer between request handling (server/) and execution (exec/):
+read-only query results keyed by a canonical plan signature and
+validated by mutation-epoch stamps, so invalidation is a compare at
+lookup time — no explicit invalidation fan-out exists anywhere.
+
+- signature:    canonical plan text + cache key construction
+- result_cache: byte-accounted LRU partitioned per tenant, TTL backstop
+- remote:       per-(index, shard) epochs observed from remote legs
+- tenant:       request-scoped tenant identity (X-API-Key or index)
+"""
+
+from pilosa_tpu.cache.remote import RemoteEpochTable
+from pilosa_tpu.cache.result_cache import ResultCache, estimate_result_size
+from pilosa_tpu.cache.signature import plan_signature
+from pilosa_tpu.cache.tenant import (
+    current_tenant,
+    reset_current_tenant,
+    set_current_tenant,
+)
+
+__all__ = [
+    "RemoteEpochTable",
+    "ResultCache",
+    "estimate_result_size",
+    "plan_signature",
+    "current_tenant",
+    "reset_current_tenant",
+    "set_current_tenant",
+]

@@ -100,6 +100,12 @@ _DEFAULTS = {
     # never-seen shard count dispatches into an already-compiled
     # kernel; "none" pads only to the device-mesh multiple.
     "plan_buckets": "pow2",
+    # Plan-keyed result cache budget, MB (0 disables) and TTL backstop,
+    # seconds (0 = epoch-invalidation only). The TTL exists for the
+    # cross-node staleness window (a lost index-dirty broadcast), not
+    # as the primary invalidation mechanism.
+    "result_cache_mb": 64,
+    "result_cache_ttl": 0.0,
 }
 
 
@@ -187,6 +193,10 @@ def cmd_server(args) -> int:
         cfg["compile_cache_dir"] = args.compile_cache_dir
     if args.plan_buckets is not None:
         cfg["plan_buckets"] = args.plan_buckets
+    if args.result_cache_mb is not None:
+        cfg["result_cache_mb"] = args.result_cache_mb
+    if args.result_cache_ttl is not None:
+        cfg["result_cache_ttl"] = args.result_cache_ttl
 
     from pilosa_tpu.server.node import ServerNode
     node = ServerNode(
@@ -227,6 +237,8 @@ def cmd_server(args) -> int:
         chaos_faults=bool(cfg["chaos_faults"]),
         compile_cache_dir=str(cfg["compile_cache_dir"]) or None,
         plan_buckets=str(cfg["plan_buckets"]) or "pow2",
+        result_cache_mb=int(cfg["result_cache_mb"]),
+        result_cache_ttl=float(cfg["result_cache_ttl"]),
     )
     node.open()  # starts the (single) serve loop in the background
     print(f"pilosa-tpu serving at {node.address}", file=sys.stderr)
@@ -645,7 +657,11 @@ def cmd_generate_config(args) -> int:
           'compile-cache-dir = ""\n'
           '# plan-shape bucketing: "pow2" reuses compiled kernels across\n'
           '# shard counts, "none" pads only to the device mesh\n'
-          'plan-buckets = "pow2"')
+          'plan-buckets = "pow2"\n'
+          '# plan-keyed result cache: budget in MB (0 disables) and TTL\n'
+          '# backstop in seconds (0 = epoch invalidation only)\n'
+          'result-cache-mb = 64\n'
+          'result-cache-ttl = 0.0')
     return 0
 
 
@@ -718,6 +734,12 @@ def main(argv: list[str] | None = None) -> int:
                    help="plan-shape bucketing policy: pow2 rounds stack "
                         "heights to power-of-two buckets so new shard "
                         "counts reuse compiled kernels (default pow2)")
+    s.add_argument("--result-cache-mb", type=int, default=None,
+                   help="plan-keyed result cache budget, MB "
+                        "(default 64; 0 disables)")
+    s.add_argument("--result-cache-ttl", type=float, default=None,
+                   help="result cache TTL backstop, seconds "
+                        "(default 0 = epoch invalidation only)")
     s.add_argument("--config", default=None)
     s.set_defaults(fn=cmd_server)
 

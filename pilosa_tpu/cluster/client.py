@@ -26,6 +26,14 @@ class InternalClient(Protocol):
         """Execute PQL on a peer (http: POST /index/{i}/query?remote=true)."""
         ...
 
+    def query_node_meta(self, node: Node, index: str, query: str,
+                        shards: list[int] | None,
+                        remote: bool) -> tuple[list[Any], dict]:
+        """query_node plus the peer's shard-epoch vector (read on the
+        peer BEFORE its leg executed) — the coordinator result cache's
+        cross-node freshness stamp."""
+        ...
+
     def fragment_blocks(self, node: Node, index: str, field: str, view: str,
                         shard: int) -> dict[int, bytes]:
         """Checksum blocks of a peer fragment (anti-entropy)."""
@@ -65,6 +73,9 @@ class NopClient:
     issue them)."""
 
     def query_node(self, node, index, query, shards, remote):
+        raise RuntimeError("nop client cannot query remote nodes")
+
+    def query_node_meta(self, node, index, query, shards, remote):
         raise RuntimeError("nop client cannot query remote nodes")
 
     def fragment_blocks(self, node, index, field, view, shard):
@@ -111,6 +122,9 @@ class LocalClient:
         return peer
 
     def query_node(self, node, index, query, shards, remote=True):
+        return self.query_node_meta(node, index, query, shards, remote)[0]
+
+    def query_node_meta(self, node, index, query, shards, remote=True):
         if self.breakers is None:
             return self._query_node(node, index, query, shards, remote)
         self.breakers.check(node.id)
@@ -139,7 +153,12 @@ class LocalClient:
         return result
 
     def _query_node(self, node, index, query, shards, remote=True):
+        """Returns (results, shard-epoch vector) — the serialization
+        boundary carries the peer's epochs like the HTTP wire does."""
         peer = self._peer(node)
+        handle = getattr(peer, "handle_query_meta", None)
+        if handle is None:  # bare test double: no epoch reporting
+            handle = lambda *a: (peer.handle_query(*a), {})  # noqa: E731
         # Cross the serialization boundary the way the HTTP transport
         # does (X-Deadline, server/httpclient.py): don't dispatch an
         # already-expired query, and hand the peer a RE-DERIVED token
@@ -162,11 +181,11 @@ class LocalClient:
                         f"node {node.id} timed out (slow-peer fault)")
             _time.sleep(delay)
         if dl is None:
-            return peer.handle_query(index, query, shards, remote)
+            return handle(index, query, shards, remote)
         dl.check()
         token = qos_deadline.set_current_deadline(dl.rederive())
         try:
-            return peer.handle_query(index, query, shards, remote)
+            return handle(index, query, shards, remote)
         finally:
             qos_deadline.reset_current_deadline(token)
 

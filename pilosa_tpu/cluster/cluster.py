@@ -94,6 +94,11 @@ class Cluster:
         #: and the first success wins (Dean & Barroso hedged requests).
         self.hedge = None
         self._hedge_pool = None
+        #: fn(index, node_id, {shard: epoch}) — remote query legs report
+        #: the serving node's shard-epoch vector here (the executor's
+        #: RemoteEpochTable.observe); the cross-node half of result
+        #: cache stamps. None = nobody caches, skip the bookkeeping.
+        self.epoch_sink = None
 
     #: shared fan-out pool size — bounds total in-flight remote
     #: sub-queries, not per-query fan-out.
@@ -378,8 +383,24 @@ class Cluster:
         def run_remote(node_id: str, node_shards: list[int]):
             node = self.node_by_id(node_id)
             t0 = time.perf_counter()
-            res = _with_trace(lambda: self.client.query_node(
-                node, idx.name, pql, node_shards, remote=True)[0])
+
+            def go():
+                # The meta path carries the peer's shard-epoch vector for
+                # the coordinator's cache stamps — but instance-level
+                # query_node overrides (test fault-injection hooks) must
+                # keep intercepting the fan-out, so it only runs on a
+                # pristine client.
+                meta = getattr(self.client, "query_node_meta", None)
+                if meta is None or "query_node" in self.client.__dict__:
+                    return self.client.query_node(
+                        node, idx.name, pql, node_shards, remote=True)[0]
+                results, epochs = meta(node, idx.name, pql, node_shards,
+                                       remote=True)
+                if self.epoch_sink is not None and epochs:
+                    self.epoch_sink(idx.name, node_id, epochs)
+                return results[0]
+
+            res = _with_trace(go)
             if self.hedge is not None:
                 # Successful remote legs feed the p95 the hedge delay
                 # derives from.

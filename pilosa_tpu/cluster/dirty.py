@@ -36,16 +36,24 @@ class DirtyBroadcaster:
         self.window = self.DEFAULT_WINDOW if window is None else window
         self._lock = threading.Lock()
         self._last_sent: dict[str, float] = {}
-        self._pending: set[str] = set()
+        #: index -> shard set mutated since the last flush; None means an
+        #: index-wide (shardless) bump happened and the broadcast must
+        #: floor-bump the whole index on peers.
+        self._pending: dict[str, set[int] | None] = {}
+        #: index -> its Epoch, for reading shard vectors at flush time.
+        self._epochs: dict[str, object] = {}
         self._timer: threading.Timer | None = None
         self._closed = False
 
     def attach(self, idx) -> None:
         """Subscribe to an index's data epoch (Holder.index_listener)."""
-        idx.epoch.subscribe(lambda name=idx.name: self.mark(name))
+        self._epochs[idx.name] = idx.epoch
+        idx.epoch.subscribe(
+            lambda shard=None, name=idx.name: self.mark(name, shard))
 
-    def mark(self, index_name: str) -> None:
-        """A local write bumped this index's epoch."""
+    def mark(self, index_name: str, shard: int | None = None) -> None:
+        """A local write bumped this index's epoch (for ``shard``, or
+        index-wide when None)."""
         if self._closed:
             return
         now = time.monotonic()
@@ -53,14 +61,22 @@ class DirtyBroadcaster:
             if self._closed:  # re-check under the lock: close() races
                 return
             if index_name in self._pending:
-                return  # a flush is already scheduled
+                # A flush is already scheduled: just widen its payload.
+                cur = self._pending[index_name]
+                if cur is not None:
+                    if shard is None:
+                        self._pending[index_name] = None
+                    else:
+                        cur.add(int(shard))
+                return
             last = self._last_sent.get(index_name, -1e9)
             if now - last >= self.window:
                 self._last_sent[index_name] = now
                 delay = 0.0
             else:
                 delay = (last + self.window) - now
-            self._pending.add(index_name)
+            self._pending[index_name] = (None if shard is None
+                                         else {int(shard)})
             self._schedule(delay)
 
     def _schedule(self, delay: float) -> None:
@@ -75,14 +91,28 @@ class DirtyBroadcaster:
 
     def _flush(self) -> None:
         with self._lock:
-            names = sorted(self._pending)
+            pending = dict(self._pending)
             self._pending.clear()
             self._timer = None
             now = time.monotonic()
-            for n in names:
+            for n in pending:
                 self._last_sent[n] = now
-        for name in names:
-            msg = {"type": "index-dirty", "index": name}
+        for name in sorted(pending):
+            shards = pending[name]
+            msg = {"type": "index-dirty", "index": name,
+                   "sender": self.cluster.local_id}
+            if shards is not None:
+                # Shard detail lets peers bump ONLY the mutated shards
+                # (their plans elsewhere keep cached results), and the
+                # sender's epoch vector gives their coordinator caches an
+                # exact cross-node stamp. A peer that ignores the extra
+                # keys still floor-bumps — wire-compatible both ways.
+                sl = sorted(shards)
+                msg["shards"] = sl
+                ep = self._epochs.get(name)
+                if ep is not None:
+                    msg["shardEpochs"] = {str(s): ep.shard_epoch(s)
+                                          for s in sl}
             for node in self.cluster.nodes:
                 if node.id == self.cluster.local_id or node.state == "DOWN":
                     continue
@@ -107,8 +137,23 @@ class DirtyBroadcaster:
         self._flush()
 
 
-def apply_index_dirty(holder, message: dict) -> None:
-    """Receiver side: bump the local epoch without re-notifying."""
-    idx = holder.index(message.get("index", ""))
-    if idx is not None:
+def apply_index_dirty(holder, message: dict, remote_epochs=None) -> None:
+    """Receiver side: bump the local epoch without re-notifying —
+    per-shard when the message carries shard detail, index-wide floor
+    otherwise (legacy senders). The sender's shard-epoch vector, when
+    present, feeds the executor's RemoteEpochTable so coordinator cache
+    stamps track the writer's exact position."""
+    name = message.get("index", "")
+    idx = holder.index(name)
+    if idx is None:
+        return
+    shards = message.get("shards")
+    if shards:
+        idx.epoch.bump_shards(shards, notify=False)
+    else:
         idx.epoch.bump(notify=False)
+    sender = message.get("sender")
+    epochs = message.get("shardEpochs")
+    if remote_epochs is not None and sender and epochs:
+        remote_epochs.observe(name, sender,
+                              {int(s): int(e) for s, e in epochs.items()})

@@ -70,6 +70,11 @@ class ClusterNode:
         self.cluster = cluster
         self.executor = Executor(self.holder, cluster=cluster,
                                  node_id=node_id, planner=planner)
+        # Remote legs report their shard-epoch vectors back to the
+        # coordinator's RemoteEpochTable (the cross-node half of result
+        # cache stamps). The sink lives on the per-node Cluster because
+        # the LocalClient transport is SHARED across harness nodes.
+        cluster.epoch_sink = self.executor.remote_epochs.observe
         from pilosa_tpu.cluster.translate_sync import ClusterKeyTranslator
         self.translator = ClusterKeyTranslator(self.holder, cluster,
                                                cluster.client)
@@ -116,7 +121,8 @@ class ClusterNode:
             deliver_completion(message)
         elif t == "index-dirty":
             from pilosa_tpu.cluster.dirty import apply_index_dirty
-            apply_index_dirty(self.holder, message)
+            apply_index_dirty(self.holder, message,
+                              self.executor.remote_epochs)
         elif t == "cluster-status":
             from pilosa_tpu.cluster.cleaner import clean_holder
             from pilosa_tpu.cluster.resize import apply_cluster_status
@@ -154,6 +160,19 @@ class ClusterNode:
                      shards: list[int] | None, remote: bool) -> list[Any]:
         opt = ExecOptions(remote=remote)
         return self.executor.execute(index, query, shards=shards, opt=opt)
+
+    def handle_query_meta(self, index: str, query: str,
+                          shards: list[int] | None,
+                          remote: bool) -> tuple[list[Any], dict]:
+        """handle_query plus this node's shard-epoch vector, read BEFORE
+        the leg executes so the report is never fresher than the data in
+        the result — a write landing mid-leg raises the next report and
+        invalidates the coordinator's cached entry."""
+        epochs: dict = {}
+        idx = self.holder.index(index)
+        if idx is not None and shards:
+            epochs = idx.epoch.shard_vector(shards)
+        return self.handle_query(index, query, shards, remote), epochs
 
     def handle_fragment_blocks(self, index, field, view, shard):
         frag = self.holder.fragment(index, field, view, shard)

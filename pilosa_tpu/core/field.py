@@ -196,7 +196,7 @@ class Field:
         #: inputs change (here: BSI bit-depth growth).
         self.schema_epoch = schema_epoch
         self.row_attr_store = row_attr_store or AttrStore(epoch=epoch)
-        self.translate_store = translate_store or TranslateStore()
+        self.translate_store = translate_store or TranslateStore(epoch=epoch)
         self.fragment_listener = fragment_listener
         self.op_writer_factory = op_writer_factory
         self.views: dict[str, View] = {}
@@ -302,7 +302,7 @@ class Field:
         # keep running against the pre-advert shard list. notify=False:
         # this isn't a local write, so no dirty re-broadcast.
         if self.epoch is not None:
-            self.epoch.bump(notify=False)
+            self.epoch.bump_shards(new, notify=False)
 
     def remove_remote_available_shard(self, shard: int) -> None:
         """Forget a remotely-advertised shard (reference
@@ -313,7 +313,7 @@ class Field:
         if int(shard) in self.remote_available_shards:
             self.remote_available_shards.discard(int(shard))
             if self.epoch is not None:
-                self.epoch.bump(notify=False)
+                self.epoch.bump(notify=False, shard=int(shard))
 
     # -- bit ops -----------------------------------------------------------
 
@@ -616,6 +616,7 @@ class Field:
         if n_shards * WORDS_PER_SHARD * 4 > self._SCATTER_MAX_BYTES:
             return False
         merged_any = False
+        touched_shards: set[int] = set()
         try:
             for rid, mask in zip(distinct.tolist(), masks):
                 out = native.scatter_row_blocks(
@@ -641,6 +642,7 @@ class Field:
                                          bit_count=int(counts[shard]),
                                          bump_epoch=False)
                     merged_any = True
+                    touched_shards.add(int(shard))
         finally:
             # ONE shared-epoch bump for the whole batch, not one per
             # shard — including the partial-failure exit (a later row's
@@ -648,7 +650,7 @@ class Field:
             # epoch-stamped caches would otherwise serve pre-import
             # counts for the merged rows.
             if merged_any:
-                self.index_epoch_bump()
+                self.index_epoch_bump(touched_shards)
         return True
 
     def import_values(self, column_ids, values, clear: bool = False) -> None:
@@ -733,6 +735,7 @@ class Field:
         # the chunk be garbage-collected.
         pinned = adopt and int(counts.max()) > DENSE_CUTOFF // 2
         merged_any = False
+        touched_shards: set[int] = set()
         try:
             for shard in shards.tolist():
                 frag = view.create_fragment_if_not_exists(int(shard))
@@ -750,6 +753,7 @@ class Field:
                                          bump_epoch=False,
                                          prefer_dense=pinned)
                     merged_any = True
+                    touched_shards.add(int(shard))
         finally:
             # ONE shared-epoch bump for the whole batch (cache
             # invalidation + dirty broadcast), not one per landed plane
@@ -757,13 +761,20 @@ class Field:
             # rows would otherwise be served stale from epoch-stamped
             # caches.
             if merged_any:
-                self.index_epoch_bump()
+                self.index_epoch_bump(touched_shards)
         return True
 
-    def index_epoch_bump(self) -> None:
+    def index_epoch_bump(self, shards: Iterable[int] | None = None) -> None:
         """One batched index-epoch bump (bulk importers defer per-row
-        bumps here: one cache invalidation + dirty broadcast per batch)."""
-        if self.epoch is not None:
+        bumps here: one cache invalidation + dirty broadcast per batch).
+        ``shards`` tags which shards the batch landed in so plans not
+        touching them keep their cached results; None floor-bumps
+        everything (caller couldn't track the touched set)."""
+        if self.epoch is None:
+            return
+        if shards:
+            self.epoch.bump_shards(shards)
+        else:
             self.epoch.bump()
 
     def import_roaring(self, shard: int, data: bytes, view: str = VIEW_STANDARD,

@@ -121,7 +121,9 @@ class Fragment:
     def _invalidate(self, bump_epoch: bool = True):
         self.generation += 1
         if bump_epoch and self.epoch is not None:
-            self.epoch.bump()
+            # Shard-tagged: plans not touching this shard keep their
+            # cached results (Epoch.max_shard_epoch).
+            self.epoch.bump(shard=self.shard)
         # Stale device blocks would never be re-hit (generation mismatch) but
         # would pin HBM forever; drop them eagerly.
         self._dev_rows.clear()

@@ -41,35 +41,75 @@ class IndexOptions:
 
 
 class Epoch:
-    """Monotonic mutation counter for one index.
+    """Monotonic mutation counter for one index, with per-shard grain.
 
     Bumped by every fragment/attr mutation anywhere under the index; the
     planner's leaf-stack cache and the executor's result cache validate
     with ONE epoch compare instead of walking per-fragment generations
     (the per-query 954-fragment walk was the r2 flagship bottleneck).
 
+    Shard grain: a bump that knows which shard mutated records that
+    shard's position in the global sequence, so a plan touching shards
+    S can stamp itself with ``max_shard_epoch(S)`` — writes to shards
+    OUTSIDE S advance ``value`` but leave that max unchanged, and the
+    plan's cached result survives. A shardless ``bump()`` (schema-ish
+    or index-wide mutations: attrs, key translation, field delete,
+    remote-origin invalidation without shard detail) raises the floor
+    under every shard instead, which also keeps the per-shard dict from
+    accumulating state older than the floor.
+
     Listeners (cluster mode) turn local bumps into index-dirty
     broadcasts so PEER nodes can invalidate their coordinator result
-    caches; remote-triggered bumps pass ``notify=False`` to stop the
-    echo from re-broadcasting forever.
+    caches; listeners are called ``fn(shard)`` with the mutated shard or
+    ``None`` for index-wide bumps. Remote-triggered bumps pass
+    ``notify=False`` to stop the echo from re-broadcasting forever.
     """
 
-    __slots__ = ("_value", "_lock", "_listeners")
+    __slots__ = ("_value", "_floor", "_shards", "_lock", "_listeners")
 
     def __init__(self):
         self._value = 0
+        #: every shard's epoch is at least this (index-wide bumps land here).
+        self._floor = 0
+        #: shard -> sequence position of its last shard-tagged bump.
+        self._shards: dict[int, int] = {}
         self._lock = threading.Lock()
         self._listeners: list = []
 
-    def bump(self, notify: bool = True) -> None:
+    def bump(self, notify: bool = True, shard: int | None = None) -> None:
         with self._lock:
             self._value += 1
+            if shard is None:
+                self._floor = self._value
+                self._shards.clear()  # all <= floor now: drop the detail
+            else:
+                self._shards[shard] = self._value
         if notify:
             for fn in list(self._listeners):
                 try:
-                    fn()
+                    fn(shard)
                 except Exception:
                     pass  # observers never break the write path
+
+    def bump_shards(self, shards: Iterable[int], notify: bool = True) -> None:
+        """One sequence increment covering a whole shard batch (bulk
+        importers: one cache invalidation + one dirty broadcast per
+        batch, not one per shard)."""
+        shards = [int(s) for s in shards]
+        if not shards:
+            return
+        with self._lock:
+            self._value += 1
+            v = self._value
+            for s in shards:
+                self._shards[s] = v
+        if notify:
+            for fn in list(self._listeners):
+                for s in shards:
+                    try:
+                        fn(s)
+                    except Exception:
+                        pass
 
     def subscribe(self, fn) -> None:
         self._listeners.append(fn)
@@ -77,6 +117,33 @@ class Epoch:
     @property
     def value(self) -> int:
         return self._value
+
+    # -- per-shard reads (result-cache stamps) -----------------------------
+
+    def shard_epoch(self, shard: int) -> int:
+        with self._lock:
+            return max(self._shards.get(shard, 0), self._floor)
+
+    def max_shard_epoch(self, shards: Iterable[int]) -> int:
+        """Stamp for a plan touching ``shards``: strictly increases when
+        any of them mutates (its entry moves to the new sequence head),
+        holds still when only other shards do."""
+        with self._lock:
+            m = self._floor
+            get = self._shards.get
+            for s in shards:
+                v = get(s, 0)
+                if v > m:
+                    m = v
+            return m
+
+    def shard_vector(self, shards: Iterable[int]) -> dict[int, int]:
+        """Per-shard epochs for the wire (remote legs report theirs so
+        the coordinator can stamp cross-node cache entries)."""
+        with self._lock:
+            floor = self._floor
+            get = self._shards.get
+            return {int(s): max(get(int(s), 0), floor) for s in shards}
 
 
 _instance_counter = itertools.count(1)
@@ -108,7 +175,7 @@ class Index:
         self._avail_shards_cache: tuple | None = None
         self.fields: dict[str, Field] = {}
         self.column_attr_store = AttrStore(epoch=self.epoch)
-        self.translate_store = TranslateStore()
+        self.translate_store = TranslateStore(epoch=self.epoch)
         self._lock = threading.RLock()
         if self.options.track_existence:
             self._create_existence_field()

@@ -25,9 +25,17 @@ _logger = StandardLogger()
 class TranslateStore:
     """Monotonic id allocator with forward and reverse maps."""
 
-    def __init__(self, path: str | None = None, read_only: bool = False):
+    def __init__(self, path: str | None = None, read_only: bool = False,
+                 epoch=None):
         self.path = path
         self.read_only = read_only
+        #: index mutation Epoch, bumped whenever a NEW mapping lands.
+        #: Cached query results embed translated keys (and the
+        #: ``str(id)`` fallback for ids with no mapping yet), so a
+        #: mapping arriving after a result was cached must invalidate it
+        #: — this was a silent mutating path before the result cache
+        #: keyed on it. Index-wide (floor) bump: keys aren't per-shard.
+        self.epoch = epoch
         self._fwd: dict[str, int] = {}
         self._rev: dict[int, str] = {}
         self._next = 1  # ids start at 1 (boltdb/translate.go sequence)
@@ -56,7 +64,9 @@ class TranslateStore:
             self._next += 1
             self._fwd[key] = id_
             self._rev[id_] = key
-            return id_
+        if self.epoch is not None:
+            self.epoch.bump()  # local allocation: notify (dirty broadcast)
+        return id_
 
     def translate_keys(self, keys, create: bool = True) -> list[int | None]:
         return [self.translate_key(k, create) for k in keys]
@@ -89,11 +99,17 @@ class TranslateStore:
             return sorted((i, k) for i, k in self._rev.items() if i > after_id)
 
     def apply_entries(self, entries) -> None:
+        applied = False
         with self._lock:
             for id_, key in entries:
+                if self._rev.get(id_) != key:
+                    applied = True
                 self._fwd[key] = id_
                 self._rev[id_] = key
                 self._next = max(self._next, id_ + 1)
+        if applied and self.epoch is not None:
+            # Remote-origin sync: invalidate local caches, no re-broadcast.
+            self.epoch.bump(notify=False)
 
     # -- persistence -------------------------------------------------------
 

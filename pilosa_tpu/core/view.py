@@ -75,7 +75,7 @@ class View:
                 # epoch, so it must see this. notify=False — not a data
                 # write.
                 if self.epoch is not None:
-                    self.epoch.bump(notify=False)
+                    self.epoch.bump(notify=False, shard=shard)
                 if self.fragment_listener:
                     self.fragment_listener(self.index, self.field, self.name, shard)
             return frag
@@ -90,7 +90,8 @@ class View:
         with self._lock:
             gone = self.fragments.pop(shard, None) is not None
         if gone and self.epoch is not None:
-            self.epoch.bump(notify=False)  # shard-set memo must see it
+            # shard-set memo must see it
+            self.epoch.bump(notify=False, shard=shard)
         return gone
 
     # -- bit ops -----------------------------------------------------------

@@ -28,6 +28,7 @@ from typing import Any, Callable, Iterable
 
 import numpy as np
 
+from pilosa_tpu.cache.tenant import current_tenant
 from pilosa_tpu.config import SHARD_WIDTH
 from pilosa_tpu.core import timequantum as tq
 from pilosa_tpu.core.field import FIELD_TYPE_BOOL, FIELD_TYPE_INT, FIELD_TYPE_TIME
@@ -87,7 +88,6 @@ class Executor:
 
     #: bounded sizes for the per-executor caches.
     PARSE_CACHE_SIZE = 512
-    RESULT_CACHE_SIZE = 256
     #: prepared entries hold references to leaf stacks (device arrays),
     #: so the bound stays small and stale entries are dropped eagerly —
     #: HBM budgeting lives in the planner's stack cache, and a prepared
@@ -112,15 +112,28 @@ class Executor:
         #: threads; every consumer clones before mutating
         #: (_translate_call clones; Options copies opt).
         self._parse_cache: "OrderedDict[str, Query]" = OrderedDict()
-        #: (index, query, shards, remote) -> (epoch, results). Validated
-        #: against the index mutation epoch, so any write anywhere in the
-        #: index invalidates every cached result for it. The reference's
-        #: analog is the per-fragment rowCache (fragment.go:623); caching
-        #: whole read-only results is the system answer to a device link
-        #: whose per-sync latency dwarfs compute.
-        self._result_cache: "OrderedDict[tuple, tuple[int, list]]" = \
-            OrderedDict()
-        self.result_cache_enabled = result_cache
+        #: plan-signature keyed result cache (pilosa_tpu.cache): entries
+        #: stamp the (schema epoch, max shard epoch over the plan's
+        #: shards, remote shard-epoch rows) they were computed under and
+        #: die by stamp mismatch at lookup — writes to shards OUTSIDE a
+        #: plan leave its entries alive. The reference's analog is the
+        #: per-fragment rowCache (fragment.go:623); caching whole
+        #: read-only results is the system answer to a device link whose
+        #: per-sync latency dwarfs compute. ``result_cache`` accepts a
+        #: shared ResultCache (ServerNode passes its byte-bounded,
+        #: tenant-partitioned one), True for a private default, False/0
+        #: to disable.
+        if result_cache is True:
+            from pilosa_tpu.cache import ResultCache
+            self.result_cache = ResultCache(stats=self.stats)
+        elif not result_cache:
+            self.result_cache = None
+        else:
+            self.result_cache = result_cache
+        #: (index, shard) -> (node, epoch) observed from remote legs and
+        #: index-dirty broadcasts; the cross-node half of cache stamps.
+        from pilosa_tpu.cache import RemoteEpochTable
+        self.remote_epochs = RemoteEpochTable()
         self._cache_lock = threading.Lock()
         #: (index, query text) -> (instance_id, schema_epoch, data epoch,
         #: shards, jitted fn, leaf device arrays, result-cache key): the
@@ -165,16 +178,27 @@ class Executor:
 
         # Cluster mode: coordinator-side caching is safe because every
         # node broadcasts index-dirty on its local writes (the
-        # DirtyBroadcaster bumps peers' epochs), so remote mutations
-        # invalidate this node's entries within the coalesce window +
-        # one control message — the same eventual visibility a remote
-        # write has without any cache.
-        cacheable = (cache and self.result_cache_enabled and raw is not None
-                     and not query.has_writes())
+        # DirtyBroadcaster bumps peers' per-shard epochs), so remote
+        # mutations invalidate this node's entries within the coalesce
+        # window + one control message — the same eventual visibility a
+        # remote write has without any cache. Remote legs additionally
+        # report their exact shard-epoch vectors in-band (belt and
+        # braces against a lost broadcast); the TTL backstop bounds the
+        # residual window.
+        cacheable = (cache and self.result_cache is not None
+                     and raw is not None and not query.has_writes())
         if cacheable:
-            key = self._cache_key(idx, raw, shards, opt)
-            epoch = idx.epoch.value
-            hit = self._cache_get(key, epoch)
+            key = self._cache_key(idx, query, shards, opt)
+            tenant = current_tenant()
+            # Local epochs read BEFORE execution: if a write lands
+            # mid-query the stored stamp is already stale and the entry
+            # dies on its first lookup (never serves post-write state as
+            # fresh; may conservatively recompute).
+            sch = idx.schema_epoch.value
+            loc = idx.epoch.max_shard_epoch(shards)
+            hit = self.result_cache.get(
+                tenant, key,
+                (sch, loc, self.remote_epochs.rows_for(idx.name, shards)))
             if hit is not None:
                 return hit
 
@@ -198,32 +222,27 @@ class Executor:
             results = [self._translate_result(idx, c, r)
                        for c, r in zip(query.calls, results)]
         if cacheable:
-            self._cache_store(key, epoch, results)
+            # Remote rows re-read AFTER the legs: each leg reported the
+            # vector it read on its node BEFORE executing (observed into
+            # remote_epochs during this query), so the stored remote
+            # stamp is exactly as conservative as the pre-exec local one
+            # — and the first cold query already stamps consistently
+            # instead of dying once on the next lookup.
+            self.result_cache.put(
+                tenant, key,
+                (sch, loc, self.remote_epochs.rows_for(idx.name, shards)),
+                results)
         return results
 
-    def _cache_key(self, idx: Index, raw: str, shards: list[int],
+    def _cache_key(self, idx: Index, query: Query, shards: list[int],
                    opt: ExecOptions) -> tuple:
-        return (idx.name, idx.instance_id, raw, tuple(shards), opt.remote,
-                opt.exclude_row_attrs, opt.exclude_columns, opt.column_attrs)
+        from pilosa_tpu.cache.signature import cache_key
+        return cache_key(idx, query, shards, opt)
 
-    def _cache_get(self, key: tuple, epoch: int) -> list | None:
-        with self._cache_lock:
-            hit = self._result_cache.get(key)
-            if hit is not None and hit[0] == epoch:
-                self._result_cache.move_to_end(key)
-                return list(hit[1])
-        return None
-
-    def _cache_store(self, key: tuple, epoch: int, results: list) -> None:
-        # Stamp with the epoch read BEFORE execution: if a write landed
-        # mid-query the stamp is stale and the entry dies on its first
-        # lookup (never serves post-write state as fresh; may
-        # conservatively recompute).
-        with self._cache_lock:
-            self._result_cache[key] = (epoch, list(results))
-            self._result_cache.move_to_end(key)
-            while len(self._result_cache) > self.RESULT_CACHE_SIZE:
-                self._result_cache.popitem(last=False)
+    def _exec_stamp(self, idx: Index, shards: list[int]) -> tuple:
+        """Pre-dispatch freshness stamp for the prepared/async paths."""
+        return (idx.schema_epoch.value, idx.epoch.max_shard_epoch(shards),
+                self.remote_epochs.rows_for(idx.name, shards))
 
     def execute_async(self, index_name: str, query: Query | str,
                       shards: Iterable[int] | None = None,
@@ -269,13 +288,18 @@ class Executor:
                 if (e is not None
                         and ((shards is None and e[8])
                              or (shards is not None and shards == e[3]))):
-                    _, _, epoch, _, fn, arrays, rkey, post, _ = e
+                    _, _, epoch, pshards, fn, arrays, rkey, post, _ = e
                     with self._cache_lock:
                         if (index_name, raw) in self._prepared:
                             self._prepared.move_to_end((index_name, raw))
-                    cacheable = cache and self.result_cache_enabled
+                    cacheable = cache and self.result_cache is not None
                     if cacheable:
-                        hit = self._cache_get(rkey, epoch)
+                        # Stamp + tenant captured NOW: the store runs on
+                        # the batcher thread, which has neither this
+                        # request's contextvars nor pre-dispatch epochs.
+                        stamp = self._exec_stamp(idx, pshards)
+                        tenant = current_tenant()
+                        hit = self.result_cache.get(tenant, rkey, stamp)
                         if hit is not None:
                             fut.set_result(hit)
                             return fut
@@ -284,10 +308,10 @@ class Executor:
                         if cacheable:
                             # Store via the batcher callback; closure
                             # only on the cacheable path.
-                            def post(host, _k=rkey, _e=epoch,  # noqa: E731
-                                     _p=post):
+                            def post(host, _k=rkey, _s=stamp,  # noqa: E731
+                                     _t=tenant, _p=post):
                                 results = _p(host)
-                                self._cache_store(_k, _e, results)
+                                self.result_cache.put(_t, _k, _s, results)
                                 return results
                         # Return the batcher future DIRECTLY: a second
                         # Future + callback chain costs more than the
@@ -328,12 +352,14 @@ class Executor:
             shards = (sorted(idx.available_shards()) if shards is None
                       else list(shards))
             epoch = idx.epoch.value
-            key = self._cache_key(idx, raw, shards, opt) \
+            key = self._cache_key(idx, q, shards, opt) \
                 if raw is not None else None
-            cacheable = (cache and self.result_cache_enabled
+            cacheable = (cache and self.result_cache is not None
                          and raw is not None)
+            stamp = self._exec_stamp(idx, shards) if cacheable else None
+            tenant = current_tenant()
             if cacheable:
-                hit = self._cache_get(key, epoch)
+                hit = self.result_cache.get(tenant, key, stamp)
                 if hit is not None:
                     fut.set_result(hit)
                     return fut
@@ -392,7 +418,8 @@ class Executor:
                 fut.set_exception(e)
                 return
             if cacheable:
-                self._cache_store(key, epoch, results)
+                # stamp/tenant captured pre-dispatch (batcher thread).
+                self.result_cache.put(tenant, key, stamp, results)
             fut.set_result(results)
 
         inner.add_done_callback(_done)

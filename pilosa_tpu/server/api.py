@@ -104,15 +104,30 @@ class API:
         opt = ExecOptions(remote=remote, column_attrs=column_attrs,
                           exclude_row_attrs=exclude_row_attrs,
                           exclude_columns=exclude_columns)
+        epochs = None
+        if remote and shards:
+            # Read BEFORE executing: the reported vector is never
+            # fresher than the data in the result, so a write landing
+            # mid-leg raises the next report and invalidates the
+            # coordinator's cached entry (see cache/remote.py).
+            idx = self.holder.index(index)
+            if idx is not None:
+                epochs = idx.epoch.shard_vector(shards)
         results = self.executor.execute(index, query, shards=shards, opt=opt,
                                         cache=cache)
         if remote:
             # Node-to-node response: typed envelope the coordinator can
-            # decode back to internal results (encoding/proto analog).
+            # decode back to internal results (encoding/proto analog),
+            # stamped with this node's shard-epoch vector.
             from pilosa_tpu.server import wire
+            extra = ({"shardEpochs": {str(s): e for s, e in epochs.items()}}
+                     if epochs else None)
             if accept_frames:
-                return wire.encode_frames(results)
-            return {"results": [wire.encode_result(r) for r in results]}
+                return wire.encode_frames(results, extra=extra)
+            resp = {"results": [wire.encode_result(r) for r in results]}
+            if extra:
+                resp.update(extra)
+            return resp
         resp: dict[str, Any] = {"results": [result_to_json(r) for r in results]}
         if opt.column_attrs:
             resp["columnAttrs"] = self._column_attr_sets(index, results)

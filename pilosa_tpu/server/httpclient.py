@@ -65,6 +65,14 @@ RETRY_503_ATTEMPTS = 3
 RETRY_BASE_DELAY = 0.1
 RETRY_MAX_DELAY = 5.0
 
+def _epoch_vector(raw: dict | None) -> dict:
+    """Normalize a wire shardEpochs payload (JSON string keys) back to
+    the {int shard: int epoch} shape RemoteEpochTable.observe expects."""
+    if not raw:
+        return {}
+    return {int(s): int(e) for s, e in raw.items()}
+
+
 #: connection failures that, on a REUSED socket, mean the peer closed it
 #: while idle — the request never reached application code, so one
 #: transparent retry on a fresh connection is safe for any method.
@@ -424,6 +432,14 @@ class HTTPInternalClient:
 
     def query_node(self, node: Node, index: str, query: str,
                    shards: list[int] | None, remote: bool = True):
+        return self.query_node_meta(node, index, query, shards, remote)[0]
+
+    def query_node_meta(self, node: Node, index: str, query: str,
+                        shards: list[int] | None, remote: bool = True):
+        """(results, shard-epoch vector): the peer stamps its response
+        with the epochs it read before executing (api.py query), which
+        feed the coordinator's RemoteEpochTable for cache stamps. Peers
+        predating the stamp report {} — the cache just misses."""
         path = f"/index/{index}/query?remote={'true' if remote else 'false'}"
         if shards:
             path += "&shards=" + ",".join(str(s) for s in shards)
@@ -448,18 +464,20 @@ class HTTPInternalClient:
                     raise ShardCorruptError() from e
                 raise
             if ctype.startswith(wire.FRAMES_CONTENT_TYPE):
-                return wire.decode_frames(data)
+                results, header = wire.decode_frames_meta(data)
+                return results, _epoch_vector(header.get("shardEpochs"))
             resp = json.loads(data) if data else {}
             if "error" in resp:
                 raise RuntimeError(resp["error"])
-            return [wire.decode_result(r) for r in resp["results"]]
+            return ([wire.decode_result(r) for r in resp["results"]],
+                    _epoch_vector(resp.get("shardEpochs")))
         # Forwarded reads are idempotent POSTs: a shed leg may back off
         # and retry within the deadline budget, same as the remote path.
         resp = self._request(node, "POST", path, query.encode(),
                              retry_503=True)
         if "error" in resp:
             raise RuntimeError(resp["error"])
-        return resp["results"]
+        return resp["results"], _epoch_vector(resp.get("shardEpochs"))
 
     def fragment_blocks(self, node, index, field, view, shard):
         resp = self._request(

@@ -28,7 +28,12 @@ from pilosa_tpu.errors import (
     QueryError,
 )
 from pilosa_tpu.pql import ParseError
+from pilosa_tpu.cache.tenant import (
+    reset_current_tenant,
+    set_current_tenant,
+)
 from pilosa_tpu.qos import (
+    CLASS_INTERNAL,
     DeadlineExceededError,
     QueryShedError,
     QuotaExceededError,
@@ -331,6 +336,19 @@ def _build_routes(api: API):
         fault_slow = getattr(api, "fault_slow_s", 0.0)
         if fault_slow > 0:
             time.sleep(fault_slow)
+        # Result-cache gate: noCache bypasses explicitly; non-remote
+        # INTERNAL-class requests (backups, maintenance sweeps) must not
+        # churn interactive tenants' partitions. Remote fan-out legs
+        # keep caching — per-node caches are what make repeated
+        # cluster dashboards cheap.
+        use_cache = (params.get("noCache") != "true"
+                     and (remote or cls != CLASS_INTERNAL))
+        # Tenant partition: same identity the quota table charges
+        # (X-API-Key, falling back to the index name). Remote legs run
+        # under the default tenant — the coordinator already attributed
+        # the query once.
+        ttoken = set_current_tenant(
+            "" if remote else (params.get("_api_key") or pv["index"]))
         status = "ok"
         t0 = time.perf_counter()
         try:
@@ -358,7 +376,7 @@ def _build_routes(api: API):
                             exclude_columns=params.get(
                                 "excludeColumns") == "true",
                             remote=remote, accept_frames=frames,
-                            cache=params.get("noCache") != "true")
+                            cache=use_cache)
                 else:
                     resp = api.query(
                         pv["index"], body.decode(),
@@ -369,7 +387,7 @@ def _build_routes(api: API):
                         exclude_columns=params.get(
                             "excludeColumns") == "true",
                         remote=remote, accept_frames=frames,
-                        cache=params.get("noCache") != "true")
+                        cache=use_cache)
             except _NOT_FOUND + (ApiMethodNotAllowedError,):
                 status = "error"
                 raise
@@ -391,6 +409,7 @@ def _build_routes(api: API):
                 status = "error"
                 return 400, {"error": str(e)}
         finally:
+            reset_current_tenant(ttoken)
             if dtoken is not None:
                 qos_deadline.reset_current_deadline(dtoken)
             slow_log = getattr(qos_ctl, "slow_log", None)
@@ -473,6 +492,7 @@ def _build_routes(api: API):
         if cluster is not None:
             breakers = getattr(cluster.client, "breakers", None)
             hedge = getattr(cluster, "hedge", None)
+        rcache = getattr(api.executor, "result_cache", None)
         return 200, {
             "admission": qos_ctl.snapshot() if qos_ctl is not None else None,
             "adaptive": (qos_ctl.adaptive.snapshot()
@@ -481,7 +501,25 @@ def _build_routes(api: API):
             "quotas": quotas.snapshot() if quotas is not None else None,
             "breakers": breakers.snapshot() if breakers is not None else None,
             "hedge": hedge.snapshot() if hedge is not None else None,
+            # Cache occupancy next to quota state: a tenant whose quota
+            # looks idle but whose partition is huge is serving from
+            # cache — the two views only make sense together.
+            "cache": rcache.snapshot() if rcache is not None else None,
         }
+
+    def get_debug_cache(pv, params, body):
+        """Result-cache snapshot: global byte/entry occupancy, hit and
+        eviction counters, per-tenant partition sizes, and the remote
+        epoch observations backing cross-node stamps."""
+        rcache = getattr(api.executor, "result_cache", None)
+        remotes = getattr(api.executor, "remote_epochs", None)
+        if rcache is None:
+            return 200, {"enabled": False}
+        snap = rcache.snapshot()
+        snap["enabled"] = True
+        if remotes is not None:
+            snap["remoteEpochs"] = remotes.snapshot()
+        return 200, snap
 
     def post_fault(pv, params, body):
         """Chaos fault injection: currently the slow-peer gray failure
@@ -794,6 +832,7 @@ def _build_routes(api: API):
         (r"/debug/vars", {"GET": get_debug_vars}),
         (r"/debug/slow-queries", {"GET": get_debug_slow_queries}),
         (r"/debug/overload", {"GET": get_debug_overload}),
+        (r"/debug/cache", {"GET": get_debug_cache}),
         (r"/debug/quarantine", {"GET": get_debug_quarantine}),
         (r"/debug/threads", {"GET": get_debug_threads}),
         (r"/debug/profile", {"GET": get_debug_profile}),

@@ -80,7 +80,9 @@ class ServerNode:
                  hedge_budget_pct: float = 5.0,
                  chaos_faults: bool = False,
                  compile_cache_dir: str | None = None,
-                 plan_buckets: str = "pow2"):
+                 plan_buckets: str = "pow2",
+                 result_cache_mb: int = 64,
+                 result_cache_ttl: float = 0.0):
         host, _, port = bind.partition(":")
         self.host, self.port = host or "127.0.0.1", int(port or 10101)
         # Node identity IS the address — member ids are built the same
@@ -169,9 +171,24 @@ class ServerNode:
                                       bucket_policy=plan_buckets)
             except Exception:
                 planner = None
+        # Plan-keyed result cache (pilosa_tpu.cache): byte-bounded,
+        # tenant-partitioned, shared by every consumer on this node.
+        # <= 0 MB disables (the executor then runs every query).
+        self.result_cache = None
+        if result_cache_mb > 0:
+            from pilosa_tpu.cache import ResultCache
+            self.result_cache = ResultCache(
+                max_bytes=int(result_cache_mb) << 20,
+                ttl=result_cache_ttl, stats=self.stats)
         self.executor = Executor(self.holder, cluster=self.cluster,
                                  node_id=self.id, planner=planner,
-                                 stats=self.stats)
+                                 stats=self.stats,
+                                 result_cache=self.result_cache)
+        if self.cluster is not None:
+            # Remote legs report their shard-epoch vectors back here
+            # (cluster.run_remote → RemoteEpochTable) so coordinator
+            # cache stamps stay consistent across nodes.
+            self.cluster.epoch_sink = self.executor.remote_epochs.observe
         self.api = API(self.holder, self.executor, cluster=self.cluster)
         # Handler hooks used by the HTTP router's /internal routes.
         self.api.message_handler = self.handle_message
@@ -715,7 +732,8 @@ class ServerNode:
             deliver_completion(message)
         elif t == "index-dirty":
             from pilosa_tpu.cluster.dirty import apply_index_dirty
-            apply_index_dirty(self.holder, message)
+            apply_index_dirty(self.holder, message,
+                              self.executor.remote_epochs)
         elif t == "cluster-status" and self.cluster is not None:
             from pilosa_tpu.cluster.resize import apply_cluster_status
             apply_cluster_status(self.cluster, message["nodes"],

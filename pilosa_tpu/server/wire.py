@@ -84,7 +84,10 @@ def decode_result(d: dict) -> Any:
 # appears as {"t": "row_frame", "blob": k, "attrs": {...}}.
 
 
-def encode_frames(results: list) -> bytes:
+def encode_frames(results: list, extra: dict | None = None) -> bytes:
+    """``extra`` merges response-level metadata (e.g. ``shardEpochs``,
+    the serving node's pre-execution epoch vector) into the frame
+    header; decoders that don't know the keys ignore them."""
     blobs: list[bytes] = []
     metas: list[dict] = []
     from pilosa_tpu import native
@@ -96,8 +99,10 @@ def encode_frames(results: list) -> bytes:
             blobs.append(native.encode_roaring(cols))
         else:
             metas.append(encode_result(r))
-    header = json.dumps({"results": metas,
-                         "blobs": [len(b) for b in blobs]}).encode()
+    head = {"results": metas, "blobs": [len(b) for b in blobs]}
+    if extra:
+        head.update(extra)
+    header = json.dumps(head).encode()
     return b"".join([_FRAME_MAGIC, struct.pack("<I", len(header)), header]
                     + blobs)
 
@@ -181,11 +186,16 @@ def decode_import(data: bytes) -> dict:
         raise ValueError(f"malformed import frame: {e!r}") from e
 
 
-def decode_frames(data: bytes) -> list[Any]:
+def _decode_header(data: bytes) -> dict:
     if data[:4] != _FRAME_MAGIC:
         raise ValueError("bad frame magic")
     (hlen,) = struct.unpack_from("<I", data, 4)
-    header = json.loads(data[8:8 + hlen].decode())
+    return json.loads(data[8:8 + hlen].decode())
+
+
+def decode_frames(data: bytes) -> list[Any]:
+    header = _decode_header(data)
+    (hlen,) = struct.unpack_from("<I", data, 4)
     off = 8 + hlen
     blobs = []
     for ln in header["blobs"]:
@@ -201,3 +211,12 @@ def decode_frames(data: bytes) -> list[Any]:
         else:
             out.append(decode_result(m))
     return out
+
+
+def decode_frames_meta(data: bytes) -> tuple[list[Any], dict]:
+    """(results, header) — the header exposes response-level metadata
+    (``shardEpochs``) alongside the decoding bookkeeping. Routed through
+    the module-level ``decode_frames`` so call-site instrumentation
+    (tests patch it to assert the frame path was taken) still observes
+    every decode."""
+    return decode_frames(data), _decode_header(data)

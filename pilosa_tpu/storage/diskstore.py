@@ -162,20 +162,23 @@ class DiskStore:
             w.start()
 
     def _attach_stores(self) -> None:
-        """Swap in path-backed attr/translate stores (boltdb/ analog)."""
+        """Swap in path-backed attr/translate stores (boltdb/ analog).
+        Every swapped-in store keeps the index's mutation epoch: attr
+        and key-translation writes on a durable node must invalidate
+        epoch-stamped caches exactly like they do on a memory node."""
         for iname in self.holder.index_names():
             idx = self.holder.index(iname)
             idir = os.path.join(self.data_dir, iname)
             idx.column_attr_store = AttrStore(
-                os.path.join(idir, "column_attrs.jsonl"))
+                os.path.join(idir, "column_attrs.jsonl"), epoch=idx.epoch)
             idx.translate_store = TranslateStore(
-                os.path.join(idir, "translate.jsonl"))
+                os.path.join(idir, "translate.jsonl"), epoch=idx.epoch)
             for fname, f in idx.fields.items():
                 fdir = os.path.join(idir, fname)
                 f.row_attr_store = AttrStore(
-                    os.path.join(fdir, "row_attrs.jsonl"))
+                    os.path.join(fdir, "row_attrs.jsonl"), epoch=idx.epoch)
                 f.translate_store = TranslateStore(
-                    os.path.join(fdir, "translate.jsonl"))
+                    os.path.join(fdir, "translate.jsonl"), epoch=idx.epoch)
 
     def _load_fragments(self) -> None:
         """Walk the data dir; rebuild fragments from snapshot + WAL."""

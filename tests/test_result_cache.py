@@ -1,0 +1,589 @@
+"""Plan-keyed result cache: unit, integration, and generative tests.
+
+Covers the pilosa_tpu.cache subsystem end to end:
+
+- Epoch per-shard semantics (selective invalidation, zero-arg compat);
+- ResultCache byte-accounted LRU, TTL backstop, tenant partitions and
+  fair-share eviction;
+- canonical plan signatures (whitespace/format insensitivity);
+- executor-level per-shard selectivity;
+- the epoch-bump audit for the silent mutating paths (translate-key
+  allocation, attr writes);
+- cluster-mode remote-leg epoch-vector consistency, including the
+  lost-broadcast recovery path;
+- generative cache-on vs cache-off equivalence on a LocalCluster under
+  random interleavings of mutations and queries.
+"""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.cache import ResultCache, estimate_result_size
+from pilosa_tpu.cache.signature import plan_signature
+from pilosa_tpu.config import SHARD_WIDTH
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.core.index import Epoch
+from pilosa_tpu.core.row import Row
+from pilosa_tpu.exec.executor import Executor
+from pilosa_tpu.exec.result import result_to_json
+from pilosa_tpu.pql import parse
+
+
+# -- Epoch: per-shard semantics ---------------------------------------------
+
+def test_epoch_zero_arg_bump_floors_every_shard():
+    e = Epoch()
+    e.bump(shard=3)
+    before = e.shard_epoch(7)
+    e.bump()  # index-wide
+    assert e.shard_epoch(3) > before
+    assert e.shard_epoch(7) > before
+    # every shard reads the same floor after a shardless bump
+    assert e.shard_epoch(3) == e.shard_epoch(7) == e.value
+
+
+def test_epoch_per_shard_bump_is_selective():
+    e = Epoch()
+    base0, base1 = e.shard_epoch(0), e.shard_epoch(1)
+    e.bump(shard=0)
+    assert e.shard_epoch(0) > base0
+    assert e.shard_epoch(1) == base1
+    assert e.max_shard_epoch([1]) == base1
+    assert e.max_shard_epoch([0, 1]) == e.shard_epoch(0)
+
+
+def test_epoch_value_stays_monotonic():
+    e = Epoch()
+    seen = [e.value]
+    e.bump(shard=0)
+    seen.append(e.value)
+    e.bump_shards([1, 2])
+    seen.append(e.value)
+    e.bump()
+    seen.append(e.value)
+    assert seen == sorted(set(seen)), "every bump must advance .value"
+
+
+def test_epoch_bump_shards_single_increment_per_batch():
+    e = Epoch()
+    v0 = e.value
+    e.bump_shards([0, 1, 2, 3])
+    assert e.value == v0 + 1  # one version for the whole batch
+    assert all(e.shard_epoch(s) == v0 + 1 for s in range(4))
+
+
+def test_epoch_listener_receives_shard():
+    e = Epoch()
+    calls = []
+    e.subscribe(lambda shard=None: calls.append(shard))
+    e.bump(shard=5)
+    e.bump()
+    e.bump_shards([1, 2])
+    assert calls == [5, None, 1, 2]
+
+
+def test_epoch_shard_vector():
+    e = Epoch()
+    e.bump(shard=0)
+    e.bump(shard=2)
+    vec = e.shard_vector([0, 1, 2])
+    assert set(vec) == {0, 1, 2}
+    assert vec[2] > vec[0] > vec[1]
+
+
+# -- ResultCache: LRU bytes, TTL, tenants -----------------------------------
+
+def _rows(n_cols):
+    return [Row.from_columns(list(range(n_cols)))]
+
+
+def test_cache_hit_requires_matching_stamp():
+    c = ResultCache(max_bytes=1 << 20)
+    c.put("t", ("k",), (1, 2, ()), [42])
+    assert c.get("t", ("k",), (1, 2, ())) == [42]
+    assert c.get("t", ("k",), (1, 3, ())) is None  # stale stamp
+    # the stale entry was removed on sight, bytes reclaimed
+    assert c.total_bytes == 0
+    assert c.hits == 1 and c.misses == 1
+
+
+def test_cache_lru_byte_accounting_and_eviction():
+    one = estimate_result_size(_rows(64))
+    c = ResultCache(max_bytes=3 * one)
+    for i in range(5):
+        c.put("t", (i,), (0,), _rows(64))
+    assert c.total_bytes <= c.max_bytes
+    assert c.evictions >= 2
+    # oldest entries went first; newest survives
+    assert c.get("t", (4,), (0,)) is not None
+    assert c.get("t", (0,), (0,)) is None
+
+
+def test_cache_reput_sole_entry_keeps_partition():
+    """Regression: re-putting a key that is its partition's ONLY entry
+    must not orphan the partition. Removing the old entry empties the
+    partition (which deletes it); the insert must recreate it instead
+    of raising KeyError on the byte account — two racing threads that
+    both miss and both put hit exactly this path."""
+    c = ResultCache(max_bytes=1 << 20)
+    c.put("t", ("k",), (1,), [1])
+    c.put("t", ("k",), (2,), [2])  # replace the sole entry
+    assert c.get("t", ("k",), (2,)) == [2]
+    snap = c.snapshot()
+    assert snap["entries"] == 1
+    assert snap["tenants"]["t"]["bytes"] == c.total_bytes > 0
+
+
+def test_cache_get_refreshes_lru_position():
+    one = estimate_result_size(_rows(64))
+    c = ResultCache(max_bytes=2 * one + one // 2)
+    c.put("t", ("a",), (0,), _rows(64))
+    c.put("t", ("b",), (0,), _rows(64))
+    assert c.get("t", ("a",), (0,)) is not None  # touch "a"
+    c.put("t", ("c",), (0,), _rows(64))  # evicts the LRU: "b"
+    assert c.get("t", ("a",), (0,)) is not None
+    assert c.get("t", ("b",), (0,)) is None
+
+
+def test_cache_oversized_entry_skipped():
+    c = ResultCache(max_bytes=128)
+    c.put("t", ("big",), (0,), _rows(10_000))
+    assert c.total_bytes == 0
+    assert c.get("t", ("big",), (0,)) is None
+
+
+def test_cache_ttl_backstop():
+    now = [0.0]
+    c = ResultCache(max_bytes=1 << 20, ttl=10.0, clock=lambda: now[0])
+    c.put("t", ("k",), (0,), [1])
+    now[0] = 5.0
+    assert c.get("t", ("k",), (0,)) == [1]
+    now[0] = 11.0
+    assert c.get("t", ("k",), (0,)) is None
+    assert c.total_bytes == 0
+
+
+def test_cache_tenant_isolation():
+    c = ResultCache(max_bytes=1 << 20)
+    c.put("a", ("k",), (0,), [1])
+    assert c.get("b", ("k",), (0,)) is None
+    assert c.get("a", ("k",), (0,)) == [1]
+
+
+def test_cache_fair_share_eviction_protects_light_tenant():
+    one = estimate_result_size(_rows(64))
+    c = ResultCache(max_bytes=4 * one)
+    c.put("light", ("x",), (0,), _rows(64))
+    for i in range(10):  # heavy tenant churns its OWN partition
+        c.put("heavy", (i,), (0,), _rows(64))
+    assert c.get("light", ("x",), (0,)) is not None
+    snap = c.snapshot()
+    assert snap["tenants"]["light"]["entries"] == 1
+    assert snap["evictions"] >= 6
+
+
+def test_cache_snapshot_shape():
+    c = ResultCache(max_bytes=1 << 20)
+    c.put("", ("k",), (0,), [1])
+    c.get("", ("k",), (0,))
+    snap = c.snapshot()
+    for key in ("bytes", "maxBytes", "entries", "hits", "misses",
+                "evictions", "tenants"):
+        assert key in snap
+    assert snap["tenants"]["(default)"]["entries"] == 1
+
+
+def test_cache_stats_counters():
+    from pilosa_tpu.obs import MemoryStats
+    stats = MemoryStats()
+    c = ResultCache(max_bytes=1 << 20, stats=stats)
+    c.put("t", ("k",), (0,), [1])
+    c.get("t", ("k",), (0,))
+    c.get("t", ("missing",), (0,))
+    assert stats.counter_value("cache.hits") == 1
+    assert stats.counter_value("cache.misses") == 1
+
+
+# -- plan signatures ---------------------------------------------------------
+
+def test_signature_normalizes_formatting():
+    a = parse("Count(Row(f=1))")
+    b = parse("Count( Row( f = 1 ) )")
+    assert plan_signature(a) == plan_signature(b)
+
+
+def test_signature_distinguishes_different_plans():
+    assert (plan_signature(parse("Count(Row(f=1))"))
+            != plan_signature(parse("Count(Row(f=2))")))
+    assert (plan_signature(parse("Row(f=1)\nRow(f=2)"))
+            != plan_signature(parse("Row(f=2)\nRow(f=1)")))
+
+
+# -- executor: per-shard selectivity ----------------------------------------
+
+def _seeded_executor(n_shards=2):
+    h = Holder()
+    idx = h.create_index("i")
+    f = idx.create_field("f")
+    rng = np.random.default_rng(7)
+    cols = rng.integers(0, n_shards * SHARD_WIDTH, 1000)
+    f.import_bits(np.ones(1000, dtype=np.int64), cols)
+    return h, idx, Executor(h)
+
+
+def test_executor_caches_and_invalidates():
+    h, idx, ex = _seeded_executor()
+    q = "Count(Row(f=1))"
+    r1 = ex.execute("i", q)
+    r2 = ex.execute("i", q)
+    assert r1 == r2
+    assert ex.result_cache.hits >= 1
+    h.field("i", "f").set_bit(1, 5)
+    r3 = ex.execute("i", q)
+    assert r3 == [r1[0] + 1]
+
+
+def test_executor_write_to_other_shard_keeps_entry():
+    """The selective-invalidation payoff: a write to shard 1 must not
+    evict a plan scoped to shard 0."""
+    h, idx, ex = _seeded_executor()
+    q = "Count(Row(f=1))"
+    ex.execute("i", q, shards=[0])
+    hits0 = ex.result_cache.hits
+    h.field("i", "f").set_bit(1, SHARD_WIDTH + 5)  # shard 1 only
+    ex.execute("i", q, shards=[0])
+    assert ex.result_cache.hits == hits0 + 1, \
+        "shard-0 plan must survive a shard-1 write"
+    # and the same write DOES invalidate a plan that touches shard 1
+    ex.execute("i", q, shards=[1])
+    h.field("i", "f").set_bit(1, SHARD_WIDTH + 6)
+    m0 = ex.result_cache.misses
+    ex.execute("i", q, shards=[1])
+    assert ex.result_cache.misses == m0 + 1
+
+
+def test_executor_cache_disabled():
+    h = Holder()
+    h.create_index("i").create_field("f").set_bit(1, 1)
+    ex = Executor(h, result_cache=False)
+    assert ex.result_cache is None
+    assert ex.execute("i", "Count(Row(f=1))") == [1]
+
+
+def test_executor_cache_flag_bypasses():
+    h, idx, ex = _seeded_executor()
+    q = "Count(Row(f=1))"
+    ex.execute("i", q)
+    hits0 = ex.result_cache.hits
+    ex.execute("i", q, cache=False)
+    assert ex.result_cache.hits == hits0
+
+
+# -- the epoch-bump audit: silent mutating paths ----------------------------
+
+def test_translate_key_allocation_bumps_epoch():
+    """New key allocation changes what Row(f="k") resolves to — it must
+    be visible to cache stamps (the historical silent path)."""
+    h = Holder()
+    idx = h.create_index("i")
+    before = idx.epoch.value
+    idx.translate_store.translate_key("new-key")
+    assert idx.epoch.value > before
+    mid = idx.epoch.value
+    idx.translate_store.translate_key("new-key")  # lookup, not allocation
+    assert idx.epoch.value == mid
+
+
+def test_translate_apply_entries_bumps_epoch():
+    h = Holder()
+    idx = h.create_index("i")
+    before = idx.epoch.value
+    idx.translate_store.apply_entries([(1, "a"), (2, "b")])
+    assert idx.epoch.value > before
+    mid = idx.epoch.value
+    idx.translate_store.apply_entries([(1, "a")])  # no-op replay
+    assert idx.epoch.value == mid
+
+
+def test_attr_writes_bump_epoch():
+    h = Holder()
+    idx = h.create_index("i")
+    f = idx.create_field("f")
+    before = idx.epoch.value
+    f.row_attr_store.set_attrs(1, {"color": "red"})
+    assert idx.epoch.value > before
+    mid = idx.epoch.value
+    idx.column_attr_store.set_attrs(3, {"x": 1})
+    assert idx.epoch.value > mid
+
+
+def test_bulk_import_bumps_every_touched_shard():
+    """Bulk imports merge fragments with bump_epoch=False and settle
+    the epoch afterwards — every touched shard must land exactly one
+    shard-scoped bump; untouched shards keep their cached plans."""
+    h = Holder()
+    idx = h.create_index("i")
+    f = idx.create_field("f")
+    cols = np.arange(0, 4 * SHARD_WIDTH, SHARD_WIDTH // 2)
+    f.import_bits(np.ones(len(cols), dtype=np.int64), cols)
+    before = {s: idx.epoch.shard_epoch(s) for s in range(5)}
+    # steady state: import into shards 0-1 only
+    cols2 = np.arange(0, 2 * SHARD_WIDTH, SHARD_WIDTH // 2)
+    f.import_bits(2 * np.ones(len(cols2), dtype=np.int64), cols2)
+    for s in (0, 1):
+        assert idx.epoch.shard_epoch(s) > before[s], f"shard {s} silent"
+    for s in (2, 3, 4):
+        assert idx.epoch.shard_epoch(s) == before[s], \
+            f"untouched shard {s} must keep its epoch"
+
+
+def test_diskstore_attached_stores_keep_epoch(tmp_path):
+    """DiskStore swaps in persistent attr/translate stores on open;
+    the replacements must stay wired to the index epoch (the second
+    silent path)."""
+    from pilosa_tpu.storage.diskstore import DiskStore
+    h = Holder()
+    idx = h.create_index("i")
+    idx.create_field("f")
+    store = DiskStore(str(tmp_path), h)
+    store.open()
+    try:
+        before = idx.epoch.value
+        idx.translate_store.translate_key("k")
+        assert idx.epoch.value > before
+        mid = idx.epoch.value
+        idx.column_attr_store.set_attrs(1, {"a": 1})
+        assert idx.epoch.value > mid
+        m2 = idx.epoch.value
+        h.field("i", "f").row_attr_store.set_attrs(1, {"b": 2})
+        assert idx.epoch.value > m2
+    finally:
+        store.close()
+
+
+# -- cluster: remote-leg epoch vectors --------------------------------------
+
+def _seed_local_cluster(n=3, n_shards=4, seed=5):
+    from pilosa_tpu.cluster.harness import LocalCluster
+    lc = LocalCluster(n)
+    lc.create_index("i")
+    lc.create_field("i", "f")
+    rng = np.random.default_rng(seed)
+    rows = rng.integers(0, 4, 2000)
+    cols = rng.integers(0, n_shards * SHARD_WIDTH, 2000)
+    for shard in range(n_shards):
+        m = (cols // SHARD_WIDTH) == shard
+        if not m.any():
+            continue
+        node = lc[0].cluster.shard_nodes("i", shard)[0]
+        peer = lc.client.peers[node.id]
+        peer.holder.field("i", "f").import_bits(rows[m], cols[m])
+    for cn in lc.nodes:
+        cn.dirty.flush_now()
+    return lc
+
+
+def _owned_column(lc, node_id, row=1):
+    """A column in a shard whose primary is ``node_id``."""
+    for shard in range(8):
+        if lc[0].cluster.shard_nodes("i", shard)[0].id == node_id:
+            return shard * SHARD_WIDTH + 11
+    raise AssertionError(f"{node_id} owns no shard")
+
+
+def test_cluster_coordinator_cache_hits_and_remote_invalidation():
+    lc = _seed_local_cluster()
+    q = "Count(Row(f=1))"
+    r1 = lc.query("i", q)
+    r2 = lc.query("i", q)
+    assert r1 == r2
+    ex = lc[0].executor
+    assert ex.result_cache.hits >= 1
+    # remote legs populated the coordinator's epoch table
+    assert ex.remote_epochs.snapshot()["entries"] > 0
+    # write on a REMOTE node; its dirty broadcast must invalidate the
+    # coordinator's cached entry
+    col = _owned_column(lc, "node1")
+    lc.client.peers["node1"].holder.field("i", "f").set_bit(1, col)
+    lc[1].dirty.flush_now()
+    r3 = lc.query("i", q)
+    assert r3 == [r1[0] + 1]
+    assert lc.query("i", q) == r3  # and re-caches
+
+
+def test_cluster_lost_broadcast_recovers_via_leg_vectors():
+    """Drop every index-dirty broadcast: the coordinator serves stale
+    (the documented window) until any uncached query re-runs the legs —
+    their response vectors update the RemoteEpochTable, and the stale
+    entry dies on the next lookup."""
+    lc = _seed_local_cluster()
+    orig = lc.client.send_message
+
+    def drop_dirty(node, message):
+        if message.get("type") == "index-dirty":
+            return None
+        return orig(node, message)
+
+    lc.client.send_message = drop_dirty
+    try:
+        q = "Count(Row(f=1))"
+        r1 = lc.query("i", q)
+        col = _owned_column(lc, "node1")
+        lc.client.peers["node1"].holder.field("i", "f").set_bit(1, col)
+        lc[1].dirty.flush_now()  # broadcast dropped on the floor
+        assert lc.query("i", q) == r1, "stale within the lost window"
+        # an uncached pass re-runs the legs and observes fresh vectors
+        fresh = lc.query("i", q, cache=False)
+        assert fresh == [r1[0] + 1]
+        assert lc.query("i", q) == fresh, \
+            "leg-reported vectors must invalidate the stale entry"
+    finally:
+        lc.client.send_message = orig
+
+
+def test_cluster_tenant_contextvar_partitions():
+    from pilosa_tpu.cache.tenant import (
+        reset_current_tenant,
+        set_current_tenant,
+    )
+    lc = _seed_local_cluster()
+    tok = set_current_tenant("alice")
+    try:
+        lc.query("i", "Count(Row(f=1))")
+        lc.query("i", "Count(Row(f=1))")
+    finally:
+        reset_current_tenant(tok)
+    snap = lc[0].executor.result_cache.snapshot()
+    assert "alice" in snap["tenants"]
+
+
+# -- generative equivalence: cache-on vs cache-off --------------------------
+
+def _generative_run(ops, seed, n_nodes=2, n_shards=3):
+    """Random interleaving of mutations and queries; every query's
+    cache-served answer must be bit-identical to a cache-bypassing run
+    at the same instant."""
+    lc = _seed_local_cluster(n=n_nodes, n_shards=n_shards, seed=seed)
+    rng = np.random.default_rng(seed)
+    queries = [
+        "Count(Row(f=1))",
+        "Row(f=2)",
+        "TopN(f, n=3)",
+        "Count(Union(Row(f=0), Row(f=3)))",
+        "Count(Intersect(Row(f=1), Row(f=2)))",
+    ]
+    checked = 0
+    for _ in range(ops):
+        op = rng.random()
+        if op < 0.35:  # mutate through a random node's local holder
+            node = lc.nodes[int(rng.integers(0, n_nodes))]
+            row = int(rng.integers(0, 4))
+            col = int(rng.integers(0, n_shards * SHARD_WIDTH))
+            shard = col // SHARD_WIDTH
+            owner = lc[0].cluster.shard_nodes("i", shard)[0].id
+            f = lc.client.peers[owner].holder.field("i", "f")
+            if rng.random() < 0.8:
+                f.set_bit(row, col)
+            else:
+                f.clear_bit(row, col)
+            if rng.random() < 0.7:  # most writes announce themselves
+                lc.client.peers[owner].dirty.flush_now()
+        else:
+            # flush every pending mark first: equivalence is only
+            # promised once broadcasts are delivered (the undelivered
+            # window is bounded staleness by design, tested above)
+            for cn in lc.nodes:
+                cn.dirty.flush_now()
+            q = queries[int(rng.integers(0, len(queries)))]
+            node = int(rng.integers(0, n_nodes))
+            got = lc.query("i", q, node=node)
+            want = lc.query("i", q, node=node, cache=False)
+            assert ([result_to_json(r) for r in got]
+                    == [result_to_json(r) for r in want]), \
+                f"divergence on {q!r} (seed={seed})"
+            checked += 1
+    assert checked > 0
+
+
+def test_generative_equivalence_small():
+    _generative_run(ops=40, seed=11)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_generative_equivalence_long(seed):
+    _generative_run(ops=150, seed=seed, n_nodes=3, n_shards=4)
+
+
+# -- HTTP surface ------------------------------------------------------------
+
+@pytest.mark.slow
+def test_http_debug_cache_and_internal_exemption():
+    import json
+    import urllib.request
+
+    from pilosa_tpu.server.node import ServerNode
+
+    def req(base, method, path, body=None, headers=None):
+        data = body.encode() if isinstance(body, str) else body
+        r = urllib.request.Request(base + path, data=data, method=method)
+        for k, v in (headers or {}).items():
+            r.add_header(k, v)
+        with urllib.request.urlopen(r, timeout=10) as resp:
+            return resp.status, json.loads(resp.read() or b"{}")
+
+    n = ServerNode(bind="127.0.0.1:0", use_planner=False)
+    n.open()
+    try:
+        base = n.address
+        req(base, "POST", "/index/i", "{}")
+        req(base, "POST", "/index/i/field/f", "{}")
+        req(base, "POST", "/index/i/query", "Set(1, f=1)")
+        # repeated read populates + hits the cache
+        req(base, "POST", "/index/i/query", "Count(Row(f=1))")
+        req(base, "POST", "/index/i/query", "Count(Row(f=1))")
+        _, snap = req(base, "GET", "/debug/cache")
+        assert snap["enabled"] and snap["hits"] >= 1
+        entries = snap["entries"]
+        # INTERNAL-class requests must not populate tenant partitions
+        for _ in range(2):
+            req(base, "POST", "/index/i/query", "Count(Row(f=2))",
+                headers={"X-Qos-Class": "internal"})
+        _, snap2 = req(base, "GET", "/debug/cache")
+        assert snap2["entries"] == entries
+        # tenant partitions keyed by X-API-Key, reported on /debug/cache
+        req(base, "POST", "/index/i/query", "Count(Row(f=1))",
+            headers={"X-API-Key": "tenant-a"})
+        _, snap3 = req(base, "GET", "/debug/cache")
+        assert "tenant-a" in snap3["tenants"]
+        # and occupancy rides /debug/overload next to quota state
+        _, over = req(base, "GET", "/debug/overload")
+        assert over["cache"]["bytes"] >= 0
+        # /debug/vars carries the counters
+        _, dv = req(base, "GET", "/debug/vars")
+        assert any(k.startswith("cache.hits") for k in dv["counters"])
+        # noCache bypasses: no new entries, no new hits
+        h0 = snap3["hits"]
+        req(base, "POST", "/index/i/query?noCache=true", "Count(Row(f=1))")
+        _, snap4 = req(base, "GET", "/debug/cache")
+        assert snap4["hits"] == h0
+    finally:
+        n.close()
+
+
+@pytest.mark.slow
+def test_http_result_cache_disabled_by_knob():
+    from pilosa_tpu.server.node import ServerNode
+    n = ServerNode(bind="127.0.0.1:0", use_planner=False,
+                   result_cache_mb=0)
+    n.open()
+    try:
+        import json
+        import urllib.request
+        with urllib.request.urlopen(n.address + "/debug/cache",
+                                    timeout=10) as resp:
+            snap = json.loads(resp.read())
+        assert snap == {"enabled": False}
+        assert n.executor.result_cache is None
+    finally:
+        n.close()
