@@ -956,6 +956,47 @@ def bench_cluster(extra):
     qps, p50, _ = _timer(lambda: lc.query("c", q_count), N_LAT, threads=8)
     extra["cluster4_count_qps"] = round(qps, 1)
     extra["cluster4_count_p50_ms"] = round(p50, 3)
+    # Uncached threaded fan-out: the wire/mux/device-reduce tax, with
+    # the coordinator's result cache out of the way (remote nodes keep
+    # theirs, as in production). This is the headline metric for the
+    # distributed fan-out cost.
+    qps_u, _, _ = _timer(lambda: lc.query("c", q_count, cache=False),
+                         N_LAT, threads=8)
+    extra["cluster4_count_uncached_qps"] = round(qps_u, 1)
+    # Single-node comparator on the SAME data: how much of one node's
+    # throughput the 4-node fan-out retains (1.0 = fan-out is free).
+    single = LocalCluster(1, planner_factory=lambda i: None)
+    single.nodes[0].executor.planner = MeshPlanner(
+        single.nodes[0].holder, make_mesh())
+    single.create_index("c")
+    single.create_field("c", "a")
+    single.create_field("c", "b")
+    rng1 = np.random.default_rng(23)
+    for fld, n_rows in (("a", 4), ("b", 8)):
+        rows = rng1.integers(0, n_rows, n_bits).astype(np.uint64)
+        colsv = _rand_positions(rng1, n_bits, cols)
+        single.nodes[0].handle_import_request("c", fld, rows=rows,
+                                              cols=colsv)
+    single.query("c", q_count)
+    qps_1, _, _ = _timer(lambda: single.query("c", q_count, cache=False),
+                         N_LAT, threads=8)
+    extra["single_node_count_uncached_qps"] = round(qps_1, 1)
+    extra["cluster_vs_single_node_ratio"] = round(
+        qps_u / qps_1, 3) if qps_1 else 0.0
+    # Device-sync link floor inside the cluster series: the fixed
+    # device round-trip every uncached fan-out leg pays at least once.
+    import jax
+    import jax.numpy as jnp
+    _tiny = jax.device_put(np.arange(8, dtype=np.int32))
+    _sumf = jax.jit(lambda v: jnp.sum(v))
+    int(_sumf(_tiny))
+    floors = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        int(_sumf(_tiny))
+        floors.append(time.perf_counter() - t0)
+    extra["cluster4_device_sync_floor_ms"] = round(
+        statistics.median(floors) * 1e3, 2)
     _, p50c, _ = _timer(lambda: lc.query("c", q_count, cache=False),
                      max(5, N_LAT // 3))
     extra["cluster4_count_cold_p50_ms"] = round(p50c, 3)

@@ -106,6 +106,14 @@ _DEFAULTS = {
     # as the primary invalidation mechanism.
     "result_cache_mb": 64,
     "result_cache_ttl": 0.0,
+    # Device-side fold of remote bitmap legs: "auto" picks host vs
+    # device by a measured size crossover; "on"/"off" force a side
+    # (results are bit-identical either way).
+    "device_reduce": "auto",
+    # Coalesce concurrent outbound legs to one peer into a single
+    # multiplexed request (POST /internal/query-mux). Peers that don't
+    # speak the envelope automatically get per-query requests.
+    "multiplex": True,
 }
 
 
@@ -197,6 +205,10 @@ def cmd_server(args) -> int:
         cfg["result_cache_mb"] = args.result_cache_mb
     if args.result_cache_ttl is not None:
         cfg["result_cache_ttl"] = args.result_cache_ttl
+    if args.device_reduce is not None:
+        cfg["device_reduce"] = args.device_reduce
+    if args.multiplex is not None:
+        cfg["multiplex"] = args.multiplex == "on"
 
     from pilosa_tpu.server.node import ServerNode
     node = ServerNode(
@@ -239,6 +251,9 @@ def cmd_server(args) -> int:
         plan_buckets=str(cfg["plan_buckets"]) or "pow2",
         result_cache_mb=int(cfg["result_cache_mb"]),
         result_cache_ttl=float(cfg["result_cache_ttl"]),
+        device_reduce=str(cfg["device_reduce"]) or "auto",
+        multiplex=(str(cfg["multiplex"]).lower()
+                   in ("1", "true", "yes", "on")),
     )
     node.open()  # starts the (single) serve loop in the background
     print(f"pilosa-tpu serving at {node.address}", file=sys.stderr)
@@ -740,6 +755,14 @@ def main(argv: list[str] | None = None) -> int:
     s.add_argument("--result-cache-ttl", type=float, default=None,
                    help="result cache TTL backstop, seconds "
                         "(default 0 = epoch invalidation only)")
+    s.add_argument("--device-reduce", choices=("on", "off", "auto"),
+                   default=None,
+                   help="fold remote bitmap legs on the device: auto "
+                        "picks host vs device by a measured size "
+                        "crossover (default auto; bit-identical results)")
+    s.add_argument("--multiplex", choices=("on", "off"), default=None,
+                   help="coalesce concurrent legs to one peer into a "
+                        "single multiplexed request (default on)")
     s.add_argument("--config", default=None)
     s.set_defaults(fn=cmd_server)
 

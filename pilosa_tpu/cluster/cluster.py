@@ -88,6 +88,19 @@ class Cluster:
         #: CONCURRENT cluster queries overlap all their remote hops.
         self._fanout_pool = None
         self._fanout_lock = threading.Lock()
+        #: memoized shard placement: (ring token, {(index, shard): nodes}).
+        #: Placement is a pure function of ring membership x replica_n x
+        #: partition_n; recomputing fnv1a64+jump_hash for all shards on
+        #: every query costs ~2 ms per 256-shard fan-out (~25% of an
+        #: uncached cluster query). Swapped atomically, never mutated
+        #: cross-token: a writer that raced a ring change fills only its
+        #: own (now unreachable) memo dict.
+        self._placement = (None, {})
+        #: memoized shards_by_node groupings (same token discipline);
+        #: the 256-iteration owner-walk costs ~0.7 ms per fan-out even
+        #: with shard_nodes memoized, and the inputs repeat exactly on
+        #: every stable-topology query.
+        self._groups_memo = (None, {})
         #: optional HedgePolicy (cluster/breaker.py): when set and the
         #: index is replicated, remote read legs that outlast the p95
         #: delay fire one budgeted backup request to the next replica
@@ -275,7 +288,20 @@ class Cluster:
                 for i in range(replica_n)]
 
     def shard_nodes(self, index: str, shard: int) -> list[Node]:
-        return self.partition_nodes(self.partition(index, shard))
+        # id() of each Node (not just its id string) so a node object
+        # replaced in-place under the same id still invalidates the memo.
+        token = (tuple(map(id, self.nodes)), self.replica_n,
+                 self.partition_n)
+        tok, memo = self._placement
+        if tok != token:
+            memo = {}
+            self._placement = (token, memo)
+        key = (index, shard)
+        hit = memo.get(key)
+        if hit is None:
+            hit = memo[key] = self.partition_nodes(
+                self.partition(index, shard))
+        return hit
 
     def owns_shard(self, node_id: str, index: str, shard: int) -> bool:
         return any(n.id == node_id for n in self.shard_nodes(index, shard))
@@ -286,11 +312,24 @@ class Cluster:
         its first live owner among ``nodes``; the LOCAL owner is skipped
         for shards whose data is quarantined here (blocked_shards_fn),
         so reads route to a replica instead of serving corrupt/no data."""
-        out: dict[str, list[int]] = {}
-        live = {n.id for n in nodes}
         blocked: set = set()
         if self.blocked_shards_fn is not None:
             blocked = self.blocked_shards_fn(index) or set()
+        ring = (tuple(map(id, self.nodes)), self.replica_n,
+                self.partition_n)
+        key = (tuple(n.id for n in nodes), index, tuple(shards),
+               frozenset(blocked))
+        tok, memo = self._groups_memo
+        if tok != ring:
+            memo = {}
+            self._groups_memo = (ring, memo)
+        hit = memo.get(key)
+        if hit is not None:
+            # Copy-on-hit: callers may hold the lists across failover
+            # waves; never hand out aliased state.
+            return {nid: list(shs) for nid, shs in hit.items()}
+        out: dict[str, list[int]] = {}
+        live = {n.id for n in nodes}
         for shard in shards:
             skipped_blocked = False
             for owner in self.shard_nodes(index, shard):
@@ -307,7 +346,10 @@ class Cluster:
                     # error, the data exists but cannot be trusted.
                     raise ShardCorruptError()
                 raise ShardUnavailableError()
-        return out
+        if len(memo) >= 64:
+            memo.clear()
+        memo[key] = out
+        return {nid: list(shs) for nid, shs in out.items()}
 
     def _hedge_backup_groups(self, nodes: list[Node], index: str,
                              node_id: str,
@@ -348,6 +390,19 @@ class Cluster:
         result = None
         pending = list(shards)
         pql = str(c)  # serialize the node-boundary query once
+        # Bitmap unions (reduce_fn tagged by the executor) defer: legs
+        # collect and fold ONCE at the end — on device, one batched
+        # program — instead of a host union chain per completion.
+        from pilosa_tpu.core.row import Row as _Row
+        row_accs: list = []
+        defer_rows = getattr(reduce_fn, "reduce_kind", None) == "row_union"
+
+        def fold(acc):
+            nonlocal result
+            if defer_rows and isinstance(acc, _Row):
+                row_accs.append(acc)
+                return
+            result = acc if result is None else reduce_fn(result, acc)
         # The fan-out pool's threads don't inherit contextvars; carry
         # the active trace id AND deadline into them so remote
         # sub-queries join the trace and stay cancellable.
@@ -385,20 +440,31 @@ class Cluster:
             t0 = time.perf_counter()
 
             def go():
-                # The meta path carries the peer's shard-epoch vector for
-                # the coordinator's cache stamps — but instance-level
-                # query_node overrides (test fault-injection hooks) must
-                # keep intercepting the fan-out, so it only runs on a
-                # pristine client.
-                meta = getattr(self.client, "query_node_meta", None)
-                if meta is None or "query_node" in self.client.__dict__:
-                    return self.client.query_node(
-                        node, idx.name, pql, node_shards, remote=True)[0]
-                results, epochs = meta(node, idx.name, pql, node_shards,
-                                       remote=True)
-                if self.epoch_sink is not None and epochs:
-                    self.epoch_sink(idx.name, node_id, epochs)
-                return results[0]
+                with tracing.start_span("cluster.remoteLeg") as span:
+                    span.set_tag("node", node_id)
+                    span.set_tag("shards", len(node_shards))
+                    # The meta path carries the peer's shard-epoch vector
+                    # for the coordinator's cache stamps — but
+                    # instance-level query_node overrides (test
+                    # fault-injection hooks) must keep intercepting the
+                    # fan-out, so it only runs on a pristine client.
+                    meta = getattr(self.client, "query_node_meta", None)
+                    if meta is None or "query_node" in self.client.__dict__:
+                        return self.client.query_node(
+                            node, idx.name, pql, node_shards, remote=True)[0]
+                    results, epochs = meta(node, idx.name, pql, node_shards,
+                                           remote=True)
+                    if self.epoch_sink is not None and epochs:
+                        self.epoch_sink(idx.name, node_id, epochs)
+                    # HTTP transports expose the leg's wire payload sizes
+                    # (thread-local, set just before returning).
+                    nbytes = getattr(self.client, "leg_wire_bytes", None)
+                    if nbytes is not None:
+                        b = nbytes()
+                        if b:
+                            span.set_tag("bytesOut", b.get("out", 0))
+                            span.set_tag("bytesIn", b.get("in", 0))
+                    return results[0]
 
             res = _with_trace(go)
             if self.hedge is not None:
@@ -461,7 +527,7 @@ class Cluster:
                     acc = (run_local(node_shards)
                            if node_id == self.local_id
                            else run_remote(node_id, node_shards))
-                    result = acc if result is None else reduce_fn(result, acc)
+                    fold(acc)
                 except (ConnectionError, ShardCorruptError):
                     # A corrupt-data refusal fails over exactly like a
                     # dead node: drop it, remap its shards to replicas.
@@ -494,26 +560,43 @@ class Cluster:
                 if local_shards is not None:
                     try:
                         acc = run_local(local_shards)
-                        result = acc if result is None else \
-                            reduce_fn(result, acc)
+                        fold(acc)
                     except (ConnectionError, ShardCorruptError):
                         # Drop the local node too — otherwise its failed
                         # shards re-map straight back to it and the
                         # retry loop never terminates.
                         nodes = [n for n in nodes if n.id != self.local_id]
                         failed.extend(local_shards)
-                for node_id, node_shards, fut in tasks:
-                    try:
-                        acc = fut.result()
-                    except (ConnectionError, ShardCorruptError):
-                        # Failover: drop the node, re-map its shards
-                        # onto replicas (executor.go:2492-2503).
-                        nodes = [n for n in nodes if n.id != node_id]
-                        failed.extend(node_shards)
-                        continue
-                    result = acc if result is None else \
-                        reduce_fn(result, acc)
+                # Merge-as-completed: each finished leg folds while the
+                # stragglers are still in flight, so GroupBy/TopN merge
+                # cost comes off the critical path (the old serial fold
+                # paid every merge after the LAST leg returned).
+                fut_info = {fut: (node_id, node_shards)
+                            for node_id, node_shards, fut in tasks}
+                pending_futs = set(fut_info)
+                while pending_futs:
+                    done, pending_futs = futures_wait(
+                        pending_futs, return_when=FIRST_COMPLETED)
+                    for fut in done:
+                        node_id, node_shards = fut_info[fut]
+                        try:
+                            acc = fut.result()
+                        except (ConnectionError, ShardCorruptError):
+                            # Failover: drop the node, re-map its shards
+                            # onto replicas (executor.go:2492-2503).
+                            nodes = [n for n in nodes if n.id != node_id]
+                            failed.extend(node_shards)
+                            continue
+                        fold(acc)
             pending = failed
+        if row_accs:
+            # The deferred bitmap fold: disjoint shards merge for free,
+            # contested shards OR-reduce in one batched device program
+            # (host numpy below the measured threshold) — bit-identical
+            # to the union chain this replaces.
+            from pilosa_tpu.exec import device_reduce
+            acc = device_reduce.union_rows(row_accs)
+            result = acc if result is None else reduce_fn(result, acc)
         return result
 
     # -- write fan-out (reference executeSetBitField executor.go:2144) -----

@@ -1,0 +1,197 @@
+"""Device-side reduce of distributed bitmap legs.
+
+The coordinator used to fold remote Row results on the host: decode
+each leg's roaring blob to positions, scatter positions into words one
+shard at a time (a Python loop over shards in ``Row.from_columns``),
+then chain ``Row.union`` per leg. Both halves batch onto the device
+instead:
+
+* ``row_from_columns`` uploads ALL of a leg's positions and scatters
+  them into every shard's word block in ONE jitted program (a single
+  ``.at[seg, word].add(bit)`` — positions are unique, so each bit value
+  is a distinct power of two per word and add == or).
+* ``union_rows`` merges legs: disjoint shards (the common placement
+  case) are a dict merge; contested shards stack into one padded
+  ``[B, K, W]`` array OR-reduced in one jitted pass — replacing the
+  per-leg ``reduce_fn(result, acc)`` union chain in
+  ``cluster.map_reduce``.
+
+Shapes bucket to powers of two so new leg sizes reuse compiled kernels
+(the plan-bucketing trick from parallel/planner.py).
+
+Selection: ``PILOSA_TPU_DEVICE_REDUCE`` = ``on`` | ``off`` | ``auto``
+(env wins over the server knob's ``set_mode``). ``auto`` uses a
+measured host-vs-device crossover so small results keep the cheap host
+path; both paths are bit-identical by construction and the equivalence
+tests force each side.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pilosa_tpu.config import SHARD_WIDTH, WORDS_PER_SHARD
+from pilosa_tpu.core.row import Row
+
+_MODES = ("on", "off", "auto")
+_default_mode = "auto"
+
+
+def set_mode(mode: str) -> None:
+    """Server-knob default; the PILOSA_TPU_DEVICE_REDUCE env var (the
+    test/operator override) takes precedence when set."""
+    global _default_mode
+    if mode not in _MODES:
+        raise ValueError(f"device_reduce mode must be one of {_MODES}")
+    _default_mode = mode
+
+
+def mode() -> str:
+    m = os.environ.get("PILOSA_TPU_DEVICE_REDUCE", "").strip().lower()
+    return m if m in _MODES else _default_mode
+
+
+def _pow2(n: int) -> int:
+    return 1 << max(0, int(n - 1).bit_length())
+
+
+# -- measured size threshold ------------------------------------------------
+
+_calibrated: int | None = None
+
+
+def _calibrate() -> int:
+    """Crossover, in scattered positions / folded words, above which the
+    batched device program beats the host numpy path: device dispatch
+    is a fixed overhead, host cost scales with the data."""
+    w = WORDS_PER_SHARD
+    a = np.arange(w, dtype=np.uint32)
+    b = a[::-1].copy()
+    t0 = time.perf_counter()
+    for _ in range(8):
+        np.bitwise_or(a, b)
+    host_per_word = max((time.perf_counter() - t0) / (8 * w), 1e-12)
+    stack = jnp.zeros((1, 2, w), dtype=jnp.uint32)
+    _or_fold(stack).block_until_ready()  # compile outside the clock
+    t0 = time.perf_counter()
+    for _ in range(4):
+        _or_fold(stack).block_until_ready()
+    dev_overhead = (time.perf_counter() - t0) / 4
+    return int(min(max(dev_overhead / host_per_word, w), 256 * w))
+
+
+def _min_size() -> int:
+    env = os.environ.get("PILOSA_TPU_DEVICE_REDUCE_MIN", "")
+    if env:
+        return int(env)
+    global _calibrated
+    if _calibrated is None:
+        _calibrated = _calibrate()
+    return _calibrated
+
+
+def _use_device(size: int) -> bool:
+    m = mode()
+    if m == "off":
+        return False
+    if m == "on":
+        return True
+    return size >= _min_size()
+
+
+# -- batched kernels --------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("n_segs",))
+def _scatter_bits(seg_idx, word_idx, bits, n_segs: int):
+    """One program building every segment's word block: scatter-add of
+    per-position bit values (unique positions => add == or). Row
+    ``n_segs`` is the padding sink."""
+    words = jnp.zeros((n_segs + 1, WORDS_PER_SHARD), dtype=jnp.uint32)
+    return words.at[seg_idx, word_idx].add(bits)
+
+
+@jax.jit
+def _or_fold(stack):
+    """[B, K, W] uint32 -> [B, W]: fold K contributors per shard in one
+    bandwidth-bound pass (the existing b_or kernel, batched)."""
+    return jax.lax.reduce(stack, jnp.uint32(0), jax.lax.bitwise_or, (1,))
+
+
+def row_from_columns(columns) -> Row:
+    """Row.from_columns with the positions->words scatter running as one
+    batched device program across all shards (host fallback below the
+    measured threshold or when the mode says off)."""
+    cols = np.asarray(columns, dtype=np.uint64)
+    if not _use_device(len(cols)):
+        return Row.from_columns(cols)
+    cols = np.unique(cols)
+    if len(cols) == 0:
+        return Row()
+    shard = (cols // np.uint64(SHARD_WIDTH)).astype(np.int64)
+    local = (cols % np.uint64(SHARD_WIDTH)).astype(np.int64)
+    shards, seg_idx = np.unique(shard, return_inverse=True)
+    n_segs = len(shards)
+    n = _pow2(len(cols))  # bucket the scatter length too
+    pad = n - len(cols)
+    seg_idx = np.concatenate(
+        [seg_idx, np.full(pad, n_segs, dtype=np.int64)]).astype(np.int32)
+    word_idx = np.concatenate(
+        [local >> 5, np.zeros(pad, dtype=np.int64)]).astype(np.int32)
+    bits = np.concatenate(
+        [np.left_shift(np.uint32(1), (local & 31).astype(np.uint32)),
+         np.zeros(pad, dtype=np.uint32)])
+    words = _scatter_bits(jnp.asarray(seg_idx), jnp.asarray(word_idx),
+                          jnp.asarray(bits), _pow2(n_segs))
+    return Row({int(s): words[i] for i, s in enumerate(shards)})
+
+
+def union_rows(rows: list) -> Row | None:
+    """Union the accumulated legs of a distributed bitmap query.
+
+    Bit-identical to the chained ``prev.union(v)`` fold it replaces:
+    one leg passes through untouched (attrs included); two or more
+    merge disjoint shards directly and fold contested shards — on
+    device in one batched program when the contested volume clears the
+    threshold, else with host numpy."""
+    rows = [r for r in rows if r is not None]
+    if not rows:
+        return None
+    if len(rows) == 1:
+        return rows[0]
+    by_shard: dict[int, list] = {}
+    for r in rows:
+        for s, seg in r.segments.items():
+            by_shard.setdefault(s, []).append(seg)
+    merged: dict[int, object] = {}
+    contested: list[tuple[int, list]] = []
+    for s, segs in by_shard.items():
+        if len(segs) == 1:
+            merged[s] = segs[0]
+        else:
+            contested.append((s, segs))
+    if contested:
+        n_words = sum(len(segs) for _, segs in contested) * WORDS_PER_SHARD
+        if _use_device(n_words):
+            b = _pow2(len(contested))
+            k = _pow2(max(len(segs) for _, segs in contested))
+            stack = np.zeros((b, k, WORDS_PER_SHARD), dtype=np.uint32)
+            for i, (_, segs) in enumerate(contested):
+                for j, seg in enumerate(segs):
+                    stack[i, j] = np.asarray(seg)
+            folded = _or_fold(jnp.asarray(stack))
+            for i, (s, _) in enumerate(contested):
+                merged[s] = folded[i]
+        else:
+            for s, segs in contested:
+                acc = np.asarray(segs[0])
+                for seg in segs[1:]:
+                    acc = np.bitwise_or(acc, np.asarray(seg))
+                merged[s] = acc
+    return Row(merged)

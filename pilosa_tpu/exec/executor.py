@@ -546,6 +546,11 @@ class Executor:
                 return v
             return prev.union(v)  # segments are disjoint by shard
 
+        # The cluster layer defers row legs and folds them device-side
+        # in one batched program (exec/device_reduce.py) when it sees
+        # this tag; untagged reduces keep the pairwise fold.
+        reduce_fn.reduce_kind = "row_union"
+
         local_batch = (lambda shs: planner.execute_bitmap(idx, c, shs)) \
             if planner is not None else None
         row = self.map_reduce(idx, shards, c, opt, map_fn, reduce_fn,
@@ -1118,7 +1123,12 @@ class Executor:
             return self._group_by_shard(idx, c, filter_call, shard, child_rows)
 
         def reduce_fn(p, v):
-            return merge_group_counts(p or [], v, limit)
+            # Merge UNBOUNDED: truncating intermediate merges to the
+            # user limit drops groups whose counts other legs would
+            # still raise — which also made the answer depend on leg
+            # completion order. The offset/limit window applies once,
+            # after the full fold below.
+            return merge_group_counts(p or [], v, _MAXINT)
 
         local_batch = None
         gb_fields = self._planner_group_by_fields(idx, c, filter_call,

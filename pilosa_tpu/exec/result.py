@@ -54,11 +54,14 @@ class Pair:
 
 
 def merge_pairs(a: list[Pair], b: list[Pair]) -> list[Pair]:
-    """Sum counts by id (reference Pairs.Add)."""
+    """Sum counts by id (reference Pairs.Add); keys survive the merge."""
     acc: dict[int, int] = {}
+    keys: dict[int, str] = {}
     for p in a + b:
         acc[p.id] = acc.get(p.id, 0) + p.count
-    return [Pair(id=i, count=c) for i, c in acc.items()]
+        if p.key:
+            keys[p.id] = p.key
+    return [Pair(id=i, count=c, key=keys.get(i, "")) for i, c in acc.items()]
 
 
 def sort_pairs(pairs: list[Pair]) -> list[Pair]:
@@ -96,7 +99,13 @@ class GroupCount:
 
 def merge_group_counts(a: list[GroupCount], b: list[GroupCount],
                        limit: int) -> list[GroupCount]:
-    """Sorted merge summing equal groups (reference mergeGroupCounts :1196)."""
+    """Sorted merge summing equal groups (reference mergeGroupCounts :1196).
+
+    Never mutates its inputs: a leg's result list may be a live cache
+    entry on the node that produced it (the in-process transport passes
+    references), and the coordinator folds legs in COMPLETION order —
+    summing in place would corrupt the cached counts for every later
+    reader. Equal keys produce a fresh GroupCount instead."""
     limit = min(limit, len(a) + len(b))
     out: list[GroupCount] = []
     i = j = 0
@@ -106,8 +115,8 @@ def merge_group_counts(a: list[GroupCount], b: list[GroupCount],
             out.append(a[i])
             i += 1
         elif ka == kb:
-            a[i].count += b[j].count
-            out.append(a[i])
+            out.append(GroupCount(group=a[i].group,
+                                  count=a[i].count + b[j].count))
             i += 1
             j += 1
         else:

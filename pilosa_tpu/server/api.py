@@ -123,7 +123,12 @@ class API:
             extra = ({"shardEpochs": {str(s): e for s, e in epochs.items()}}
                      if epochs else None)
             if accept_frames:
-                return wire.encode_frames(results, extra=extra)
+                # accept_frames == 2 means the peer negotiated the v2
+                # layout (aggregates as typed array blobs); plain True
+                # keeps the v1 layout for not-yet-upgraded peers.
+                return wire.encode_frames(
+                    results, extra=extra,
+                    version=2 if accept_frames == 2 else 1)
             resp = {"results": [wire.encode_result(r) for r in results]}
             if extra:
                 resp.update(extra)

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import http.client
 import json
+import os
 import random
 import socket
 import threading
@@ -161,6 +162,99 @@ def _split_url(url: str) -> tuple[str, str, int, str]:
     return scheme, parts.hostname or "", port, path
 
 
+class _MuxLeg:
+    """One outbound query leg riding a multiplexed peer channel."""
+
+    __slots__ = ("index", "query", "shards", "timeout_ms", "trace",
+                 "done", "frame", "error", "bytes_out")
+
+    def __init__(self, index: str, query: str, shards, timeout_ms,
+                 trace: str | None):
+        self.index = index
+        self.query = query
+        self.shards = shards
+        self.timeout_ms = timeout_ms
+        self.trace = trace
+        self.done = False
+        self.frame: bytes | None = None
+        self.error: BaseException | None = None
+        self.bytes_out = len(query)
+
+    def to_json(self) -> dict:
+        d: dict = {"index": self.index, "query": self.query}
+        if self.shards:
+            d["shards"] = list(self.shards)
+        if self.timeout_ms is not None:
+            d["timeoutMs"] = self.timeout_ms
+        if self.trace:
+            d["trace"] = self.trace
+        return d
+
+
+class _MuxUnsupportedError(Exception):
+    """Sentinel: the peer doesn't speak the mux envelope (old version);
+    the submitting leg falls back to a per-query request."""
+
+
+class _PeerChannel:
+    """Per-peer request multiplexer (group commit).
+
+    The first leg to a free channel dispatches immediately — batching
+    adds ZERO latency to an idle peer. Legs arriving while a batch is
+    in flight queue up; when the wire frees, one of their threads
+    drains the whole queue as the next batch. Under concurrent load
+    the coordinator therefore sends one pipelined request per peer per
+    congestion window instead of one per query.
+
+    Every leg keeps its own deadline, trace id, epoch stamp, and error
+    status (the envelope carries them per leg); only transport-level
+    outcomes — connection failure, breaker state — are shared, exactly
+    as they would be on one physical connection.
+    """
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._queue: list[_MuxLeg] = []
+        self._busy = False
+
+    def submit(self, client: "HTTPInternalClient", node: Node,
+               leg: _MuxLeg) -> _MuxLeg:
+        """Blocks until the leg is resolved (frame or error set)."""
+        with self._cv:
+            self._queue.append(leg)
+            batch = None
+            while not leg.done:
+                if not self._busy:
+                    # Become the dispatcher for everything queued
+                    # (including our own leg — nobody drained it yet).
+                    batch, self._queue = self._queue, []
+                    self._busy = True
+                    break
+                self._cv.wait(timeout=0.1)
+                if leg.done:
+                    break
+                # A queued (not yet in-flight) leg whose deadline died
+                # while another batch holds the wire gives up its slot;
+                # an in-flight leg must wait for its outcome.
+                dl = _current_deadline()
+                rem = dl.remaining() if dl is not None else None
+                if (rem is not None and rem <= 0) and leg in self._queue:
+                    self._queue.remove(leg)
+                    leg.error = DeadlineExceededError(
+                        "deadline expired before remote call")
+                    leg.done = True
+        if batch is not None:
+            try:
+                client._send_mux_batch(node, batch)
+            finally:
+                with self._cv:
+                    for b in batch:
+                        b.done = True
+                    self._busy = False
+                    self._cv.notify_all()
+        return leg
+
+
 class HTTPInternalClient:
     """Implements the InternalClient protocol against peer HTTP servers."""
 
@@ -176,6 +270,18 @@ class HTTPInternalClient:
         #: executor's replica failover kicks in without burning a
         #: socket timeout on a known-sick peer.
         self.breakers = None
+        #: Optional StatsClient: wire-level counters (cluster.wireBytesIn/
+        #: wireBytesOut/wireDecodeMs) land on /debug/vars when set.
+        self.stats = None
+        #: Coalesce concurrent outbound query legs to the same peer into
+        #: one multiplexed request (POST /internal/query-mux). Peers that
+        #: 404/400 the envelope (older version) are remembered and get
+        #: per-query requests instead — see _mux_allowed.
+        self.multiplex = True
+        self._channels: dict[str, _PeerChannel] = {}
+        self._channels_lock = threading.Lock()
+        self._mux_unsupported: set[str] = set()
+        self._leg_local = threading.local()
         # Verification policy (reference tls.skip-verify,
         # server/config.go): with a CA bundle, verify by default; the
         # CERT_NONE fallback is only for CA-less (self-signed) clusters
@@ -428,6 +534,154 @@ class HTTPInternalClient:
         self._request(node, "POST", "/internal/import",
                       json.dumps(body).encode())
 
+    # -- multiplexed peer channel --------------------------------------------
+
+    def leg_wire_bytes(self) -> dict | None:
+        """Wire bytes of the LAST query leg this thread sent — read by
+        the coordinator's per-leg tracing span right after the call."""
+        return getattr(self._leg_local, "bytes", None)
+
+    def _count_wire(self, n_out: int, n_in: int, decode_ms: float = 0.0):
+        st = self.stats
+        if st is not None:
+            st.count("cluster.wireBytesOut", n_out)
+            st.count("cluster.wireBytesIn", n_in)
+            if decode_ms:
+                st.count("cluster.wireDecodeMs", decode_ms)
+
+    def _mux_allowed(self, node: Node) -> bool:
+        env = os.environ.get("PILOSA_TPU_MULTIPLEX", "").strip().lower()
+        if env in ("off", "0", "false"):
+            return False
+        if env in ("on", "1", "true"):
+            return node.id not in self._mux_unsupported
+        return self.multiplex and node.id not in self._mux_unsupported
+
+    def _channel(self, node: Node) -> _PeerChannel:
+        with self._channels_lock:
+            ch = self._channels.get(node.id)
+            if ch is None:
+                ch = self._channels[node.id] = _PeerChannel()
+            return ch
+
+    def _send_mux_batch(self, node: Node, batch: list[_MuxLeg]) -> None:
+        """Dispatch one multiplexed request carrying every queued leg.
+
+        Runs on ONE submitter thread (the channel's current dispatcher);
+        resolves every leg with a frame or an error and never raises —
+        a transport failure is every leg's failure, exactly as if each
+        had dialed and hit the same dead peer. Per-leg application
+        outcomes (503 shed, 404, quarantine) come back inside the
+        envelope and are mapped by each leg's own submitter.
+        """
+        from pilosa_tpu.server import wire
+        try:
+            if self.breakers is not None:
+                self.breakers.check(node.id)
+            body = wire.encode_mux_request([leg.to_json() for leg in batch])
+            # The envelope waits for its slowest leg: socket timeout is
+            # the largest per-leg budget (deadline-capped by callers).
+            budget = max((leg.timeout_ms or int(self.timeout * 1000))
+                         for leg in batch) / 1000.0
+            try:
+                status, msg, data = self._http(
+                    self._url(node, "/internal/query-mux"), "POST", body,
+                    {"Content-Type": wire.MUX_CONTENT_TYPE},
+                    timeout=max(0.05, min(self.timeout, budget)))
+            except OSError as e:
+                if self.breakers is not None:
+                    self.breakers.record_failure(node.id)
+                err = ConnectionError(f"node {node.id} unreachable: {e}")
+                err.__cause__ = e
+                for leg in batch:
+                    leg.error = err
+                return
+            if self.breakers is not None:
+                # Any HTTP status proves the peer is alive (same rule as
+                # _request_raw) — shedding and rejections are app-level.
+                self.breakers.record_success(node.id)
+            self._count_wire(len(body), len(data))
+            if status in (400, 404, 405):
+                # The peer predates the mux envelope (no route, or its
+                # parser rejects the magic). Remember and fall back to
+                # per-query requests — mixed-version clusters must keep
+                # answering (same contract as _post_import's 400 rule).
+                self._mux_unsupported.add(node.id)
+                for leg in batch:
+                    leg.error = _MuxUnsupportedError()
+                return
+            if status >= 400:
+                err = NodeHTTPError(
+                    status,
+                    f"node {node.id} HTTP {status}: "
+                    f"{data.decode(errors='replace')}")
+                for leg in batch:
+                    leg.error = err
+                return
+            outcomes = wire.decode_mux_response(data)
+            if len(outcomes) != len(batch):
+                raise ValueError(
+                    f"mux response has {len(outcomes)} legs, sent "
+                    f"{len(batch)}")
+            for leg, o in zip(batch, outcomes):
+                if "frame" in o:
+                    leg.frame = o["frame"]
+                else:
+                    leg.error = NodeHTTPError(
+                        o["status"],
+                        f"node {node.id} HTTP {o['status']}: {o['error']}",
+                        retry_after=o.get("retryAfter"))
+        except BaseException as e:  # noqa: BLE001 — every leg must resolve
+            if self.breakers is not None:
+                self.breakers.abort(node.id)
+            for leg in batch:
+                if leg.frame is None and leg.error is None:
+                    leg.error = e
+
+    def _mux_query(self, node: Node, index: str, query: str,
+                   shards: list[int] | None):
+        """One query leg over the peer's multiplexed channel. Same
+        outcome mapping as the per-query path: quarantine -> typed
+        ShardCorruptError, shed (503) -> bounded jittered retry, 404 ->
+        LookupError. Raises _MuxUnsupportedError for old peers (caller
+        falls back per-query)."""
+        from pilosa_tpu.obs import tracing
+        from pilosa_tpu.server import wire
+        attempt = 0
+        while True:
+            # Deadline-capped per-leg budget; raises if already expired.
+            timeout_ms = int(self._deadline_timeout() * 1000)
+            leg = _MuxLeg(index, query, shards, timeout_ms,
+                          tracing.current_trace_id())
+            self._channel(node).submit(self, node, leg)
+            if leg.error is not None:
+                e = leg.error
+                if isinstance(e, NodeHTTPError) and e.code == 503:
+                    if "quarantined" in str(e):
+                        from pilosa_tpu.storage.quarantine import (
+                            ShardCorruptError,
+                        )
+                        raise ShardCorruptError() from e
+                    if attempt < RETRY_503_ATTEMPTS:
+                        delay = self._backoff_delay(attempt, e.retry_after)
+                        if delay is not None:
+                            time.sleep(delay)
+                            attempt += 1
+                            continue
+                if isinstance(e, NodeHTTPError) and e.code == 404:
+                    raise LookupError(f"node {node.id}: {e}") from e
+                raise e
+            frame = leg.frame
+            t0 = time.perf_counter()
+            results, header = wire.decode_frames_meta(frame)
+            decode_ms = (time.perf_counter() - t0) * 1000.0
+            st = self.stats
+            if st is not None:
+                st.count("cluster.wireDecodeMs", decode_ms)
+            self._leg_local.bytes = {"out": leg.bytes_out,
+                                     "in": len(frame)}
+            return results, _epoch_vector(header.get("shardEpochs"))
+
     # -- InternalClient protocol -------------------------------------------
 
     def query_node(self, node: Node, index: str, query: str,
@@ -445,32 +699,12 @@ class HTTPInternalClient:
             path += "&shards=" + ",".join(str(s) for s in shards)
         from pilosa_tpu.server import wire
         if remote:
-            # Advertise binary-frame support: Row results come back as
-            # roaring blobs instead of JSON int lists (~10-100x smaller
-            # for large rows; wire.encode_frames). Reads are idempotent,
-            # so a shed (503) leg may back off and retry.
-            try:
-                data, ctype = self._request_raw(
-                    node, "POST", path, query.encode(),
-                    accept=wire.FRAMES_CONTENT_TYPE, retry_503=True)
-            except NodeHTTPError as e:
-                if e.code == 503 and "quarantined" in str(e):
-                    # The peer refused because ITS copy of a shard is
-                    # corrupt: surface the typed error so the
-                    # coordinator fails this leg over to a replica.
-                    from pilosa_tpu.storage.quarantine import (
-                        ShardCorruptError,
-                    )
-                    raise ShardCorruptError() from e
-                raise
-            if ctype.startswith(wire.FRAMES_CONTENT_TYPE):
-                results, header = wire.decode_frames_meta(data)
-                return results, _epoch_vector(header.get("shardEpochs"))
-            resp = json.loads(data) if data else {}
-            if "error" in resp:
-                raise RuntimeError(resp["error"])
-            return ([wire.decode_result(r) for r in resp["results"]],
-                    _epoch_vector(resp.get("shardEpochs")))
+            if self._mux_allowed(node):
+                try:
+                    return self._mux_query(node, index, query, shards)
+                except _MuxUnsupportedError:
+                    pass  # old peer; the per-query path below still works
+            return self._query_node_frames(node, path, query)
         # Forwarded reads are idempotent POSTs: a shed leg may back off
         # and retry within the deadline budget, same as the remote path.
         resp = self._request(node, "POST", path, query.encode(),
@@ -478,6 +712,41 @@ class HTTPInternalClient:
         if "error" in resp:
             raise RuntimeError(resp["error"])
         return resp["results"], _epoch_vector(resp.get("shardEpochs"))
+
+    def _query_node_frames(self, node: Node, path: str, query: str):
+        """Per-query remote leg. Advertises binary-frame support (v2:
+        aggregate results ship as typed array blobs too — TopN pairs,
+        GroupBy tables, rowid lists; wire.encode_frames): Row results
+        come back as roaring blobs instead of JSON int lists (~10-100x
+        smaller for large rows). Reads are idempotent, so a shed (503)
+        leg may back off and retry."""
+        from pilosa_tpu.server import wire
+        body = query.encode()
+        try:
+            data, ctype = self._request_raw(
+                node, "POST", path, body,
+                accept=wire.FRAMES_ACCEPT_V2, retry_503=True)
+        except NodeHTTPError as e:
+            if e.code == 503 and "quarantined" in str(e):
+                # The peer refused because ITS copy of a shard is
+                # corrupt: surface the typed error so the
+                # coordinator fails this leg over to a replica.
+                from pilosa_tpu.storage.quarantine import ShardCorruptError
+                raise ShardCorruptError() from e
+            raise
+        self._leg_local.bytes = {"out": len(body), "in": len(data)}
+        if ctype.startswith(wire.FRAMES_CONTENT_TYPE):
+            t0 = time.perf_counter()
+            results, header = wire.decode_frames_meta(data)
+            self._count_wire(len(body), len(data),
+                             (time.perf_counter() - t0) * 1000.0)
+            return results, _epoch_vector(header.get("shardEpochs"))
+        self._count_wire(len(body), len(data))
+        resp = json.loads(data) if data else {}
+        if "error" in resp:
+            raise RuntimeError(resp["error"])
+        return ([wire.decode_result(r) for r in resp["results"]],
+                _epoch_vector(resp.get("shardEpochs")))
 
     def fragment_blocks(self, node, index, field, view, shard):
         resp = self._request(

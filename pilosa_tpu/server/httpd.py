@@ -316,8 +316,14 @@ def _build_routes(api: API):
             shards = [int(s) for s in params["shards"].split(",")]
         from pilosa_tpu.server import wire
         remote = params.get("remote") == "true"
-        frames = (remote
-                  and wire.FRAMES_CONTENT_TYPE in params.get("_accept", ""))
+        # v2 frames carry aggregate results (TopN pairs, GroupBy tables,
+        # rowid lists) as typed array blobs too; a v1 client's bare
+        # content type gets the v1 layout (JSON aggregate metas), so a
+        # mixed-version cluster keeps interoperating.
+        accept = params.get("_accept", "")
+        frames: int | bool = False
+        if remote and wire.FRAMES_CONTENT_TYPE in accept:
+            frames = 2 if "v=2" in accept else True
         # QoS front: classify, apply the node default deadline when the
         # client sent none, gate on admission, and feed the slow log.
         # Shed/deadline errors propagate to _dispatch's 503/504 mapping.
@@ -420,6 +426,79 @@ def _build_routes(api: API):
         if isinstance(resp, bytes):
             return 200, resp, {"Content-Type": wire.FRAMES_CONTENT_TYPE}
         return 200, resp
+
+    def post_query_mux(pv, params, body):
+        """Multiplexed peer-leg batch (POST /internal/query-mux): one
+        request carrying N independent query legs, answered with N
+        binary frames (wire.encode_mux_response). Transport failures
+        stay whole-request; everything application-level — shed,
+        deadline, quarantine, missing index, parse error — is a per-leg
+        outcome inside the envelope, so one sick leg never poisons its
+        batch-mates. Each leg restores its own trace id and deadline
+        from the envelope: the batch rides one handler thread, but the
+        legs may belong to different coordinator queries."""
+        from pilosa_tpu.obs import tracing as _tr
+        from pilosa_tpu.server import wire
+        legs = wire.decode_mux_request(body)  # ValueError -> 400
+        qos_ctl = getattr(api, "qos", None)
+        cls = normalize_class("", remote=True)
+        fault_slow = getattr(api, "fault_slow_s", 0.0)
+        outcomes: list[dict] = []
+        for leg in legs:
+            token = None
+            trace = leg.get("trace")
+            if trace:
+                token = _tr.set_current_trace(trace)
+            tms = leg.get("timeoutMs")
+            if tms is not None:
+                dl = qos_deadline.Deadline(timeout=float(tms) / 1000.0)
+            elif qos_ctl is not None and qos_ctl.default_deadline > 0:
+                dl = qos_deadline.Deadline(timeout=qos_ctl.default_deadline)
+            else:
+                dl = None
+            dtoken = (qos_deadline.set_current_deadline(dl)
+                      if dl is not None else None)
+            # Remote legs run under the default tenant — the
+            # coordinator already attributed the query once.
+            ttoken = set_current_tenant("")
+            try:
+                if fault_slow > 0:
+                    time.sleep(fault_slow)
+                qos_deadline.check_current()
+                if qos_ctl is not None:
+                    with qos_ctl.admit(cls):
+                        frame = api.query(
+                            leg["index"], leg["query"],
+                            shards=leg.get("shards"),
+                            remote=True, accept_frames=2, cache=True)
+                else:
+                    frame = api.query(
+                        leg["index"], leg["query"],
+                        shards=leg.get("shards"),
+                        remote=True, accept_frames=2, cache=True)
+                outcomes.append({"frame": frame})
+            except QueryShedError as e:
+                outcomes.append({"status": 503, "error": str(e),
+                                 "retryAfter": float(e.retry_after)})
+            except ShardCorruptError as e:
+                # str() carries "quarantined" — the client's typed
+                # ShardCorruptError mapping keys on it, same as the
+                # per-query path's 503 body.
+                outcomes.append({"status": 503, "error": str(e)})
+            except DeadlineExceededError as e:
+                outcomes.append({"status": 504, "error": str(e)})
+            except _NOT_FOUND as e:
+                outcomes.append({"status": 404, "error": str(e)})
+            except (QueryError, ParseError, ValueError, PilosaError) as e:
+                outcomes.append({"status": 400, "error": str(e)})
+            finally:
+                reset_current_tenant(ttoken)
+                if dtoken is not None:
+                    qos_deadline.reset_current_deadline(dtoken)
+                if token is not None:
+                    _tr.reset_current_trace(token)
+        return (200, wire.encode_mux_response(outcomes),
+                {"Content-Type": wire.MUX_CONTENT_TYPE})
 
     def get_export(pv, params, body):
         csv = api.export_csv(params["index"], params["field"],
@@ -864,6 +943,7 @@ def _build_routes(api: API):
         (r"/internal/import", {"POST": post_internal_import}),
         (r"/internal/nodes", {"GET": get_nodes}),
         (r"/internal/probe", {"GET": get_internal_probe}),
+        (r"/internal/query-mux", {"POST": post_query_mux}),
     ]
     if getattr(api, "chaos_faults", False):
         table.append((r"/internal/fault", {"POST": post_fault}))

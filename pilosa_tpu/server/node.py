@@ -82,7 +82,9 @@ class ServerNode:
                  compile_cache_dir: str | None = None,
                  plan_buckets: str = "pow2",
                  result_cache_mb: int = 64,
-                 result_cache_ttl: float = 0.0):
+                 result_cache_ttl: float = 0.0,
+                 device_reduce: str = "auto",
+                 multiplex: bool = True):
         host, _, port = bind.partition(":")
         self.host, self.port = host or "127.0.0.1", int(port or 10101)
         # Node identity IS the address — member ids are built the same
@@ -285,8 +287,14 @@ class ServerNode:
         self._scrub_interval = (
             self.DEFAULT_SCRUB_INTERVAL
             if scrub_interval is None else scrub_interval)
+        # Device-side fold of remote bitmap legs (exec/device_reduce);
+        # the PILOSA_TPU_DEVICE_REDUCE env var still overrides per-run.
+        from pilosa_tpu.exec import device_reduce as _device_reduce
+        _device_reduce.set_mode(device_reduce)
         if self.cluster is not None:
             self.cluster.stats = self.stats
+            self.cluster.client.stats = self.stats
+            self.cluster.client.multiplex = multiplex
             self.syncer = HolderSyncer(self.holder, self.cluster,
                                        self.cluster.client)
             # Coordinator-primary key allocation (translate.go:93 model):

@@ -20,7 +20,20 @@ from pilosa_tpu.exec.result import FieldRow, GroupCount, Pair, ValCount
 
 #: binary frame response for remote queries (see encode_frames).
 FRAMES_CONTENT_TYPE = "application/x-pilosa-frames"
+#: Accept value advertising frame VERSION 2 (aggregate results as raw
+#: array blobs, not JSON int lists). Version negotiation is one-sided
+#: and safe in mixed-version clusters: an old peer substring-matches the
+#: base content type and answers v1 frames (which v2 clients decode),
+#: and an old client never sends ";v=2" so a new peer answers it v1.
+FRAMES_ACCEPT_V2 = FRAMES_CONTENT_TYPE + ";v=2"
 _FRAME_MAGIC = b"PTF1"
+
+#: multiplexed peer-channel envelope: N query legs in one POST
+#: /internal/query-mux request, N PTF1 frames (or per-leg errors) in
+#: one response (see encode_mux_request/encode_mux_response below).
+MUX_CONTENT_TYPE = "application/x-pilosa-mux"
+_MUX_MAGIC = b"PTM1"
+MUX_VERSION = 1
 
 
 def encode_result(r: Any) -> dict:
@@ -33,8 +46,14 @@ def encode_result(r: Any) -> dict:
         return {"t": "pair", "id": r.id, "count": r.count, "key": r.key}
     if isinstance(r, list):
         if r and isinstance(r[0], Pair):
-            return {"t": "pairs",
-                    "items": [[p.id, p.count] for p in r]}
+            d = {"t": "pairs",
+                 "items": [[p.id, p.count] for p in r]}
+            # Keyed TopN: ids alone lose the translated keys across the
+            # node boundary; ship them alongside (sparse fields stay
+            # absent so unkeyed results pay nothing).
+            if any(p.key for p in r):
+                d["keys"] = [p.key for p in r]
+            return d
         if r and isinstance(r[0], GroupCount):
             return {"t": "groupcounts",
                     "items": [{"group": [[fr.field, fr.row_id]
@@ -57,6 +76,10 @@ def decode_result(d: dict) -> Any:
     if t == "pair":
         return Pair(id=d["id"], count=d["count"], key=d.get("key", ""))
     if t == "pairs":
+        keys = d.get("keys")
+        if keys:
+            return [Pair(id=i, count=c, key=k)
+                    for (i, c), k in zip(d["items"], keys)]
         return [Pair(id=i, count=c) for i, c in d["items"]]
     if t == "groupcounts":
         return [GroupCount(group=[FieldRow(field=f, row_id=rid)
@@ -82,12 +105,100 @@ def decode_result(d: dict) -> Any:
 #
 # header = {"results": [...], "blobs": [len0, len1, ...]} where a Row
 # appears as {"t": "row_frame", "blob": k, "attrs": {...}}.
+#
+# VERSION 2 extends the binary sections to the aggregate results that
+# used to ride the JSON envelope as Python int lists — a 10k-group
+# GroupBy was a json walk on both ends:
+#
+#   {"t": "pairs_frame",  "ids": A, "counts": A, "keys": [...]?}
+#   {"t": "groupcounts_frame", "fields": [f...], "rows": A, "counts": A,
+#    "n": N}                        (rows = N x depth row-major u64)
+#   {"t": "rowids_frame", "ids": A}
+#   {"t": "valcount_frame", "vc": A}       (i64 [val, count])
+#
+# where A = {"blob": k, "dtype": "<u8", "n": N} exactly like PTI1
+# import arrays (u64 ids narrow to u32 when they fit; the dtype string
+# restores the width on decode). Aggregates below _AGG_BLOB_MIN items,
+# keyed group rows, and non-uniform group shapes keep the JSON metas —
+# both encodings decode bit-identically.
+
+#: below this many items the tagged-JSON meta is cheaper than blob
+#: bookkeeping; the cutover only changes the encoding, never the result.
+_AGG_BLOB_MIN = 16
 
 
-def encode_frames(results: list, extra: dict | None = None) -> bytes:
+def _arr_meta(a: np.ndarray, blobs: list[bytes]) -> dict:
+    """Append ``a`` as a binary section, return its header meta
+    (the PTI1 array idiom: u64 that fits 32 bits ships as u32)."""
+    if a.dtype == np.uint64 and len(a) and int(a.max()) < (1 << 32):
+        a = a.astype(np.uint32)
+    meta = {"blob": len(blobs), "dtype": a.dtype.str, "n": int(len(a))}
+    blobs.append(np.ascontiguousarray(a).tobytes())
+    return meta
+
+
+def _encode_agg_frame(r: Any, blobs: list[bytes]) -> dict | None:
+    """Binary meta for a large aggregate result, or None when the
+    tagged-JSON envelope is the better (or only faithful) encoding."""
+    if isinstance(r, ValCount):
+        if (isinstance(r.val, int) and isinstance(r.count, int)
+                and not isinstance(r.val, bool)):
+            return {"t": "valcount_frame",
+                    "vc": _arr_meta(np.array([r.val, r.count],
+                                             dtype=np.int64), blobs)}
+        return None
+    if not isinstance(r, list) or len(r) < _AGG_BLOB_MIN:
+        return None
+    if isinstance(r[0], Pair):
+        if not all(isinstance(p, Pair) for p in r):
+            return None
+        n = len(r)
+        meta = {"t": "pairs_frame",
+                "ids": _arr_meta(np.fromiter((p.id for p in r),
+                                             dtype=np.uint64, count=n),
+                                 blobs),
+                "counts": _arr_meta(np.fromiter((p.count for p in r),
+                                                dtype=np.int64, count=n),
+                                    blobs)}
+        if any(p.key for p in r):
+            meta["keys"] = [p.key for p in r]
+        return meta
+    if isinstance(r[0], GroupCount):
+        fields = [fr.field for fr in r[0].group]
+        uniform = all(
+            isinstance(gc, GroupCount) and len(gc.group) == len(fields)
+            and all(fr.field == f and not fr.row_key
+                    for fr, f in zip(gc.group, fields))
+            for gc in r)
+        if not uniform:
+            return None  # keyed / ragged groups keep the JSON meta
+        n = len(r)
+        rows = np.fromiter((fr.row_id for gc in r for fr in gc.group),
+                           dtype=np.uint64, count=n * len(fields))
+        counts = np.fromiter((gc.count for gc in r),
+                             dtype=np.int64, count=n)
+        return {"t": "groupcounts_frame", "fields": fields, "n": n,
+                "rows": _arr_meta(rows, blobs),
+                "counts": _arr_meta(counts, blobs)}
+    # Plain rowid lists (Rows() remote legs). Anything non-integral
+    # falls back to the JSON meta.
+    try:
+        ids = np.fromiter((int(x) for x in r), dtype=np.uint64,
+                          count=len(r))
+    except (TypeError, ValueError, OverflowError):
+        return None
+    return {"t": "rowids_frame", "ids": _arr_meta(ids, blobs)}
+
+
+def encode_frames(results: list, extra: dict | None = None,
+                  version: int = 2) -> bytes:
     """``extra`` merges response-level metadata (e.g. ``shardEpochs``,
     the serving node's pre-execution epoch vector) into the frame
-    header; decoders that don't know the keys ignore them."""
+    header; decoders that don't know the keys ignore them.
+
+    ``version=1`` keeps aggregates in the JSON envelope — the shape an
+    old (pre-v2) coordinator can decode; peers answer v1 unless the
+    client's Accept advertised ``;v=2`` (FRAMES_ACCEPT_V2)."""
     blobs: list[bytes] = []
     metas: list[dict] = []
     from pilosa_tpu import native
@@ -97,8 +208,9 @@ def encode_frames(results: list, extra: dict | None = None) -> bytes:
             metas.append({"t": "row_frame", "blob": len(blobs),
                           "attrs": r.attrs})
             blobs.append(native.encode_roaring(cols))
-        else:
-            metas.append(encode_result(r))
+            continue
+        m = _encode_agg_frame(r, blobs) if version >= 2 else None
+        metas.append(m if m is not None else encode_result(r))
     head = {"results": metas, "blobs": [len(b) for b in blobs]}
     if extra:
         head.update(extra)
@@ -186,31 +298,101 @@ def decode_import(data: bytes) -> dict:
         raise ValueError(f"malformed import frame: {e!r}") from e
 
 
-def _decode_header(data: bytes) -> dict:
-    if data[:4] != _FRAME_MAGIC:
-        raise ValueError("bad frame magic")
-    (hlen,) = struct.unpack_from("<I", data, 4)
-    return json.loads(data[8:8 + hlen].decode())
+def _decode_header(data: bytes, magic: bytes = _FRAME_MAGIC) -> dict:
+    """Raises ValueError on ANY malformation (bad magic, truncated or
+    undecodable header) so transport layers surface a clean protocol
+    error — never a stack trace — and HTTP maps it to 400."""
+    if data[:4] != magic:
+        raise ValueError(f"bad frame magic (want {magic!r})")
+    try:
+        (hlen,) = struct.unpack_from("<I", data, 4)
+        if 8 + hlen > len(data):
+            raise ValueError("truncated frame header")
+        header = json.loads(data[8:8 + hlen].decode())
+    except (struct.error, UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise ValueError(f"malformed frame header: {e!r}") from e
+    if not isinstance(header, dict):
+        raise ValueError("frame header is not an object")
+    return header
 
 
-def decode_frames(data: bytes) -> list[Any]:
-    header = _decode_header(data)
+def _split_blobs(data: bytes, header: dict) -> list[bytes]:
     (hlen,) = struct.unpack_from("<I", data, 4)
     off = 8 + hlen
     blobs = []
     for ln in header["blobs"]:
+        if not isinstance(ln, int) or ln < 0 or off + ln > len(data):
+            raise ValueError("truncated frame body")
         blobs.append(data[off:off + ln])
         off += ln
+    return blobs
+
+
+def _read_arr(meta: dict, blobs: list[bytes]) -> np.ndarray:
+    a = np.frombuffer(blobs[meta["blob"]], dtype=np.dtype(meta["dtype"]))
+    if len(a) != meta["n"]:
+        raise ValueError("frame array length mismatch")
+    return a
+
+
+def decode_frames(data: bytes) -> list[Any]:
+    """Raises ValueError on any malformed frame, like decode_import."""
+    header = _decode_header(data)
     from pilosa_tpu import native
-    out: list[Any] = []
-    for m in header["results"]:
-        if m.get("t") == "row_frame":
-            row = Row.from_columns(native.decode_roaring(blobs[m["blob"]]))
-            row.attrs = m.get("attrs") or {}
-            out.append(row)
-        else:
-            out.append(decode_result(m))
-    return out
+    try:
+        blobs = _split_blobs(data, header)
+        out: list[Any] = []
+        for m in header["results"]:
+            t = m.get("t")
+            if t == "row_frame":
+                # Batched device scatter: the leg's roaring positions
+                # upload once and every shard's word block builds in a
+                # single program (host fallback under the threshold).
+                from pilosa_tpu.exec import device_reduce
+                row = device_reduce.row_from_columns(
+                    native.decode_roaring(blobs[m["blob"]]))
+                row.attrs = m.get("attrs") or {}
+                out.append(row)
+            elif t == "pairs_frame":
+                ids = _read_arr(m["ids"], blobs)
+                counts = _read_arr(m["counts"], blobs)
+                if len(ids) != len(counts):
+                    raise ValueError("pairs frame id/count mismatch")
+                keys = m.get("keys")
+                if keys is not None and len(keys) != len(ids):
+                    raise ValueError("pairs frame key mismatch")
+                out.append([Pair(id=int(i), count=int(c),
+                                 key=keys[j] if keys else "")
+                            for j, (i, c) in enumerate(zip(ids, counts))])
+            elif t == "groupcounts_frame":
+                fields = m["fields"]
+                n = m["n"]
+                rows = _read_arr(m["rows"], blobs)
+                counts = _read_arr(m["counts"], blobs)
+                if len(counts) != n or len(rows) != n * len(fields):
+                    raise ValueError("groupcounts frame shape mismatch")
+                d = len(fields)
+                out.append([
+                    GroupCount(group=[FieldRow(field=f,
+                                               row_id=int(rows[i * d + j]))
+                                      for j, f in enumerate(fields)],
+                               count=int(counts[i]))
+                    for i in range(n)])
+            elif t == "rowids_frame":
+                out.append([int(x) for x in _read_arr(m["ids"], blobs)])
+            elif t == "valcount_frame":
+                vc = _read_arr(m["vc"], blobs)
+                if len(vc) != 2:
+                    raise ValueError("valcount frame shape mismatch")
+                out.append(ValCount(int(vc[0]), int(vc[1])))
+            else:
+                out.append(decode_result(m))
+        return out
+    except ValueError:
+        raise
+    except (struct.error, KeyError, IndexError, TypeError,
+            AttributeError) as e:
+        raise ValueError(f"malformed result frame: {e!r}") from e
 
 
 def decode_frames_meta(data: bytes) -> tuple[list[Any], dict]:
@@ -220,3 +402,85 @@ def decode_frames_meta(data: bytes) -> tuple[list[Any], dict]:
     (tests patch it to assert the frame path was taken) still observes
     every decode."""
     return decode_frames(data), _decode_header(data)
+
+
+# -- multiplexed peer channel (batch envelope) ------------------------------
+#
+# Under concurrent load a coordinator used to open one HTTP request per
+# peer PER QUERY; the peer channel coalesces concurrent outbound legs
+# to the same peer into one request. Layout mirrors the frame format:
+#
+#   "PTM1" | u32 header_len | header JSON [| response blobs]
+#
+# request header  = {"v": 1, "legs": [{"index", "query", "shards"?,
+#                    "timeoutMs"?, "trace"?}, ...]}
+# response header = {"v": 1, "legs": [{"blob": k} | {"status": s,
+#                    "error": msg, "retryAfter": secs?}],
+#                    "blobs": [len0, ...]}
+#
+# Each response blob is a complete PTF1 frame (per-leg shardEpochs and
+# all), so per-leg semantics — deadline, epoch stamps, quarantine 503s,
+# shed retries — survive the batching. The envelope is VERSIONED: an
+# old peer 404s the route (or 400s the magic) and the client falls back
+# to per-query requests, so mixed-version clusters keep working.
+
+
+def encode_mux_request(legs: list[dict]) -> bytes:
+    header = json.dumps({"v": MUX_VERSION, "legs": legs}).encode()
+    return b"".join([_MUX_MAGIC, struct.pack("<I", len(header)), header])
+
+
+def decode_mux_request(data: bytes) -> list[dict]:
+    """Raises ValueError on malformed/unknown-version envelopes (HTTP
+    maps it to 400 — the signal an old-version client needs)."""
+    header = _decode_header(data, magic=_MUX_MAGIC)
+    try:
+        if header["v"] != MUX_VERSION:
+            raise ValueError(f"unsupported mux version {header['v']!r}")
+        legs = header["legs"]
+        if not isinstance(legs, list) or not all(
+                isinstance(leg, dict) and "index" in leg and "query" in leg
+                for leg in legs):
+            raise ValueError("malformed mux legs")
+        return legs
+    except (KeyError, TypeError) as e:
+        raise ValueError(f"malformed mux request: {e!r}") from e
+
+
+def encode_mux_response(outcomes: list[dict]) -> bytes:
+    """``outcomes``: per leg either {"frame": <PTF1 bytes>} or
+    {"status": int, "error": str, "retryAfter": float|None}."""
+    blobs: list[bytes] = []
+    metas: list[dict] = []
+    for o in outcomes:
+        if "frame" in o:
+            metas.append({"blob": len(blobs)})
+            blobs.append(o["frame"])
+        else:
+            metas.append({"status": int(o["status"]),
+                          "error": o.get("error", ""),
+                          "retryAfter": o.get("retryAfter")})
+    header = json.dumps({"v": MUX_VERSION, "legs": metas,
+                         "blobs": [len(b) for b in blobs]}).encode()
+    return b"".join([_MUX_MAGIC, struct.pack("<I", len(header)), header]
+                    + blobs)
+
+
+def decode_mux_response(data: bytes) -> list[dict]:
+    """Inverse of encode_mux_response; ValueError on malformation."""
+    header = _decode_header(data, magic=_MUX_MAGIC)
+    try:
+        blobs = _split_blobs(data, header)
+        out = []
+        for m in header["legs"]:
+            if "blob" in m:
+                out.append({"frame": blobs[m["blob"]]})
+            else:
+                out.append({"status": int(m["status"]),
+                            "error": m.get("error", ""),
+                            "retryAfter": m.get("retryAfter")})
+        return out
+    except ValueError:
+        raise
+    except (KeyError, IndexError, TypeError) as e:
+        raise ValueError(f"malformed mux response: {e!r}") from e

@@ -1,0 +1,405 @@
+"""Distributed fan-out wire path: binary aggregate frames (PTF1 v2),
+the multiplexed peer channel (PTM1), and the device-side reduce.
+
+Equivalence discipline: every optimized path (v2 frames, device fold,
+multiplexed channel) must be BIT-IDENTICAL to the path it replaces —
+the tests here force each side on and compare.
+"""
+
+import json
+import socket
+import struct
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.config import SHARD_WIDTH
+from pilosa_tpu.cluster.harness import LocalCluster
+from pilosa_tpu.core.row import Row
+from pilosa_tpu.exec import device_reduce
+from pilosa_tpu.exec.result import (
+    GroupCount,
+    FieldRow,
+    Pair,
+    ValCount,
+    merge_pairs,
+)
+from pilosa_tpu.server import wire
+
+
+def _canon(r):
+    """Order-independent canonical form for result comparison."""
+    if isinstance(r, Row):
+        return ("row", tuple(int(c) for c in np.sort(r.columns())))
+    if isinstance(r, list) and r and isinstance(r[0], Pair):
+        return ("pairs", tuple(sorted((p.id, p.count, p.key) for p in r)))
+    if isinstance(r, list) and r and isinstance(r[0], GroupCount):
+        return ("groups", tuple(sorted(
+            (tuple((fr.field, fr.row_id) for fr in g.group), g.count)
+            for g in r)))
+    if isinstance(r, list):
+        return ("list", tuple(sorted(int(x) for x in r)))
+    if isinstance(r, ValCount):
+        return ("valcount", r.val, r.count)
+    return ("scalar", r)
+
+
+# -- Pair.key regression ----------------------------------------------------
+
+
+def test_wire_pair_key_survives_encode_result():
+    """Regression: encode_result dropped Pair.key, so keyed TopN results
+    lost their keys crossing the wire (coordinator re-looked-up or
+    returned blank keys)."""
+    pairs = [Pair(id=1, count=10, key="alpha"),
+             Pair(id=2, count=5, key="beta"),
+             Pair(id=3, count=1, key="")]
+    back = wire.decode_result(wire.encode_result(pairs))
+    assert [(p.id, p.count, p.key) for p in back] == \
+        [(p.id, p.count, p.key) for p in pairs]
+
+
+def test_wire_pair_key_survives_frames():
+    pairs = [Pair(id=7, count=3, key="k7"), Pair(id=9, count=1, key="k9")]
+    for version in (1, 2):
+        (back,), _ = wire.decode_frames_meta(
+            wire.encode_frames([pairs], version=version))
+        assert [(p.id, p.count, p.key) for p in back] == \
+            [(p.id, p.count, p.key) for p in pairs], version
+
+
+def test_wire_merge_pairs_keeps_keys():
+    a = [Pair(id=1, count=2, key="one")]
+    b = [Pair(id=1, count=3, key="one"), Pair(id=2, count=4, key="two")]
+    merged = {p.id: (p.count, p.key) for p in merge_pairs(a, b)}
+    assert merged == {1: (5, "one"), 2: (4, "two")}
+
+
+# -- frame codec property test ----------------------------------------------
+
+
+def _random_results(rng):
+    out = []
+    out.append(Row.from_columns(
+        rng.choice(4 * SHARD_WIDTH, rng.integers(0, 200), replace=False)))
+    out.append(Row())  # empty row
+    out.append([Pair(id=int(i), count=int(c),
+                     key=(f"k{i}" if rng.random() < 0.5 else ""))
+                for i, c in zip(rng.integers(0, 2**40, 8),
+                                rng.integers(1, 2**33, 8))])
+    out.append([GroupCount(group=[FieldRow(field="a", row_id=int(i)),
+                                  FieldRow(field="b", row_id=int(j))],
+                           count=int(c))
+                for i, j, c in zip(rng.integers(0, 50, 6),
+                                   rng.integers(0, 50, 6),
+                                   rng.integers(1, 10**6, 6))])
+    out.append(ValCount(val=int(rng.integers(-2**40, 2**40)),
+                        count=int(rng.integers(0, 2**33))))
+    out.append(sorted(int(x) for x in rng.integers(0, 2**35, 12)))
+    out.append(int(rng.integers(0, 2**50)))
+    out.append(bool(rng.random() < 0.5))
+    out.append(None)
+    return out
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+@pytest.mark.parametrize("version", [1, 2])
+def test_wire_frames_random_roundtrip(seed, version):
+    rng = np.random.default_rng(seed)
+    results = _random_results(rng)
+    extra = {"shardEpochs": {"0": 3, "7": 1}}
+    data = wire.encode_frames(results, extra=extra, version=version)
+    back, header = wire.decode_frames_meta(data)
+    assert header.get("shardEpochs") == extra["shardEpochs"]
+    assert len(back) == len(results)
+    for want, got in zip(results, back):
+        assert _canon(want) == _canon(got), (version, type(want))
+
+
+def test_wire_frames_v2_aggregates_are_binary():
+    """v2 must actually ship aggregates as blobs, not JSON — the point
+    of the format."""
+    pairs = [Pair(id=i, count=i * 3) for i in range(4096)]
+    v1 = wire.encode_frames([pairs], version=1)
+    v2 = wire.encode_frames([pairs], version=2)
+    assert len(v2) < len(v1)
+    hlen = struct.unpack("<I", v2[4:8])[0]
+    (meta,) = json.loads(v2[8:8 + hlen])["results"]
+    assert meta["t"] == "pairs_frame"
+    assert meta["ids"]["dtype"] == "<u4"  # ids < 2^32 narrow to u32
+    (back,), _ = wire.decode_frames_meta(v2)
+    assert _canon(back) == _canon(pairs)
+
+
+@pytest.mark.parametrize("mangle", ["magic", "truncate_header",
+                                    "truncate_body", "garbage_header",
+                                    "short"])
+def test_wire_frames_corrupt_rejected(mangle):
+    rng = np.random.default_rng(5)
+    data = wire.encode_frames(_random_results(rng), version=2)
+    if mangle == "magic":
+        bad = b"XXXX" + data[4:]
+    elif mangle == "truncate_header":
+        bad = data[:6]
+    elif mangle == "truncate_body":
+        bad = data[:-7]
+    elif mangle == "garbage_header":
+        hlen = struct.unpack("<I", data[4:8])[0]
+        bad = data[:8] + b"{" * hlen + data[8 + hlen:]
+    else:
+        bad = b"PT"
+    with pytest.raises(ValueError):
+        wire.decode_frames_meta(bad)
+
+
+# -- mux envelope -----------------------------------------------------------
+
+
+def test_wire_mux_envelope_roundtrip():
+    legs = [{"index": "i", "query": "Count(Row(f=1))", "shards": [0, 2],
+             "timeoutMs": 1500, "trace": "abc"},
+            {"index": "j", "query": "Row(g=2)"}]
+    assert wire.decode_mux_request(wire.encode_mux_request(legs)) == legs
+
+    frame = wire.encode_frames([42], version=2)
+    outcomes = [{"frame": frame},
+                {"status": 503, "error": "shed", "retryAfter": 0.5},
+                {"status": 404, "error": "missing"}]
+    back = wire.decode_mux_response(wire.encode_mux_response(outcomes))
+    assert back[0]["frame"] == frame
+    assert (back[1]["status"], back[1]["error"],
+            back[1]["retryAfter"]) == (503, "shed", 0.5)
+    assert (back[2]["status"], back[2]["error"]) == (404, "missing")
+
+
+def test_wire_mux_rejects_bad_envelopes():
+    good = wire.encode_mux_request([{"index": "i", "query": "q"}])
+    with pytest.raises(ValueError):
+        wire.decode_mux_request(b"NOPE" + good[4:])
+    with pytest.raises(ValueError):
+        wire.decode_mux_request(good[:5])
+    # wrong version
+    hdr = json.dumps({"v": 99, "legs": [{"index": "i", "query": "q"}]})
+    bad = b"PTM1" + struct.pack("<I", len(hdr)) + hdr.encode()
+    with pytest.raises(ValueError):
+        wire.decode_mux_request(bad)
+    # legs missing required fields
+    hdr = json.dumps({"v": 1, "legs": [{"index": "i"}]})
+    bad = b"PTM1" + struct.pack("<I", len(hdr)) + hdr.encode()
+    with pytest.raises(ValueError):
+        wire.decode_mux_request(bad)
+
+
+# -- device-side reduce -----------------------------------------------------
+
+
+def test_device_reduce_row_from_columns_matches_host(monkeypatch):
+    rng = np.random.default_rng(11)
+    cols = rng.choice(6 * SHARD_WIDTH, 5000, replace=False)
+    monkeypatch.setenv("PILOSA_TPU_DEVICE_REDUCE", "on")
+    dev = device_reduce.row_from_columns(cols)
+    monkeypatch.setenv("PILOSA_TPU_DEVICE_REDUCE", "off")
+    host = device_reduce.row_from_columns(cols)
+    assert sorted(dev.segments) == sorted(host.segments)
+    for s in host.segments:
+        assert np.array_equal(np.asarray(dev.segments[s]),
+                              np.asarray(host.segments[s])), s
+
+
+def test_device_reduce_union_rows_matches_chained_union(monkeypatch):
+    rng = np.random.default_rng(13)
+    rows = []
+    for _ in range(5):
+        # overlapping shard sets so some shards are contested
+        cols = rng.choice(3 * SHARD_WIDTH, 2000, replace=False)
+        rows.append(Row.from_columns(cols))
+    want = rows[0].union(*rows[1:])
+    for m in ("on", "off", "auto"):
+        monkeypatch.setenv("PILOSA_TPU_DEVICE_REDUCE", m)
+        got = device_reduce.union_rows(list(rows))
+        assert np.array_equal(np.sort(got.columns()),
+                              np.sort(want.columns())), m
+
+
+def test_device_reduce_single_leg_passthrough():
+    r = Row.from_columns([1, 2, 3])
+    r.attrs["x"] = 1
+    out = device_reduce.union_rows([r, None])
+    assert out is r  # one contributor: passthrough, attrs intact
+    assert device_reduce.union_rows([]) is None
+
+
+def test_device_reduce_cluster_on_off_equivalence(monkeypatch):
+    """4-node cluster, device fold forced on vs off: every result type
+    coming back through map_reduce must be identical."""
+    n_shards = 8
+    rng = np.random.default_rng(17)
+    lc = LocalCluster(4)
+    lc.create_index("c")
+    lc.create_field("c", "a")
+    lc.create_field("c", "b")
+    total = n_shards * SHARD_WIDTH
+    cl0 = lc.nodes[0].cluster
+    groups = cl0.shards_by_node(cl0.nodes, "c", list(range(n_shards)))
+    node_by_id = {cn.id: cn for cn in lc.nodes}
+    for fld, n_rows in (("a", 3), ("b", 4)):
+        rows = rng.integers(0, n_rows, 30000).astype(np.uint64)
+        cols = rng.integers(0, total, 30000).astype(np.uint64)
+        shard_of = (cols // np.uint64(SHARD_WIDTH)).astype(np.int64)
+        for node_id, shs in groups.items():
+            mask = np.isin(shard_of, shs)
+            node_by_id[node_id].handle_import_request(
+                "c", fld, rows=rows[mask], cols=cols[mask])
+    queries = ["Count(Intersect(Row(a=1), Row(b=2)))",
+               "Row(a=1)",
+               "Union(Row(a=0), Row(b=3))",
+               "TopN(a, n=3)",
+               "GroupBy(Rows(a), Rows(b))"]
+    results = {}
+    for m in ("on", "off"):
+        monkeypatch.setenv("PILOSA_TPU_DEVICE_REDUCE", m)
+        results[m] = [lc.query("c", q, cache=False) for q in queries]
+    for q, on, off in zip(queries, results["on"], results["off"]):
+        assert [_canon(r) for r in on] == [_canon(r) for r in off], q
+
+
+def test_cluster_tree_reduce_failover():
+    """Completion-order folding + deferred row union must preserve the
+    failover contract: a downed node's shards re-fold on replicas."""
+    lc = LocalCluster(3, replica_n=2)
+    lc.create_index("i")
+    lc.create_field("i", "f")
+    cols = [1, SHARD_WIDTH + 2, 2 * SHARD_WIDTH + 3, 3 * SHARD_WIDTH + 4]
+    for c in cols:
+        lc.query("i", f"Set({c}, f=7)")
+    assert lc.query("i", "Count(Row(f=7))") == [len(cols)]
+    lc.down("node1")
+    try:
+        assert lc.query("i", "Count(Row(f=7))", node=0,
+                        cache=False) == [len(cols)]
+        (row,) = lc.query("i", "Row(f=7)", node=0, cache=False)
+        assert sorted(int(c) for c in row.columns()) == cols
+    finally:
+        lc.up("node1")
+
+
+# -- HTTP cluster: multiplexed channel --------------------------------------
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _post(base, path, data=b"", method="POST"):
+    req = urllib.request.Request(base + path, method=method, data=data)
+    with urllib.request.urlopen(req) as r:
+        return r.read()
+
+
+@pytest.fixture
+def http_pair():
+    from pilosa_tpu.server.node import ServerNode
+    addrs = [f"127.0.0.1:{_free_port()}" for _ in range(2)]
+    nodes = [ServerNode(bind=a, peers=[b for b in addrs if b != a],
+                        use_planner=False, anti_entropy_interval=0.0,
+                        check_nodes_interval=0.0) for a in addrs]
+    for n in nodes:
+        n.open()
+    try:
+        base = f"http://{addrs[0]}"
+        _post(base, "/index/i", b"{}")
+        _post(base, "/index/i/field/f", b"{}")
+        rng = np.random.default_rng(29)
+        cols = [int(c) for c in
+                rng.choice(4 * SHARD_WIDTH, 3000, replace=False)]
+        rows = [int(r) for r in rng.integers(0, 3, 3000)]
+        _post(base, "/index/i/field/f/import",
+              json.dumps({"rowIDs": rows, "columnIDs": cols}).encode())
+        yield nodes, base
+    finally:
+        for n in nodes:
+            n.close()
+
+
+_QUERIES = ["Count(Row(f=0))", "Row(f=1)", "TopN(f, n=2)"]
+
+
+def _run_queries(base):
+    out = []
+    for q in _QUERIES:
+        out.append(_post(base, "/index/i/query?noCache=true", q.encode()))
+    return out
+
+
+def test_cluster_multiplex_on_off_equivalence(http_pair, monkeypatch):
+    nodes, base = http_pair
+    monkeypatch.setenv("PILOSA_TPU_MULTIPLEX", "on")
+    with_mux = _run_queries(base)
+    client = nodes[0].cluster.client
+    assert client._channels, "mux channel never engaged"
+    assert not client._mux_unsupported
+    monkeypatch.setenv("PILOSA_TPU_MULTIPLEX", "off")
+    without_mux = _run_queries(base)
+    assert with_mux == without_mux
+
+
+def test_cluster_mux_fallback_to_per_query(http_pair, monkeypatch):
+    """A peer that 404s the mux route (old version) must be remembered
+    and served per-query — same answers, no error surfaced."""
+    nodes, base = http_pair
+    client = nodes[0].cluster.client
+    monkeypatch.setenv("PILOSA_TPU_MULTIPLEX", "on")
+    want = _run_queries(base)
+    client._mux_unsupported.clear()
+    real_http = client._http
+
+    import email.message
+
+    def http_404_mux(url, method="GET", body=None, headers=None,
+                     timeout=None):
+        if url.endswith("/internal/query-mux"):
+            return 404, email.message.Message(), b"not found"
+        return real_http(url, method, body, headers, timeout)
+
+    monkeypatch.setattr(client, "_http", http_404_mux)
+    got = _run_queries(base)
+    assert got == want
+    assert client._mux_unsupported  # peer remembered as old-version
+
+
+def test_cluster_wire_counters_exported(http_pair):
+    nodes, base = http_pair
+    _run_queries(base)
+    data = json.loads(_post(base, "/debug/vars", method="GET"))
+    flat = json.dumps(data)
+    for key in ("cluster.wireBytesOut", "cluster.wireBytesIn",
+                "cluster.wireDecodeMs"):
+        assert key in flat, key
+    st = nodes[0].stats
+    assert st.counter_value("cluster.wireBytesOut") > 0
+    assert st.counter_value("cluster.wireBytesIn") > 0
+
+
+def test_cluster_remote_leg_spans_traced(http_pair):
+    """Every remote leg gets a span tagged with node id, shard count,
+    and payload bytes."""
+    import pilosa_tpu.obs.tracing as tracing_mod
+    nodes, base = http_pair
+    tracer = tracing_mod.SimpleTracer()
+    old = tracing_mod.get_tracer()
+    tracing_mod.set_tracer(tracer)
+    try:
+        _run_queries(base)
+    finally:
+        tracing_mod.set_tracer(old)
+    legs = [s for s in tracer.spans if s.operation == "cluster.remoteLeg"]
+    assert legs, "no remote-leg spans recorded"
+    tagged = [s for s in legs if "bytesIn" in s.tags and "bytesOut" in s.tags]
+    assert tagged, "remote-leg spans missing wire byte tags"
+    assert all(s.tags.get("node") for s in legs)
+    assert all("shards" in s.tags for s in legs)
