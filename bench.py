@@ -877,6 +877,140 @@ def bench_bsi(extra):
 
 
 # ---------------------------------------------------------------------------
+# config 3b: streaming ingestion (import stream + WAL group commit +
+# ingest/query isolation)
+# ---------------------------------------------------------------------------
+
+
+def bench_ingest(extra):
+    import tempfile
+    import threading
+
+    from pilosa_tpu.config import SHARD_WIDTH
+    from pilosa_tpu.server.httpclient import HTTPInternalClient, NodeHTTPError
+    from pilosa_tpu.server.node import ServerNode
+    from pilosa_tpu.cluster.node import URI, Node
+    from pilosa_tpu.storage.wal import WalWriter
+
+    n = ServerNode(bind="127.0.0.1:0", use_planner=False,
+                   qos_max_concurrent=8, ingest_max_inflight_mb=64)
+    n.open()
+    client = HTTPInternalClient(timeout=120)
+    try:
+        base = n.address
+        peer = Node(id=f"127.0.0.1:{n.port}",
+                    uri=URI(host="127.0.0.1", port=n.port))
+
+        def post(path, body):
+            import urllib.request
+            r = urllib.request.Request(base + path, data=body.encode(),
+                                       method="POST")
+            with urllib.request.urlopen(r, timeout=60) as resp:
+                return resp.read()
+
+        post("/index/ing", "{}")
+        post("/index/ing/field/v",
+             json.dumps({"options": {"type": "int", "min": -100_000,
+                                     "max": 100_000}}))
+        post("/index/ing/field/f", "{}")
+        rng = np.random.default_rng(23)
+        n_shards, per_shard = 8, 250_000
+        total = n_shards * per_shard
+        reqs = []
+        for s in range(n_shards):
+            cols = (s * SHARD_WIDTH
+                    + rng.choice(SHARD_WIDTH, per_shard,
+                                 replace=False).astype(np.uint64))
+            vals = rng.integers(-100_000, 100_000, per_shard)
+            reqs.append({"kind": "field", "index": "ing", "field": "v",
+                         "shard": s, "rowIDs": None, "columnIDs": cols,
+                         "values": vals, "clear": False})
+        # warm the apply path (fresh fields each timed trial below)
+        client.send_import_stream(peer, reqs[:1])
+        rates = []
+        for t in range(3):
+            fname = f"v{t}"
+            post(f"/index/ing/field/{fname}",
+                 json.dumps({"options": {"type": "int", "min": -100_000,
+                                         "max": 100_000}}))
+            trial = [dict(r, field=fname) for r in reqs]
+            t0 = time.perf_counter()
+            client.send_import_stream(peer, trial)
+            rates.append(total / (time.perf_counter() - t0) / 1e6)
+        extra["bsi_import_stream_mvals_per_s"] = round(
+            statistics.median(rates), 2)
+
+        # interactive p99 while the stream hammers the node
+        body = json.dumps({
+            "rowIDs": rng.integers(0, 8, 100_000).tolist(),
+            "columnIDs": rng.integers(0, n_shards * SHARD_WIDTH,
+                                      100_000).tolist()})
+        post("/index/ing/field/f/import", body)
+
+        def q99(k):
+            lat = []
+            for i in range(k):
+                t0 = time.perf_counter()
+                post("/index/ing/query", f"Count(Row(f={i % 8}))")
+                lat.append(time.perf_counter() - t0)
+            return _p99(lat)
+
+        q99(10)  # warm
+        stop = threading.Event()
+
+        def ingest():
+            t = 0
+            while not stop.is_set():
+                fname = f"bg{t % 2}"
+                try:
+                    post(f"/index/ing/field/{fname}",
+                         json.dumps({"options": {"type": "int",
+                                                 "min": -100_000,
+                                                 "max": 100_000}}))
+                    client.send_import_stream(
+                        peer, [dict(r, field=fname) for r in reqs])
+                except (NodeHTTPError, ConnectionError, OSError):
+                    pass
+                t += 1
+
+        th = threading.Thread(target=ingest, daemon=True)
+        th.start()
+        try:
+            extra["import_while_query_p99_ms"] = round(q99(40), 3)
+        finally:
+            stop.set()
+            th.join(timeout=120)
+    finally:
+        client.close()
+        n.close()
+
+    # WAL group commit: fsyncs per million values at a bulk batch size,
+    # concurrent appenders sharing the flush window.
+    with tempfile.TemporaryDirectory() as td:
+        w = WalWriter(os.path.join(td, "g.wal"), fsync_appends=True,
+                      group_window=0.002)
+        n_threads, appends, batch = 8, 40, 25_000
+        rows = np.ones(batch, dtype=np.uint64)
+        cols = np.arange(batch, dtype=np.uint64)
+
+        def run():
+            for _ in range(appends):
+                w.append("addBatch", rows, cols)
+
+        threads = [threading.Thread(target=run) for _ in range(n_threads)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        mvals = n_threads * appends * batch / 1e6
+        extra["wal_group_commit_fsyncs_per_mval"] = round(w.fsyncs / mvals, 2)
+        extra["wal_group_commit_mvals_per_s"] = round(
+            mvals / (time.perf_counter() - t0), 2)
+        w.close()
+
+
+# ---------------------------------------------------------------------------
 # config 4: time-quantum views
 # ---------------------------------------------------------------------------
 
@@ -1287,8 +1421,8 @@ def main() -> None:
 
     want = (set(c.strip() for c in CONFIGS.split(","))
             if CONFIGS != "all"
-            else {"star", "topn", "bsi", "time", "cluster", "cache",
-                  "oversub", "backup", "overload"})
+            else {"star", "topn", "bsi", "ingest", "time", "cluster",
+                  "cache", "oversub", "backup", "overload"})
     extra: dict = {"backend": jax.default_backend(),
                    "devices": len(jax.devices())}
 
@@ -1320,6 +1454,7 @@ def main() -> None:
     if "star" in want:
         qps, cpu_qps = bench_star_trace(extra)
     for name, fn in (("topn", bench_topn), ("bsi", bench_bsi),
+                     ("ingest", bench_ingest),
                      ("time", bench_time), ("cluster", bench_cluster),
                      ("cache", bench_cache),
                      ("oversub", bench_oversubscribed),

@@ -114,6 +114,15 @@ _DEFAULTS = {
     # multiplexed request (POST /internal/query-mux). Peers that don't
     # speak the envelope automatically get per-query requests.
     "multiplex": True,
+    # Device-side BSI bit-plane transpose for bulk value imports:
+    # "auto" picks host vs device by batch size (bit-identical).
+    "ingest_transpose": "auto",
+    # WAL group commit: fsync window in ms when fsync-per-append is on
+    # (0 = one fsync per append; concurrent appends share one fsync).
+    "wal_group_commit_ms": 0.0,
+    # Import-stream in-flight byte budget, MB (0 = unbounded); over
+    # budget trips 429 + Retry-After instead of queueing.
+    "ingest_max_inflight_mb": 0,
 }
 
 
@@ -209,6 +218,12 @@ def cmd_server(args) -> int:
         cfg["device_reduce"] = args.device_reduce
     if args.multiplex is not None:
         cfg["multiplex"] = args.multiplex == "on"
+    if args.ingest_transpose is not None:
+        cfg["ingest_transpose"] = args.ingest_transpose
+    if args.wal_group_commit_ms is not None:
+        cfg["wal_group_commit_ms"] = args.wal_group_commit_ms
+    if args.ingest_max_inflight_mb is not None:
+        cfg["ingest_max_inflight_mb"] = args.ingest_max_inflight_mb
 
     from pilosa_tpu.server.node import ServerNode
     node = ServerNode(
@@ -254,6 +269,9 @@ def cmd_server(args) -> int:
         device_reduce=str(cfg["device_reduce"]) or "auto",
         multiplex=(str(cfg["multiplex"]).lower()
                    in ("1", "true", "yes", "on")),
+        ingest_transpose=str(cfg["ingest_transpose"]) or "auto",
+        wal_group_commit_ms=float(cfg["wal_group_commit_ms"]),
+        ingest_max_inflight_mb=int(cfg["ingest_max_inflight_mb"]),
     )
     node.open()  # starts the (single) serve loop in the background
     print(f"pilosa-tpu serving at {node.address}", file=sys.stderr)
@@ -676,7 +694,14 @@ def cmd_generate_config(args) -> int:
           '# plan-keyed result cache: budget in MB (0 disables) and TTL\n'
           '# backstop in seconds (0 = epoch invalidation only)\n'
           'result-cache-mb = 64\n'
-          'result-cache-ttl = 0.0')
+          'result-cache-ttl = 0.0\n'
+          '# device-side BSI bit-plane transpose for bulk value imports\n'
+          'ingest-transpose = "auto"\n'
+          '# WAL group-commit fsync window, ms (0 = fsync per append)\n'
+          'wal-group-commit-ms = 0.0\n'
+          '# import-stream in-flight budget, MB (0 = unbounded;\n'
+          '# over budget replies 429 + Retry-After + applied count)\n'
+          'ingest-max-inflight-mb = 0')
     return 0
 
 
@@ -763,6 +788,18 @@ def main(argv: list[str] | None = None) -> int:
     s.add_argument("--multiplex", choices=("on", "off"), default=None,
                    help="coalesce concurrent legs to one peer into a "
                         "single multiplexed request (default on)")
+    s.add_argument("--ingest-transpose", choices=("on", "off", "auto"),
+                   default=None,
+                   help="device-side BSI bit-plane transpose for bulk "
+                        "value imports (default auto; bit-identical)")
+    s.add_argument("--wal-group-commit-ms", type=float, default=None,
+                   help="WAL group-commit fsync window in ms when "
+                        "fsync-per-append is enabled (default 0 = one "
+                        "fsync per append)")
+    s.add_argument("--ingest-max-inflight-mb", type=int, default=None,
+                   help="import-stream in-flight byte budget, MB "
+                        "(default 0 = unbounded; over budget replies "
+                        "429 + Retry-After)")
     s.add_argument("--config", default=None)
     s.set_defaults(fn=cmd_server)
 

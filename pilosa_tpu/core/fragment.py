@@ -103,6 +103,9 @@ class Fragment:
         #: generation-stamped concatenated sparse-row index — see
         #: _sparse_index().
         self._sparse_cache: tuple | None = None
+        #: generation-stamped (gen, depth, [depth+1, W] words) host stack
+        #: of the sign + magnitude planes — see value().
+        self._value_stack: tuple | None = None
         self._lock = threading.RLock()
         # device caches: row_id -> (gen, jax.Array[W]); stack key -> (gen, ids, jax.Array[n, W])
         self._dev_rows: dict[int, tuple[int, jax.Array]] = {}
@@ -128,6 +131,7 @@ class Fragment:
         # would pin HBM forever; drop them eagerly.
         self._dev_rows.clear()
         self._dev_stacks.clear()
+        self._value_stack = None
 
     def set_bit(self, row_id: int, column_id: int) -> bool:
         with self._lock:
@@ -739,17 +743,55 @@ class Fragment:
                     changed |= self.clear_bit(BSI_OFFSET_BIT + i, column_id)
             return changed
 
+    #: exists-plane cardinality below which value() keeps the per-bit
+    #: probe loop: materializing the plane stack costs O(depth * W), a
+    #: loss for tiny fragments but amortized across the thousands of
+    #: lookups row materialization makes against a big one.
+    VALUE_STACK_MIN = 2048
+
     def value(self, column_id: int, bit_depth: int) -> tuple[int, bool]:
-        """(value, exists) — reference fragment.value (fragment.go:897)."""
+        """(value, exists) — reference fragment.value (fragment.go:897).
+
+        Row materialization calls this per column, so the per-bit
+        ``contains`` loop (one dict probe + searchsorted per plane) was
+        the hot path. Planes gather instead as ONE fancy-index into a
+        generation-stamped ``[depth+1, W]`` host word stack (sign row
+        first, then magnitude rows) rebuilt lazily after mutations."""
         if not self.contains(BSI_EXISTS_BIT, column_id):
             return 0, False
-        mag = 0
-        for i in range(bit_depth):
-            if self.contains(BSI_OFFSET_BIT + i, column_id):
-                mag |= 1 << i
-        if self.contains(BSI_SIGN_BIT, column_id):
-            mag = -mag
-        return mag, True
+        pos = self._local(column_id)
+        vs = self._value_stack
+        if vs is None or vs[0] != self.generation or vs[1] < bit_depth:
+            hr_e = self.rows.get(BSI_EXISTS_BIT)
+            if hr_e is None or hr_e.n < self.VALUE_STACK_MIN:
+                mag = 0
+                for i in range(bit_depth):
+                    if self.contains(BSI_OFFSET_BIT + i, column_id):
+                        mag |= 1 << i
+                if self.contains(BSI_SIGN_BIT, column_id):
+                    mag = -mag
+                return mag, True
+            vs = self._build_value_stack(bit_depth)
+        words = vs[2][: bit_depth + 1, pos >> 5]  # one gather across planes
+        on = (words >> np.uint32(pos & 31)) & np.uint32(1)
+        mag = int(on[1:].astype(np.uint64)
+                  @ (np.uint64(1) << np.arange(bit_depth, dtype=np.uint64)))
+        return (-mag if int(on[0]) else mag), True
+
+    def _build_value_stack(self, bit_depth: int) -> tuple:
+        with self._lock:
+            vs = self._value_stack
+            if vs is not None and vs[0] == self.generation and vs[1] >= bit_depth:
+                return vs
+            mat = np.zeros((bit_depth + 1, WORDS_PER_SHARD), dtype=np.uint32)
+            ids = [BSI_SIGN_BIT] + list(range(BSI_OFFSET_BIT,
+                                              BSI_OFFSET_BIT + bit_depth))
+            for i, rid in enumerate(ids):
+                hr = self.rows.get(rid)
+                if hr is not None and hr.n:
+                    mat[i] = hr.to_words()
+            vs = self._value_stack = (self.generation, bit_depth, mat)
+            return vs
 
     def import_values(self, column_ids, values, bit_depth: int, clear: bool = False) -> None:
         """Batched BSI write (reference importValue fragment.go:2205),
@@ -773,6 +815,10 @@ class Fragment:
         # Keep the LAST occurrence of each duplicated column.
         local_u, idx = np.unique(local_all[::-1], return_index=True)
         vals_u = vals[::-1][idx]
+        from pilosa_tpu.exec import ingest_transpose
+        if ingest_transpose.use_device(len(local_u) * (bit_depth + 2)):
+            self._import_values_device(local_u, vals_u, bit_depth)
+            return
         neg = vals_u < 0
         mag = np.abs(vals_u).astype(np.uint64)
 
@@ -807,6 +853,89 @@ class Fragment:
         with self._lock:  # one atomic overwrite, clears before sets
             _run(clr_rows, clr_cols, True)
             _run(set_rows, set_cols, False)
+
+    def _import_values_device(self, local_u: np.ndarray, vals_u: np.ndarray,
+                              bit_depth: int) -> None:
+        """Device half of import_values: one jitted transpose yields the
+        full ``[depth+2, W]`` plane image for the deduplicated batch,
+        merged here with word ops. Bit-identical to the host plane
+        loop: row 0 doubles as the written-column mask, so
+        ``(old & ~mask) | new`` is exactly clear-then-set per column
+        (exists only ever ORs in — columns are never un-existed)."""
+        from pilosa_tpu.exec import ingest_transpose
+        planes = ingest_transpose.transpose_planes(local_u, vals_u, bit_depth)
+        colmask = planes[0]
+        notmask = np.invert(colmask)
+        plane_ids = [BSI_EXISTS_BIT, BSI_SIGN_BIT] + list(
+            range(BSI_OFFSET_BIT, BSI_OFFSET_BIT + bit_depth))
+        with self._lock:
+            added = removed = 0
+            for j, rid in enumerate(plane_ids):
+                set_w = planes[j]
+                hr = self.rows.get(rid)
+                if hr is None or hr.n == 0:
+                    a = int(bitops.np_count(set_w))
+                    if a == 0:
+                        continue
+                    # set_w is a view of the shared plane image: siblings
+                    # pin the block anyway, so keep it dense in place.
+                    self.rows[rid] = HostRow.adopt_words(
+                        set_w, a, prefer_dense=True)
+                    added += a
+                    continue
+                old = hr.to_words()
+                if rid == BSI_EXISTS_BIT:
+                    new = np.bitwise_or(old, set_w)
+                else:
+                    new = np.bitwise_or(np.bitwise_and(old, notmask), set_w)
+                a = int(bitops.np_count(np.bitwise_and(new, np.invert(old))))
+                r = int(bitops.np_count(np.bitwise_and(old, np.invert(new))))
+                if a == 0 and r == 0:
+                    continue
+                self.rows[rid] = HostRow.adopt_words(
+                    new, hr.n + a - r, prefer_dense=True)
+                added += a
+                removed += r
+            if added or removed:
+                self._col_row = None
+                self._invalidate()
+                if self.op_writer:
+                    self._emit_value_wal(local_u, vals_u, bit_depth,
+                                         removed, added)
+
+    def _emit_value_wal(self, local_u: np.ndarray, vals_u: np.ndarray,
+                        bit_depth: int, removed: int, added: int) -> None:
+        """Replay the host path's WAL framing for a device-side value
+        import: one removeBatch of every (plane, column) whose bit is
+        off in the new values, then one addBatch of every on bit — the
+        same full request arrays bulk_import_sorted_local logs, gated
+        the same way (a record only when its pass changed bits)."""
+        neg = vals_u < 0
+        mag = np.abs(vals_u).astype(np.uint64)
+        set_rows, set_cols = [], []
+        clr_rows, clr_cols = [], []
+
+        def _add(bucket_r, bucket_c, row_id, mask):
+            n = int(mask.sum())
+            if n:
+                bucket_r.append(np.full(n, row_id, dtype=np.uint64))
+                bucket_c.append(local_u[mask].astype(np.uint64))
+
+        all_mask = np.ones(len(local_u), dtype=bool)
+        _add(set_rows, set_cols, BSI_EXISTS_BIT, all_mask)
+        _add(set_rows, set_cols, BSI_SIGN_BIT, neg)
+        _add(clr_rows, clr_cols, BSI_SIGN_BIT, ~neg)
+        for i in range(bit_depth):
+            on = ((mag >> np.uint64(i)) & np.uint64(1)) == 1
+            _add(set_rows, set_cols, BSI_OFFSET_BIT + i, on)
+            _add(clr_rows, clr_cols, BSI_OFFSET_BIT + i, ~on)
+        base = np.uint64(self.shard * SHARD_WIDTH)
+        if removed and clr_rows:
+            self.op_writer("removeBatch", np.concatenate(clr_rows),
+                           np.concatenate(clr_cols) + base)
+        if added and set_rows:
+            self.op_writer("addBatch", np.concatenate(set_rows),
+                           np.concatenate(set_cols) + base)
 
     def _filter_seg(self, filter_row: Row | None) -> jax.Array:
         if filter_row is None:

@@ -1,0 +1,173 @@
+"""Device-side BSI plane transpose for bulk value imports.
+
+``Fragment.import_values`` used to assemble bit planes on the host: a
+Python loop over ``bit_depth`` magnitude planes, each building a mask,
+bucketing positions, and merging into HostRow sorted arrays — O(depth)
+numpy passes plus per-plane HostRow merges. The transpose runs as ONE
+jitted program instead: upload the deduplicated ``[M]`` column/value
+batch once and scatter every plane's word block in a single
+``.at[plane, word].add(bit)`` (columns are unique per plane, so each
+bit value is a distinct power of two per word and add == or). The
+program returns the full ``[depth+2, W]`` plane image — exists row,
+sign row, magnitude rows — which the fragment merges with plain word
+ops (`old & ~written | new`), preserving last-write-wins overwrite
+semantics bit-for-bit.
+
+Magnitudes ride as two uint32 halves (lo/hi) so the kernel never needs
+x64 mode; plane membership is a broadcast shift over the static plane
+axis. M buckets to a power of two and the plane axis buckets too, so
+batch-size jitter reuses compiled kernels (planner.py's bucketing
+trick).
+
+Selection: ``PILOSA_TPU_INGEST_TRANSPOSE`` = ``on`` | ``off`` | ``auto``
+(env wins over the server knob's ``set_mode``). ``auto`` uses a
+measured host-vs-device crossover; both paths are bit-identical by
+construction and the equivalence tests force each side.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pilosa_tpu.config import WORDS_PER_SHARD
+
+_MODES = ("on", "off", "auto")
+_default_mode = "auto"
+
+
+def set_mode(mode: str) -> None:
+    """Server-knob default; the PILOSA_TPU_INGEST_TRANSPOSE env var (the
+    test/operator override) takes precedence when set."""
+    global _default_mode
+    if mode not in _MODES:
+        raise ValueError(f"ingest_transpose mode must be one of {_MODES}")
+    _default_mode = mode
+
+
+def mode() -> str:
+    m = os.environ.get("PILOSA_TPU_INGEST_TRANSPOSE", "").strip().lower()
+    return m if m in _MODES else _default_mode
+
+
+def _pow2(n: int) -> int:
+    return 1 << max(0, int(n - 1).bit_length())
+
+
+# -- measured size threshold ------------------------------------------------
+
+_calibrated: int | None = None
+
+
+def _calibrate() -> int:
+    """Crossover, in plane-bit writes (values x planes), above which the
+    one-program device transpose beats the host plane-assembly loop:
+    device dispatch is a fixed overhead, host cost scales with the
+    batch."""
+    m = 4096
+    pos = np.arange(m, dtype=np.uint64)
+    mag = pos.copy()
+    t0 = time.perf_counter()
+    for _ in range(8):
+        on = ((mag >> np.uint64(3)) & np.uint64(1)) == 1
+        _ = pos[on]
+    host_per_write = max((time.perf_counter() - t0) / (8 * m), 1e-12)
+    z32 = jnp.zeros(8, dtype=jnp.uint32)
+    zi = jnp.zeros(8, dtype=jnp.int32)
+    _plane_scatter(zi, z32, z32, z32, z32, bit_depth=1,
+                   n_mag_planes=1).block_until_ready()  # compile off-clock
+    t0 = time.perf_counter()
+    for _ in range(4):
+        _plane_scatter(zi, z32, z32, z32, z32, bit_depth=1,
+                       n_mag_planes=1).block_until_ready()
+    dev_overhead = (time.perf_counter() - t0) / 4
+    return int(min(max(dev_overhead / host_per_write, 1024), 1 << 22))
+
+
+def _min_size() -> int:
+    env = os.environ.get("PILOSA_TPU_INGEST_TRANSPOSE_MIN", "")
+    if env:
+        return int(env)
+    global _calibrated
+    if _calibrated is None:
+        _calibrated = _calibrate()
+    return _calibrated
+
+
+def use_device(size: int) -> bool:
+    """size = deduped values x (bit_depth + 2) plane-bit writes."""
+    m = mode()
+    if m == "off":
+        return False
+    if m == "on":
+        return True
+    return size >= _min_size()
+
+
+# -- kernel -----------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("bit_depth", "n_mag_planes"))
+def _plane_scatter(word_idx, bitval, mag_lo, mag_hi, neg,
+                   bit_depth: int, n_mag_planes: int):
+    """[M] batch -> [2 + n_mag_planes, W] plane words in one program.
+
+    Row 0 is the exists plane (== the written-column mask), row 1 the
+    sign plane, rows 2+i the magnitude planes. Padding entries carry
+    bitval 0 so they scatter nothing; magnitude bits at or above the
+    true bit_depth are masked off (the host loop never visits them)."""
+    m = word_idx.shape[0]
+    shifts = jnp.arange(n_mag_planes, dtype=jnp.uint32)
+    lo_sh = jnp.minimum(shifts, jnp.uint32(31))[:, None]
+    hi_sh = jnp.where(shifts >= 32, shifts - 32, jnp.uint32(0))[:, None]
+    mag_member = jnp.where((shifts < 32)[:, None],
+                           mag_lo[None, :] >> lo_sh,
+                           mag_hi[None, :] >> hi_sh) & jnp.uint32(1)
+    mag_member = jnp.where((shifts < bit_depth)[:, None],
+                           mag_member, jnp.uint32(0))
+    member = jnp.concatenate(
+        [jnp.ones((1, m), dtype=jnp.uint32), neg[None, :], mag_member],
+        axis=0)
+    bits = member * bitval[None, :]
+    p = n_mag_planes + 2
+    plane_rows = jnp.broadcast_to(
+        jnp.arange(p, dtype=jnp.int32)[:, None], (p, m))
+    word_cols = jnp.broadcast_to(word_idx[None, :], (p, m))
+    out = jnp.zeros((p, WORDS_PER_SHARD), dtype=jnp.uint32)
+    return out.at[plane_rows, word_cols].add(bits)
+
+
+def transpose_planes(local_u: np.ndarray, vals_u: np.ndarray,
+                     bit_depth: int) -> np.ndarray:
+    """Transpose a deduplicated (sorted-unique local positions, values)
+    batch into ``[bit_depth + 2, W]`` uint32 plane words on device.
+    Returns a host copy the caller owns."""
+    m = len(local_u)
+    mp = _pow2(max(m, 8))
+    pad = mp - m
+    local64 = local_u.astype(np.uint64)
+    word_idx = np.concatenate(
+        [(local64 >> np.uint64(5)).astype(np.int32),
+         np.zeros(pad, dtype=np.int32)])
+    bitval = np.concatenate(
+        [np.left_shift(np.uint32(1), (local64 & np.uint64(31)).astype(np.uint32)),
+         np.zeros(pad, dtype=np.uint32)])
+    mag = np.abs(vals_u).astype(np.uint64)
+    mag_lo = np.concatenate(
+        [(mag & np.uint64(0xFFFFFFFF)).astype(np.uint32),
+         np.zeros(pad, dtype=np.uint32)])
+    mag_hi = np.concatenate(
+        [(mag >> np.uint64(32)).astype(np.uint32),
+         np.zeros(pad, dtype=np.uint32)])
+    neg = np.concatenate(
+        [(vals_u < 0).astype(np.uint32), np.zeros(pad, dtype=np.uint32)])
+    out = _plane_scatter(jnp.asarray(word_idx), jnp.asarray(bitval),
+                         jnp.asarray(mag_lo), jnp.asarray(mag_hi),
+                         jnp.asarray(neg), bit_depth=bit_depth,
+                         n_mag_planes=_pow2(max(bit_depth, 1)))
+    return np.asarray(out[: bit_depth + 2])

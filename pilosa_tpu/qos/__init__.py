@@ -15,6 +15,8 @@ from .admission import (
     DEFAULT_WEIGHTS,
     QOS_CLASSES,
     AdmissionController,
+    IngestBackpressureError,
+    IngestGate,
     QueryShedError,
     normalize_class,
 )
@@ -45,6 +47,8 @@ __all__ = [
     "DEFAULT_WEIGHTS",
     "Deadline",
     "DeadlineExceededError",
+    "IngestBackpressureError",
+    "IngestGate",
     "QOS_CLASSES",
     "QueryShedError",
     "QuotaExceededError",

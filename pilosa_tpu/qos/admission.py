@@ -51,6 +51,77 @@ class QueryShedError(RuntimeError):
         self.retry_after = float(retry_after)
 
 
+class IngestBackpressureError(RuntimeError):
+    """The bulk-ingest pipeline (WAL append + device upload) is over its
+    in-flight budget — surfaced as HTTP 429 + Retry-After (like a tenant
+    quota trip: the *request stream* must slow down; the node is fine).
+    """
+
+    def __init__(self,
+                 message: str = "ingest backpressure: pipeline saturated",
+                 retry_after: float = 1.0):
+        super().__init__(message)
+        self.retry_after = float(retry_after)
+
+
+class IngestGate:
+    """In-flight byte budget for bulk import work.
+
+    Stream chunks hold their decoded size while they're being applied
+    (decode -> WAL -> device upload); when concurrent holders exceed the
+    budget, new chunks are refused with IngestBackpressureError instead
+    of queueing — the client gets 429 + Retry-After + how far the
+    server got, and resumes. ``max_inflight_bytes=0`` disables the gate.
+    A chunk larger than the whole budget is still admitted when the
+    pipeline is idle, so an oversized batch degrades to serial progress
+    rather than wedging forever.
+    """
+
+    def __init__(self, max_inflight_bytes: int = 0):
+        self.max_inflight_bytes = int(max_inflight_bytes)
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self._holders = 0
+        self.admitted_total = 0
+        self.rejected_total = 0
+
+    def _retry_after(self) -> float:
+        # One pipeline turn per budget of backlog, clamped like the
+        # admission controller's hint.
+        if self.max_inflight_bytes <= 0:
+            return 1.0
+        return min(30.0, max(1.0, self._inflight / self.max_inflight_bytes))
+
+    @contextlib.contextmanager
+    def admit(self, nbytes: int):
+        if self.max_inflight_bytes <= 0:
+            yield
+            return
+        with self._lock:
+            if self._holders and \
+                    self._inflight + nbytes > self.max_inflight_bytes:
+                self.rejected_total += 1
+                raise IngestBackpressureError(
+                    retry_after=self._retry_after())
+            self._inflight += nbytes
+            self._holders += 1
+            self.admitted_total += 1
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._inflight -= nbytes
+                self._holders -= 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"inflightBytes": self._inflight,
+                    "holders": self._holders,
+                    "maxInflightBytes": self.max_inflight_bytes,
+                    "admitted": self.admitted_total,
+                    "rejected": self.rejected_total}
+
+
 def normalize_class(name: str | None, remote: bool = False) -> str:
     """Map a client-supplied class name to a known class. Remote legs of
     a fan-out are always internal-sync regardless of what the header

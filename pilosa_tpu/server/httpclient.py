@@ -84,6 +84,19 @@ _STALE_CONN_ERRORS = (http.client.RemoteDisconnected,
                       BrokenPipeError)
 
 
+class _RewindableChunks:
+    """Iterable-only body: http.client sees no length and sends chunked
+    transfer-encoding. Unlike a generator, iteration restarts from the
+    top, so _http's one stale-connection retry re-sends the whole
+    stream instead of a truncated tail."""
+
+    def __init__(self, chunks: list[bytes]):
+        self._chunks = chunks
+
+    def __iter__(self):
+        return iter(self._chunks)
+
+
 class _ConnPool:
     """Shared keep-alive pool: {(scheme, host, port): idle connections}.
 
@@ -281,6 +294,9 @@ class HTTPInternalClient:
         self._channels: dict[str, _PeerChannel] = {}
         self._channels_lock = threading.Lock()
         self._mux_unsupported: set[str] = set()
+        #: Peers that rejected the PTS1 import stream (older version);
+        #: they get per-batch /internal/import requests instead.
+        self._stream_unsupported: set[str] = set()
         self._leg_local = threading.local()
         # Verification policy (reference tls.skip-verify,
         # server/config.go): with a CA bundle, verify by default; the
@@ -779,10 +795,110 @@ class HTTPInternalClient:
                "columnIDs": cols if cols is not None else [],
                "values": values, "clear": clear}
         if timestamps is not None:
-            # Per-element None sentinels don't fit a raw array; time
-            # imports keep the JSON body.
+            # Epoch-second ints (or None per element); the wire encoder
+            # packs them as a u64 blob with a sentinel for None. An old
+            # peer's binary decoder hands the raw array to its timestamp
+            # parser, which rejects it (400) before any mutation — the
+            # JSON fallback below then carries the ints, which every
+            # version's parse_time accepts.
             req["timestamps"] = timestamps
-        self._post_import(node, req, json_only=timestamps is not None)
+        self._post_import(node, req)
+
+    def send_import_stream(self, node, reqs, chunked: bool = False) -> int:
+        """POST many shard-batch import requests as ONE pipelined PTS1
+        stream (/internal/import-stream): the peer decodes, WAL-appends,
+        and device-uploads chunk k while chunk k+1 is still on the wire,
+        so the per-request round-trip stops gating bulk ingest.
+
+        Backpressure contract: a 429 reply carries ``{"applied": k}``
+        (the server applied a strict prefix and drained the rest) plus
+        Retry-After — sleep, then resume from chunk k. Peers that
+        400/404/405 the stream (older version: no route, or the parser
+        rejects the magic) are remembered and replayed per-batch through
+        _post_import — nothing was applied, and imports are idempotent,
+        so the replay is safe (same contract as the mux envelope).
+
+        ``chunked=True`` sends chunked transfer-encoding instead of one
+        Content-Length body; the server pipelines either way (it reads
+        length-prefixed frames incrementally off the socket).
+
+        Returns the number of requests applied (== len(reqs)).
+        """
+        from pilosa_tpu.server import wire
+        reqs = list(reqs)
+        if not reqs:
+            return 0
+        if node.id in self._stream_unsupported:
+            for r in reqs:
+                self._post_import(node, r)
+            return len(reqs)
+        start = 0
+        stalls = 0
+        while start < len(reqs):
+            chunks = ([wire.stream_preamble()]
+                      + [wire.stream_chunk(r) for r in reqs[start:]]
+                      + [wire.stream_end()])
+            body = _RewindableChunks(chunks) if chunked else b"".join(chunks)
+            if self.breakers is not None:
+                self.breakers.check(node.id)
+            try:
+                status, msg, data = self._http(
+                    self._url(node, "/internal/import-stream"), "POST",
+                    body, {"Content-Type": wire.STREAM_CONTENT_TYPE})
+            except OSError as e:
+                if self.breakers is not None:
+                    self.breakers.record_failure(node.id)
+                raise ConnectionError(
+                    f"node {node.id} unreachable: {e}") from e
+            if self.breakers is not None:
+                self.breakers.record_success(node.id)
+            self._count_wire(sum(len(c) for c in chunks), len(data))
+            if status < 400:
+                return len(reqs)
+            if status in (400, 404, 405):
+                # "applied" in the body means the ROUTE answered: a new
+                # server reporting a chunk that failed to apply — not an
+                # old peer missing the route. Surface it; only a bare
+                # rejection triggers the per-batch fallback.
+                try:
+                    payload = json.loads(data)
+                except (ValueError, TypeError):
+                    payload = {}
+                if isinstance(payload, dict) and "applied" in payload:
+                    raise NodeHTTPError(
+                        status, f"node {node.id} HTTP {status}: "
+                                f"{data.decode(errors='replace')}")
+                self._stream_unsupported.add(node.id)
+                for r in reqs[start:]:
+                    self._post_import(node, r)
+                return len(reqs)
+            if status == 429:
+                applied = 0
+                try:
+                    applied = int(json.loads(data).get("applied", 0))
+                except (ValueError, TypeError, AttributeError):
+                    pass
+                start += applied
+                # A saturated-but-draining gate makes progress between
+                # rounds; zero progress several rounds running means the
+                # pipeline is wedged on something else — surface it.
+                stalls = 0 if applied else stalls + 1
+                if stalls > RETRY_503_ATTEMPTS:
+                    raise NodeHTTPError(
+                        status,
+                        f"node {node.id} ingest backpressure made no "
+                        f"progress after {stalls} retries",
+                        retry_after=None)
+                try:
+                    delay = float(msg.get("Retry-After"))
+                except (TypeError, ValueError):
+                    delay = 1.0
+                time.sleep(min(max(delay, 0.0), RETRY_MAX_DELAY))
+                continue
+            raise NodeHTTPError(
+                status, f"node {node.id} HTTP {status}: "
+                        f"{data.decode(errors='replace')}")
+        return len(reqs)
 
     def send_message(self, node: Node, message: dict):
         self._request(node, "POST", "/internal/cluster/message",

@@ -33,8 +33,10 @@ from pilosa_tpu.cache.tenant import (
     set_current_tenant,
 )
 from pilosa_tpu.qos import (
+    CLASS_BATCH,
     CLASS_INTERNAL,
     DeadlineExceededError,
+    IngestBackpressureError,
     QueryShedError,
     QuotaExceededError,
     normalize_class,
@@ -137,6 +139,11 @@ def _make_handler(api: API):
             params["_accept"] = self.headers.get("Accept", "")
             params["_qos_class"] = self.headers.get("X-Qos-Class", "")
             params["_api_key"] = self.headers.get("X-API-Key", "")
+            if method == "POST" and parsed.path == "/internal/import-stream":
+                # Streaming route: decode/apply PER CHUNK while the
+                # client is still sending — must run before the
+                # whole-body read below.
+                return self._handle_import_stream()
             length = int(self.headers.get("Content-Length") or 0)
             body = self.rfile.read(length) if length else b""
             for pattern, methods in routes:
@@ -172,6 +179,12 @@ def _make_handler(api: API):
                     status, payload = 429, {"error": str(e)}
                     headers = {"Retry-After":
                                str(max(1, int(e.retry_after + 0.5)))}
+                except IngestBackpressureError as e:
+                    # Same shape as the quota trip: the import stream
+                    # must slow down, the node is otherwise healthy.
+                    status, payload = 429, {"error": str(e)}
+                    headers = {"Retry-After":
+                               str(max(1, int(e.retry_after + 0.5)))}
                 except DeadlineExceededError as e:
                     status, payload = 504, {"error": str(e)}
                 except _CONFLICTS as e:
@@ -200,6 +213,79 @@ def _make_handler(api: API):
                         _tr.reset_current_trace(token)
                 return self._reply(status, payload, headers)
             self._reply(404, {"error": "not found"})
+
+        def _handle_import_stream(self):
+            """POST /internal/import-stream: length-prefixed PTI1 frames
+            (wire.STREAM_CONTENT_TYPE), applied as they arrive — decode,
+            WAL append (group-committed), device upload per chunk. Bulk
+            work rides the BATCH admission class so interactive queries
+            keep their weighted share of the node. On backpressure (the
+            ingest gate's byte budget, an admission shed, or a tenant
+            quota) the server STOPS APPLYING but keeps draining the
+            stream, then answers 429 + Retry-After + how many chunks
+            were applied — replying mid-send would just break the pipe
+            and mask the signal; the client resumes from ``applied``."""
+            from pilosa_tpu.server import wire
+
+            te = (self.headers.get("Transfer-Encoding") or "").lower()
+            if "chunked" in te:
+                read = _chunked_body_reader(self.rfile)
+            else:
+                read = _bounded_body_reader(
+                    self.rfile, int(self.headers.get("Content-Length") or 0))
+            server = getattr(api, "import_handler", None)
+            if server is None:
+                self.close_connection = True
+                return self._reply(400, {"error": "no import handler"})
+            qos_ctl = getattr(api, "qos", None)
+            gate = getattr(api, "ingest_gate", None)
+            applied = 0
+            pressure = None
+            fatal = None
+            try:
+                for frame in wire.iter_stream_frames(read):
+                    if pressure is not None or fatal is not None:
+                        continue  # draining: count nothing, apply nothing
+                    try:
+                        if gate is not None:
+                            with gate.admit(len(frame)):
+                                self._apply_import_chunk(
+                                    wire.decode_import(frame), server,
+                                    qos_ctl)
+                        else:
+                            self._apply_import_chunk(
+                                wire.decode_import(frame), server, qos_ctl)
+                        applied += 1
+                    except (IngestBackpressureError, QueryShedError,
+                            QuotaExceededError) as e:
+                        pressure = e
+                    except Exception as e:  # bad chunk: drain, then report
+                        fatal = e
+            except ValueError as e:
+                # Malformed stream framing: the tail is unreadable, so
+                # the connection can't be reused.
+                self.close_connection = True
+                return self._reply(400, {"error": str(e),
+                                         "applied": applied})
+            if fatal is not None:
+                status = 404 if isinstance(fatal, _NOT_FOUND + (LookupError,)) \
+                    else 400 if isinstance(fatal, (ValueError, KeyError,
+                                                   PilosaError)) else 500
+                return self._reply(status, {"error": str(fatal),
+                                            "applied": applied})
+            if pressure is not None:
+                return self._reply(
+                    429, {"error": str(pressure), "applied": applied},
+                    {"Retry-After":
+                     str(max(1, int(pressure.retry_after + 0.5)))})
+            return self._reply(200, {"applied": applied})
+
+        def _apply_import_chunk(self, req, server, qos_ctl):
+            if qos_ctl is not None:
+                with qos_ctl.admit(CLASS_BATCH):
+                    server(req)
+            else:
+                server(req)
 
         def _reply(self, status: int, payload, headers=None):
             if isinstance(payload, (dict, list)):
@@ -232,6 +318,58 @@ def _make_handler(api: API):
             self._dispatch("DELETE")
 
     return Handler
+
+
+def _bounded_body_reader(rfile, length: int):
+    """read(n) over a Content-Length body that never reads past it (the
+    socket would block waiting for bytes that aren't coming)."""
+    remaining = [length]
+
+    def read(n: int) -> bytes:
+        if remaining[0] <= 0:
+            return b""
+        b = rfile.read(min(n, remaining[0]))
+        remaining[0] -= len(b)
+        return b
+
+    return read
+
+
+def _chunked_body_reader(rfile):
+    """read(n) over a chunked transfer-encoded body (hex-length lines,
+    RFC 9112 §7.1) — what http.client sends for an iterator body, which
+    is how the import client pipelines an unbounded stream."""
+    state = {"left": 0, "eof": False}
+
+    def read(n: int) -> bytes:
+        if state["eof"]:
+            return b""
+        if state["left"] == 0:
+            line = rfile.readline(130)
+            if not line:
+                state["eof"] = True
+                return b""
+            try:
+                size = int(line.split(b";")[0].strip() or b"0", 16)
+            except ValueError:
+                state["eof"] = True
+                return b""
+            if size == 0:
+                # consume optional trailers up to the blank line
+                while True:
+                    t = rfile.readline(1024)
+                    if not t or t in (b"\r\n", b"\n"):
+                        break
+                state["eof"] = True
+                return b""
+            state["left"] = size
+        b = rfile.read(min(n, state["left"]))
+        state["left"] -= len(b)
+        if state["left"] == 0:
+            rfile.read(2)  # chunk-terminating CRLF
+        return b
+
+    return read
 
 
 def _build_routes(api: API):

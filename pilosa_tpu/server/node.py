@@ -84,7 +84,10 @@ class ServerNode:
                  result_cache_mb: int = 64,
                  result_cache_ttl: float = 0.0,
                  device_reduce: str = "auto",
-                 multiplex: bool = True):
+                 multiplex: bool = True,
+                 ingest_transpose: str = "auto",
+                 wal_group_commit_ms: float = 0.0,
+                 ingest_max_inflight_mb: int = 0):
         host, _, port = bind.partition(":")
         self.host, self.port = host or "127.0.0.1", int(port or 10101)
         # Node identity IS the address — member ids are built the same
@@ -291,6 +294,16 @@ class ServerNode:
         # the PILOSA_TPU_DEVICE_REDUCE env var still overrides per-run.
         from pilosa_tpu.exec import device_reduce as _device_reduce
         _device_reduce.set_mode(device_reduce)
+        # Device-side BSI bit-plane transpose for bulk value imports
+        # (exec/ingest_transpose); PILOSA_TPU_INGEST_TRANSPOSE overrides.
+        from pilosa_tpu.exec import ingest_transpose as _ingest_transpose
+        _ingest_transpose.set_mode(ingest_transpose)
+        # In-flight byte budget for the /internal/import-stream pipeline
+        # (0 = unbounded); trips 429 + Retry-After, never queues.
+        from pilosa_tpu.qos import IngestGate
+        self.ingest_gate = IngestGate(
+            max_inflight_bytes=int(ingest_max_inflight_mb) << 20)
+        self.api.ingest_gate = self.ingest_gate
         if self.cluster is not None:
             self.cluster.stats = self.stats
             self.cluster.client.stats = self.stats
@@ -310,6 +323,8 @@ class ServerNode:
             kw = {} if max_op_n is None else {"max_op_n": max_op_n}
             self.store = DiskStore(data_dir, self.holder, stats=self.stats,
                                    quarantine_keep_n=quarantine_keep_n,
+                                   wal_group_window=wal_group_commit_ms
+                                   / 1000.0,
                                    **kw)
             self.store.open()
         else:

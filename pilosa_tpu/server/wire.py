@@ -231,19 +231,29 @@ def encode_frames(results: list, extra: dict | None = None,
 #: rowConst scalar instead of shipping N identical values.
 _IMPORT_MAGIC = b"PTI1"
 _IMPORT_ARRAYS = (("rowIDs", np.uint64), ("columnIDs", np.uint64),
-                  ("values", np.int64))
+                  ("values", np.int64), ("timestamps", np.uint64))
+#: per-element "no timestamp" sentinel in the timestamps array — epoch
+#: seconds can never reach it, and it pins the array at u64 so the u32
+#: narrowing below never fires on a mixed batch.
+_TS_NONE = (1 << 64) - 1
 
 
 def encode_import(req: dict) -> bytes:
     blobs: list[bytes] = []
     arrays: dict = {}
     fields = {k: v for k, v in req.items()
-              if k not in ("rowIDs", "columnIDs", "values")}
+              if k not in ("rowIDs", "columnIDs", "values", "timestamps")}
     for name, dtype in _IMPORT_ARRAYS:
         v = req.get(name)
         if v is None:
             continue
-        a = np.ascontiguousarray(v, dtype=dtype)
+        if name == "timestamps":
+            # Unix epoch seconds, None riding as the u64 sentinel.
+            a = np.ascontiguousarray(
+                [_TS_NONE if t is None else int(t) for t in v],
+                dtype=np.uint64)
+        else:
+            a = np.ascontiguousarray(v, dtype=dtype)
         if name == "rowIDs" and len(a) and (a == a[0]).all():
             fields["rowConst"] = int(a[0])
             fields["rowN"] = len(a)
@@ -292,10 +302,81 @@ def decode_import(data: bytes) -> dict:
         if "rowConst" in req:
             req["rowIDs"] = np.full(req.pop("rowN"), req.pop("rowConst"),
                                     dtype=np.uint64)
+        if "timestamps" in req:
+            # Back to the handler's list[int|None] shape (tq.parse_time
+            # accepts plain ints, not numpy scalars).
+            req["timestamps"] = [
+                None if t == _TS_NONE else t
+                for t in req["timestamps"].astype(np.uint64).tolist()]
         return req
     except (struct.error, KeyError, IndexError, TypeError,
             UnicodeDecodeError, json.JSONDecodeError) as e:
         raise ValueError(f"malformed import frame: {e!r}") from e
+
+
+# -- streaming import (chunked PTI1 pipeline) -------------------------------
+#
+# Bulk loads used to pay one HTTP round trip (and one whole-body decode)
+# per shard batch. The import stream multiplexes MANY shard batches over
+# one connection as length-prefixed PTI1 frames the server can decode,
+# WAL-append, and upload PER CHUNK while the client is still sending the
+# rest — a pipeline, not a request loop (reference analog: ctl/'s
+# shard-batched import client feeding /import continuously).
+#
+#   "PTS1" | u32 len0 | <PTI1 frame 0> | u32 len1 | <PTI1 frame 1> | ...
+#   ... | u32 0                                        (terminator)
+#
+# The envelope is VERSIONED by its magic exactly like the mux channel:
+# an old peer 404s the route, and the client falls back to per-chunk
+# /internal/import posts, so mixed-version rings keep working.
+
+STREAM_CONTENT_TYPE = "application/x-pilosa-import-stream"
+_STREAM_MAGIC = b"PTS1"
+#: one chunk's frame may not exceed this (a corrupt/hostile length
+#: prefix must not make the server buffer gigabytes).
+STREAM_MAX_CHUNK = 256 << 20
+
+
+def stream_preamble() -> bytes:
+    return _STREAM_MAGIC
+
+
+def stream_chunk(req: dict) -> bytes:
+    frame = encode_import(req)
+    return struct.pack("<I", len(frame)) + frame
+
+
+def stream_end() -> bytes:
+    return struct.pack("<I", 0)
+
+
+def _read_exact(read, n: int) -> bytes:
+    parts = []
+    need = n
+    while need:
+        b = read(need)
+        if not b:
+            raise ValueError("truncated import stream")
+        parts.append(b)
+        need -= len(b)
+    return b"".join(parts)
+
+
+def iter_stream_frames(read):
+    """Yield raw PTI1 frame bytes from a file-like ``read(n)`` callable,
+    validating the preamble and stopping at the zero-length terminator.
+    Yields BYTES, not decoded requests, so a backpressuring server can
+    keep draining (cheaply) after it stops applying. Raises ValueError
+    on malformation — the 400 signal an old client needs."""
+    if _read_exact(read, 4) != _STREAM_MAGIC:
+        raise ValueError("bad import stream magic")
+    while True:
+        (ln,) = struct.unpack("<I", _read_exact(read, 4))
+        if ln == 0:
+            return
+        if ln > STREAM_MAX_CHUNK:
+            raise ValueError("import stream chunk too large")
+        yield _read_exact(read, ln)
 
 
 def _decode_header(data: bytes, magic: bytes = _FRAME_MAGIC) -> dict:

@@ -87,10 +87,13 @@ class DiskStore:
     def __init__(self, data_dir: str, holder: Holder,
                  max_op_n: int = MAX_OP_N, snapshot_workers: int = 2,
                  fsync_appends: bool = False, stats=None, logger=None,
-                 quarantine_keep_n: int = 0):
+                 quarantine_keep_n: int = 0, wal_group_window: float = 0.0):
         self.data_dir = data_dir
         self.holder = holder
         self.max_op_n = max_op_n
+        #: group-commit flush window (seconds) handed to every WalWriter;
+        #: only meaningful with fsync_appends (see wal.WalWriter).
+        self.wal_group_window = wal_group_window
         #: cap on accumulated ``*.quarantine`` evidence files per
         #: fragment, pruned oldest-first after a successful scrub repair;
         #: 0 keeps everything (the historical behaviour).
@@ -314,8 +317,15 @@ class DiskStore:
             w = self._writers.get(key)
             if w is None:
                 w = self._writers[key] = WalWriter(
-                    self._wal_path(key), fsync_appends=self.fsync_appends)
+                    self._wal_path(key), fsync_appends=self.fsync_appends,
+                    group_window=self.wal_group_window)
             return w
+
+    def wal_fsyncs(self) -> int:
+        """Total fsync() calls across every live WAL writer (the
+        group-commit amortization gauge)."""
+        with self._lock:
+            return sum(w.fsyncs for w in self._writers.values())
 
     def delete_fragment_files(self, key: tuple) -> None:
         """Remove a fragment's snapshot + WAL (holderCleaner's disk

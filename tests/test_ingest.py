@@ -1,0 +1,671 @@
+"""Streaming ingestion: the columnar import-stream wire (PTS1), the
+device-side BSI bit-plane transpose, WAL group commit, and ingest/query
+isolation.
+
+Equivalence discipline (same contract as test_wire_fanout): every
+optimized path — device transpose vs the host plane loop, the
+vectorized value() gather vs the per-bit probe, binary timestamps vs
+JSON — must be BIT-IDENTICAL to the path it replaces; the tests here
+force each side and compare state, WAL bytes, and query results.
+"""
+
+import io
+import json
+import struct
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.config import SHARD_WIDTH
+from pilosa_tpu.cluster.harness import LocalCluster
+from pilosa_tpu.cluster.node import URI, Node
+from pilosa_tpu.core.fragment import Fragment
+from pilosa_tpu.exec import ingest_transpose
+from pilosa_tpu.qos import IngestBackpressureError, IngestGate
+from pilosa_tpu.server import wire
+from pilosa_tpu.server.api import API
+from pilosa_tpu.server.httpclient import HTTPInternalClient, NodeHTTPError
+from pilosa_tpu.server.httpd import _bounded_body_reader, _chunked_body_reader
+from pilosa_tpu.server.node import ServerNode
+from pilosa_tpu.storage.wal import WalReader, WalWriter
+
+
+@pytest.fixture(autouse=True)
+def _no_transpose_env(monkeypatch):
+    """Each test picks its own mode explicitly; the env override and any
+    leftover module mode must not leak between tests."""
+    monkeypatch.delenv("PILOSA_TPU_INGEST_TRANSPOSE", raising=False)
+    ingest_transpose.set_mode("auto")
+    yield
+    ingest_transpose.set_mode("auto")
+
+
+def req(base, method, path, body=None, headers=None):
+    data = body.encode() if isinstance(body, str) else body
+    r = urllib.request.Request(base + path, data=data, method=method,
+                               headers=headers or {})
+    try:
+        with urllib.request.urlopen(r, timeout=10) as resp:
+            return resp.status, json.loads(resp.read() or b"{}"), resp.headers
+    except urllib.error.HTTPError as e:
+        payload = e.read()
+        try:
+            return e.code, json.loads(payload), e.headers
+        except json.JSONDecodeError:
+            return e.code, {"raw": payload.decode()}, e.headers
+
+
+# -- stream wire format ------------------------------------------------------
+
+
+def _stream_bytes(reqs):
+    return b"".join([wire.stream_preamble()]
+                    + [wire.stream_chunk(r) for r in reqs]
+                    + [wire.stream_end()])
+
+
+def test_stream_wire_roundtrip():
+    reqs = [
+        {"kind": "field", "index": "i", "field": "v", "shard": 0,
+         "columnIDs": [1, 5, 9], "values": [-3, 0, 7], "clear": False},
+        {"kind": "field", "index": "i", "field": "f", "shard": 1,
+         "rowIDs": [2, 2, 4],
+         "columnIDs": [SHARD_WIDTH + 1, SHARD_WIDTH + 2, SHARD_WIDTH + 3],
+         "clear": False},
+    ]
+    buf = io.BytesIO(_stream_bytes(reqs))
+    out = [wire.decode_import(f) for f in wire.iter_stream_frames(buf.read)]
+    assert len(out) == 2
+    assert out[0]["values"].tolist() == [-3, 0, 7]
+    assert out[0]["columnIDs"].tolist() == [1, 5, 9]
+    assert out[1]["rowIDs"].tolist() == [2, 2, 4]
+    assert out[1]["index"] == "i" and out[1]["shard"] == 1
+
+
+def test_stream_timestamps_sentinel_and_narrowing():
+    """All-present epoch timestamps may narrow to u32 on the wire; a
+    batch with Nones rides the u64 sentinel. Both decode back to the
+    exact int/None list."""
+    all_present = {"kind": "field", "index": "i", "field": "f", "shard": 0,
+                   "rowIDs": [1, 1], "columnIDs": [3, 4],
+                   "timestamps": [1700000000, 1700000001], "clear": False}
+    mixed = {"kind": "field", "index": "i", "field": "f", "shard": 0,
+             "rowIDs": [1, 1, 1], "columnIDs": [3, 4, 5],
+             "timestamps": [1700000000, None, 1700000002], "clear": False}
+    d1 = wire.decode_import(wire.encode_import(all_present))
+    assert d1["timestamps"] == [1700000000, 1700000001]
+    d2 = wire.decode_import(wire.encode_import(mixed))
+    assert d2["timestamps"] == [1700000000, None, 1700000002]
+
+
+def test_stream_truncated_and_oversized_raise():
+    reqs = [{"kind": "field", "index": "i", "field": "v", "shard": 0,
+             "columnIDs": [1], "values": [2], "clear": False}]
+    good = _stream_bytes(reqs)
+    torn = io.BytesIO(good[:-6])  # cut into the terminator + last frame
+    with pytest.raises(ValueError):
+        list(wire.iter_stream_frames(torn.read))
+    huge = io.BytesIO(wire.stream_preamble()
+                      + struct.pack("<I", wire.STREAM_MAX_CHUNK + 1))
+    with pytest.raises(ValueError):
+        list(wire.iter_stream_frames(huge.read))
+    bad_magic = io.BytesIO(b"NOPE" + good[4:])
+    with pytest.raises(ValueError):
+        list(wire.iter_stream_frames(bad_magic.read))
+
+
+# -- device transpose vs host plane loop -------------------------------------
+
+
+def _frag_with_wal(mode, seed, prefill, batches, depth):
+    """Build a fragment in the given transpose mode, applying prefill
+    then each batch; returns (canonical row state, WAL records,
+    sampled value() reads)."""
+    ingest_transpose.set_mode(mode)
+    f = Fragment("i", "v", "bsig_v", 0)
+    records = []
+    f.import_values(*prefill, depth)
+    f.op_writer = lambda op, rows, cols: records.append(
+        (op, np.asarray(rows, dtype=np.uint64).tobytes(),
+         np.asarray(cols, dtype=np.uint64).tobytes()))
+    for cols, vals, clear in batches:
+        f.import_values(cols, vals, depth, clear=clear)
+    state = {rid: hr.to_words().tobytes()
+             for rid, hr in f.rows.items() if hr is not None and hr.n}
+    f.VALUE_STACK_MIN = 0  # force the vectorized gather
+    rng = np.random.default_rng(seed)
+    probe_cols = rng.integers(0, 4096, 64).tolist()
+    reads = [f.value(c, depth) for c in probe_cols]
+    return state, records, reads
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_import_values_device_matches_host_generative(seed):
+    """Force host and device transpose over identical generative
+    workloads (duplicates, negatives, overwrites, clears) and require
+    identical row state, identical WAL bytes, identical value() reads."""
+    rng = np.random.default_rng(seed)
+    depth = int(rng.integers(1, 40))
+    lo, hi = -(1 << (depth - 1)) if depth > 1 else -1, (1 << (depth - 1))
+    prefill = (rng.integers(0, 4096, 300), rng.integers(lo, hi, 300))
+    batches = []
+    for _ in range(4):
+        n = int(rng.integers(1, 500))
+        cols = rng.integers(0, 4096, n)
+        vals = rng.integers(lo, hi, n)
+        batches.append((cols, vals, bool(rng.integers(0, 5) == 0)))
+    host = _frag_with_wal("off", seed, prefill, batches, depth)
+    dev = _frag_with_wal("on", seed, prefill, batches, depth)
+    assert host[0] == dev[0], "row state diverged"
+    assert host[1] == dev[1], "WAL records diverged"
+    assert host[2] == dev[2], "value() reads diverged"
+
+
+def test_import_values_lww_duplicates_device():
+    """Duplicate columns in one batch: last write wins, both modes."""
+    for mode in ("off", "on"):
+        ingest_transpose.set_mode(mode)
+        f = Fragment("i", "v", "bsig_v", 0)
+        f.import_values([7, 7, 7], [5, -9, 42], 8)
+        assert f.value(7, 8) == (42, True), mode
+        f.import_values([7, 3, 7], [1, 2, -6], 8)
+        assert f.value(7, 8) == (-6, True), mode
+        assert f.value(3, 8) == (2, True), mode
+
+
+def test_import_values_clear_then_reimport_device():
+    for mode in ("off", "on"):
+        ingest_transpose.set_mode(mode)
+        f = Fragment("i", "v", "bsig_v", 0)
+        f.import_values([1, 2, 3], [10, -20, 30], 8)
+        f.import_values([2], [], 8, clear=True)
+        assert f.value(2, 8) == (0, False), mode
+        assert f.value(1, 8) == (10, True), mode
+        f.import_values([2], [-1], 8)
+        assert f.value(2, 8) == (-1, True), mode
+
+
+def test_import_values_shard_boundary_positions_device():
+    """Columns at the very edges of a non-zero shard: the local-position
+    mask and the device word indexing must agree at word 0 and the last
+    word of the shard."""
+    base = 3 * SHARD_WIDTH
+    edges = [base, base + 1, base + 31, base + 32,
+             base + SHARD_WIDTH - 33, base + SHARD_WIDTH - 1]
+    vals = [1, -2, 3, -4, 5, -6]
+    results = {}
+    for mode in ("off", "on"):
+        ingest_transpose.set_mode(mode)
+        f = Fragment("i", "v", "bsig_v", 3)
+        f.import_values(edges, vals, 8)
+        results[mode] = [f.value(c, 8) for c in edges]
+        assert results[mode] == [(v, True) for v in vals], mode
+    assert results["off"] == results["on"]
+
+
+def test_value_vectorized_matches_probe_loop():
+    f = Fragment("i", "v", "bsig_v", 0)
+    rng = np.random.default_rng(3)
+    cols = rng.integers(0, 8192, 1000)
+    vals = rng.integers(-500, 500, 1000)
+    f.import_values(cols, vals, 16)
+    probe_cols = list(range(0, 8192, 7))
+    f.VALUE_STACK_MIN = 1 << 30  # force the per-bit probe loop
+    probe = [f.value(c, 16) for c in probe_cols]
+    f.VALUE_STACK_MIN = 0  # force the gather
+    f._value_stack = None
+    gather = [f.value(c, 16) for c in probe_cols]
+    assert probe == gather
+
+
+def test_ingest_transpose_mode_knob(monkeypatch):
+    ingest_transpose.set_mode("on")
+    assert ingest_transpose.use_device(1)
+    ingest_transpose.set_mode("off")
+    assert not ingest_transpose.use_device(1 << 30)
+    monkeypatch.setenv("PILOSA_TPU_INGEST_TRANSPOSE", "on")
+    assert ingest_transpose.use_device(1)  # env wins over set_mode
+    with pytest.raises(ValueError):
+        ingest_transpose.set_mode("sideways")
+
+
+# -- WAL group commit --------------------------------------------------------
+
+
+def test_wal_group_commit_coalesces_fsyncs(tmp_path):
+    p = str(tmp_path / "f.wal")
+    w = WalWriter(p, fsync_appends=True, group_window=0.02)
+    n_threads, per_thread = 8, 5
+    start = threading.Barrier(n_threads)
+
+    def run(t):
+        start.wait()
+        for k in range(per_thread):
+            w.append("add", [t], [t * 100 + k])
+
+    threads = [threading.Thread(target=run, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = n_threads * per_thread
+    assert w.fsyncs < total, (w.fsyncs, total)
+    assert w.fsyncs >= 1
+    w.close()
+    ops = list(WalReader(p))
+    assert len(ops) == total
+    seen = sorted((int(r[0]), int(c[0])) for _, r, c in ops)
+    assert seen == sorted((t, t * 100 + k) for t in range(n_threads)
+                          for k in range(per_thread))
+
+
+def test_wal_group_commit_single_appender_durable(tmp_path):
+    """A lone appender must not wait for company: its append returns
+    after one windowed fsync and the record is on disk."""
+    p = str(tmp_path / "f.wal")
+    w = WalWriter(p, fsync_appends=True, group_window=0.01)
+    t0 = time.perf_counter()
+    w.append("add", [1], [2])
+    assert time.perf_counter() - t0 < 5.0
+    assert w.fsyncs == 1
+    ops = list(WalReader(p))  # readable without close: fsync happened
+    assert len(ops) == 1
+    w.close()
+
+
+def test_wal_group_commit_zero_window_is_per_append(tmp_path):
+    p = str(tmp_path / "f.wal")
+    w = WalWriter(p, fsync_appends=True)
+    w.append("add", [1], [2])
+    w.append("add", [3], [4])
+    assert w.fsyncs == 2
+    w.close()
+
+
+# -- ingest gate (backpressure) ----------------------------------------------
+
+
+def test_ingest_gate_budget_and_oversize():
+    g = IngestGate(max_inflight_bytes=100)
+    with g.admit(60):
+        with pytest.raises(IngestBackpressureError) as ei:
+            with g.admit(60):
+                pass
+        assert ei.value.retry_after >= 1.0
+    # idle gate admits even an oversized chunk (degrades to serial)
+    with g.admit(10_000):
+        pass
+    snap = g.snapshot()
+    assert snap["rejected"] == 1 and snap["admitted"] == 2
+    # disabled gate admits everything
+    g0 = IngestGate(0)
+    with g0.admit(1 << 40):
+        pass
+
+
+# -- HTTP body readers -------------------------------------------------------
+
+
+def test_chunked_body_reader():
+    raw = b"4\r\nWiki\r\n6\r\npedia \r\nB\r\nin chunks.\n\r\n0\r\n\r\n"
+    read = _chunked_body_reader(io.BytesIO(raw))
+    out = b""
+    while True:
+        b = read(5)
+        if not b:
+            break
+        out += b
+    assert out == b"Wiki" + b"pedia " + b"in chunks.\n"
+    assert read(5) == b""  # stays at EOF
+
+
+def test_bounded_body_reader():
+    read = _bounded_body_reader(io.BytesIO(b"abcdefXXX"), 6)
+    assert read(4) == b"abcd" and read(4) == b"ef" and read(4) == b""
+
+
+# -- HTTP endpoint + client --------------------------------------------------
+
+
+@pytest.fixture
+def node():
+    n = ServerNode(bind="127.0.0.1:0", use_planner=False)
+    n.open()
+    yield n
+    n.close()
+
+
+def _client_node(n):
+    return Node(id=f"127.0.0.1:{n.port}",
+                uri=URI(host="127.0.0.1", port=n.port))
+
+
+def _value_req(shard, cols, vals, index="si"):
+    return {"kind": "field", "index": index, "field": "v", "shard": shard,
+            "rowIDs": None, "columnIDs": cols, "values": vals,
+            "clear": False}
+
+
+@pytest.mark.parametrize("chunked", [False, True])
+def test_import_stream_http_end_to_end(node, chunked):
+    b = node.address
+    req(b, "POST", "/index/si", "{}")
+    req(b, "POST", "/index/si/field/v",
+        json.dumps({"options": {"type": "int", "min": -10_000,
+                                "max": 10_000}}))
+    client = HTTPInternalClient(timeout=10)
+    try:
+        reqs = [_value_req(s, [s * SHARD_WIDTH + c for c in range(10)],
+                           [(s + 1) * 10 + c for c in range(10)])
+                for s in range(4)]
+        applied = client.send_import_stream(_client_node(node), reqs,
+                                            chunked=chunked)
+        assert applied == 4
+        status, resp, _ = req(b, "POST", "/index/si/query",
+                              "Sum(field=v)")
+        want = sum((s + 1) * 10 + c for s in range(4) for c in range(10))
+        assert resp["results"] == [{"value": want, "count": 40}]
+    finally:
+        client.close()
+
+
+def test_import_stream_binary_timestamps_http(node):
+    """send_import with per-element None timestamps rides the binary
+    wire end-to-end (the old json_only escape hatch is gone)."""
+    b = node.address
+    req(b, "POST", "/index/ti", "{}")
+    req(b, "POST", "/index/ti/field/t",
+        json.dumps({"options": {"timeQuantum": "YMD"}}))
+    client = HTTPInternalClient(timeout=10)
+    try:
+        client.send_import(_client_node(node), "ti", "t", 0,
+                           rows=[1, 1, 1], cols=[3, 4, 5],
+                           timestamps=[1700000000, None, 1700000000])
+        status, resp, _ = req(b, "POST", "/index/ti/query", "Row(t=1)")
+        assert resp["results"][0]["columns"] == [3, 4, 5]
+        status, resp, _ = req(
+            b, "POST", "/index/ti/query",
+            "Row(t=1, from='2023-11-14T00:00', to='2023-11-16T00:00')")
+        assert resp["results"][0]["columns"] == [3, 5]
+    finally:
+        client.close()
+
+
+def test_import_stream_backpressure_http_429_applied(node):
+    b = node.address
+    req(b, "POST", "/index/bp", "{}")
+    req(b, "POST", "/index/bp/field/v",
+        json.dumps({"options": {"type": "int", "min": -100, "max": 100}}))
+    chunk = {"kind": "field", "index": "bp", "field": "v", "shard": 0,
+             "columnIDs": [1, 2], "values": [3, 4], "clear": False}
+    node.ingest_gate.max_inflight_bytes = 64
+    hold = node.ingest_gate.admit(32)
+    hold.__enter__()
+    try:
+        status, resp, headers = req(
+            b, "POST", "/internal/import-stream",
+            _stream_bytes([chunk, chunk]),
+            headers={"Content-Type": wire.STREAM_CONTENT_TYPE})
+        assert status == 429, resp
+        assert resp["applied"] == 0
+        assert int(headers["Retry-After"]) >= 1
+    finally:
+        hold.__exit__(None, None, None)
+    # gate released: the same stream now lands whole
+    status, resp, _ = req(
+        b, "POST", "/internal/import-stream", _stream_bytes([chunk]),
+        headers={"Content-Type": wire.STREAM_CONTENT_TYPE})
+    assert (status, resp) == (200, {"applied": 1})
+    status, resp, _ = req(b, "POST", "/index/bp/query", "Sum(field=v)")
+    assert resp["results"] == [{"value": 7, "count": 2}]
+
+
+def test_import_stream_bad_chunk_reports_applied(node):
+    """A chunk for a missing field: the server drains the rest, reports
+    the error AND how far it got, and the connection stays usable."""
+    b = node.address
+    req(b, "POST", "/index/gx", "{}")
+    req(b, "POST", "/index/gx/field/v",
+        json.dumps({"options": {"type": "int", "min": -100, "max": 100}}))
+    good = {"kind": "field", "index": "gx", "field": "v", "shard": 0,
+            "columnIDs": [1], "values": [5], "clear": False}
+    bad = {"kind": "field", "index": "gx", "field": "missing", "shard": 0,
+           "columnIDs": [2], "values": [6], "clear": False}
+    status, resp, _ = req(
+        b, "POST", "/internal/import-stream",
+        _stream_bytes([good, bad, good]),
+        headers={"Content-Type": wire.STREAM_CONTENT_TYPE})
+    assert status == 404, resp
+    assert resp["applied"] == 1
+
+
+def test_send_import_stream_resumes_from_applied(monkeypatch):
+    """429 + {"applied": k} + Retry-After: the client sleeps, rebuilds
+    the stream from chunk k, and finishes."""
+    client = HTTPInternalClient()
+    peer = Node(id="p1", uri=URI(host="127.0.0.1", port=1))
+    reqs = [_value_req(s, [s], [s]) for s in range(3)]
+    bodies = []
+    replies = [(429, {"Retry-After": "0"},
+                json.dumps({"applied": 2}).encode()),
+               (200, {}, b"{}")]
+
+    def fake_http(url, method="GET", body=None, headers=None, timeout=None):
+        assert url.endswith("/internal/import-stream")
+        bodies.append(bytes(body))
+        return replies.pop(0)
+
+    monkeypatch.setattr(client, "_http", fake_http)
+    monkeypatch.setattr("pilosa_tpu.server.httpclient.time.sleep",
+                        lambda s: None)
+    assert client.send_import_stream(peer, reqs) == 3
+    assert len(bodies) == 2
+    first = [wire.decode_import(f) for f in
+             wire.iter_stream_frames(io.BytesIO(bodies[0]).read)]
+    resumed = [wire.decode_import(f) for f in
+               wire.iter_stream_frames(io.BytesIO(bodies[1]).read)]
+    assert [r["shard"] for r in first] == [0, 1, 2]
+    assert [r["shard"] for r in resumed] == [2]
+
+
+def test_send_import_stream_zero_progress_raises(monkeypatch):
+    client = HTTPInternalClient()
+    peer = Node(id="p1", uri=URI(host="127.0.0.1", port=1))
+
+    def always_429(url, method="GET", body=None, headers=None, timeout=None):
+        return 429, {"Retry-After": "0"}, json.dumps({"applied": 0}).encode()
+
+    monkeypatch.setattr(client, "_http", always_429)
+    monkeypatch.setattr("pilosa_tpu.server.httpclient.time.sleep",
+                        lambda s: None)
+    with pytest.raises(NodeHTTPError) as ei:
+        client.send_import_stream(peer, [_value_req(0, [1], [2])])
+    assert ei.value.code == 429
+
+
+def test_send_import_stream_old_peer_fallback(monkeypatch):
+    """404 from a peer that predates the route: the whole stream is
+    replayed per-request through _post_import and the peer is
+    remembered — the next stream skips the probe entirely."""
+    client = HTTPInternalClient()
+    peer = Node(id="old1", uri=URI(host="127.0.0.1", port=1))
+    reqs = [_value_req(s, [s], [s]) for s in range(3)]
+    http_calls, posted = [], []
+
+    def fake_http(url, method="GET", body=None, headers=None, timeout=None):
+        http_calls.append(url)
+        return 404, {}, b'{"error": "not found"}'
+
+    monkeypatch.setattr(client, "_http", fake_http)
+    monkeypatch.setattr(client, "_post_import",
+                        lambda node, r, json_only=False: posted.append(r))
+    assert client.send_import_stream(peer, reqs) == 3
+    assert len(http_calls) == 1 and len(posted) == 3
+    assert peer.id in client._stream_unsupported
+    assert client.send_import_stream(peer, reqs) == 3
+    assert len(http_calls) == 1  # no second probe
+    assert len(posted) == 6
+
+
+# -- coordinator routing (vectorized shard split + stream fan-out) -----------
+
+
+def test_route_import_shard_split_and_stream(monkeypatch):
+    """Columns straddling odd shard boundaries reach the right owners
+    with LWW order preserved, and a multi-shard remote fan-out goes out
+    as ONE import stream per peer."""
+    lc = LocalCluster(2, replica_n=1)
+    lc.create_index("ri")
+    from pilosa_tpu.core.field import FieldOptions
+    lc.create_field("ri", "v", FieldOptions(
+        type="int", min=-1000, max=1000))
+    api = API(lc[0].holder, lc[0].executor, cluster=lc[0].cluster)
+    streams = []
+    orig_send = lc.client.send_import
+
+    def spy_stream(node, reqs):
+        streams.append((node.id, [int(r["shard"]) for r in reqs]))
+        for r in reqs:
+            orig_send(node, r["index"], r["field"], r["shard"],
+                      rows=r["rowIDs"], cols=r["columnIDs"],
+                      values=r["values"], timestamps=r.get("timestamps"),
+                      clear=r["clear"])
+        return len(reqs)
+
+    monkeypatch.setattr(lc.client, "send_import_stream", spy_stream,
+                        raising=False)
+    cols = [0, SHARD_WIDTH - 1, SHARD_WIDTH, SHARD_WIDTH + 1,
+            5 * SHARD_WIDTH - 1, 5 * SHARD_WIDTH,
+            SHARD_WIDTH, 7]  # duplicates: LWW within shard
+    vals = [1, 2, 3, 4, 5, 6, -33, 7]
+    api.import_values("ri", "v", cols, vals)
+    # duplicate column SHARD_WIDTH: the later value (-33) wins
+    expect = {0: 1, SHARD_WIDTH - 1: 2, SHARD_WIDTH: -33,
+              SHARD_WIDTH + 1: 4, 5 * SHARD_WIDTH - 1: 5,
+              5 * SHARD_WIDTH: 6, 7: 7}
+    got = {}
+    for shard in (0, 1, 4, 5):
+        for cn in lc.nodes:
+            frag = cn.holder.fragment("ri", "v", "bsig_v", shard)
+            if frag is None:
+                continue
+            for c, v in expect.items():
+                if c // SHARD_WIDTH == shard:
+                    val, ok = frag.value(c, 11)
+                    assert ok and val == v, (shard, c, val, v)
+                    got[c] = val
+    assert got == expect
+    # remote fan-out used the stream (node1 owns >1 shard with rf=1 only
+    # if placement says so; assert any stream seen had its shards sorted
+    # through one call per peer)
+    for node_id, shards in streams:
+        assert node_id != "node0"
+        assert len(shards) == len(set(shards))
+
+
+def test_route_import_bits_epoch_timestamps():
+    """Routed bit imports carry epoch ints end-to-end (the remote peer
+    re-parses them into time views identically to local application)."""
+    lc = LocalCluster(2, replica_n=1)
+    lc.create_index("ti2")
+    from pilosa_tpu.core.field import FieldOptions
+    lc.create_field("ti2", "t", FieldOptions(type="time", time_quantum="YMD"))
+    api = API(lc[0].holder, lc[0].executor, cluster=lc[0].cluster)
+    cols = [5, SHARD_WIDTH + 6, 3 * SHARD_WIDTH + 7]
+    api.import_bits("ti2", "t", [1, 1, 1], cols,
+                    timestamps=[1700000000, None, 1700000000])
+    r = lc.query("ti2", "Row(t=1)")[0]
+    assert sorted(int(c) for c in r.columns()) == sorted(cols)
+    r = lc.query(
+        "ti2", "Row(t=1, from='2023-11-14T00:00', to='2023-11-16T00:00')")[0]
+    assert sorted(int(c) for c in r.columns()) == [5, 3 * SHARD_WIDTH + 7]
+
+
+# -- ingest/query isolation drill --------------------------------------------
+
+
+@pytest.mark.slow
+def test_ingest_under_query_drill():
+    """Deterministic isolation drill: interactive p99 while a bulk
+    import stream hammers the node must stay within 3x the no-ingest
+    baseline, with ZERO failed queries; backpressure (429) is allowed
+    and counted."""
+    n = ServerNode(bind="127.0.0.1:0", use_planner=False,
+                   qos_max_concurrent=4, ingest_max_inflight_mb=1)
+    n.open()
+    client = HTTPInternalClient(timeout=30)
+    try:
+        b = n.address
+        req(b, "POST", "/index/drill", "{}")
+        req(b, "POST", "/index/drill/field/f", "{}")
+        req(b, "POST", "/index/drill/field/v",
+            json.dumps({"options": {"type": "int", "min": -100_000,
+                                    "max": 100_000}}))
+        rng = np.random.default_rng(7)
+        body = json.dumps({
+            "rowIDs": rng.integers(0, 8, 5000).tolist(),
+            "columnIDs": rng.integers(0, 4 * SHARD_WIDTH, 5000).tolist()})
+        assert req(b, "POST", "/index/drill/field/f/import", body)[0] == 200
+
+        def run_queries(k):
+            lat, fails = [], 0
+            for i in range(k):
+                t0 = time.perf_counter()
+                status, resp, _ = req(b, "POST", "/index/drill/query",
+                                      f"Count(Row(f={i % 8}))")
+                lat.append(time.perf_counter() - t0)
+                if status != 200 or "results" not in resp:
+                    fails += 1
+            return np.percentile(lat, 99), fails
+
+        # warm the query path, then baseline
+        run_queries(10)
+        base_p99, base_fails = run_queries(60)
+        assert base_fails == 0
+
+        stop = threading.Event()
+        backpressured = [0]
+        chunks_sent = [0]
+
+        def ingest():
+            node_ref = _client_node(n)
+            s = 0
+            while not stop.is_set():
+                reqs = [_value_req(
+                    (s + j) % 8,
+                    (((s + j) % 8) * SHARD_WIDTH
+                     + rng.integers(0, SHARD_WIDTH, 2000,
+                                    dtype=np.int64)).tolist(),
+                    rng.integers(-1000, 1000, 2000).tolist(),
+                    index="drill")
+                    for j in range(4)]
+                try:
+                    applied = client.send_import_stream(node_ref, reqs)
+                    chunks_sent[0] += applied
+                except NodeHTTPError as e:
+                    if e.code == 429:
+                        backpressured[0] += 1
+                    else:
+                        raise
+                s += 4
+
+        t = threading.Thread(target=ingest, daemon=True)
+        t.start()
+        try:
+            load_p99, load_fails = run_queries(60)
+        finally:
+            stop.set()
+            t.join(timeout=30)
+        assert load_fails == 0, "interactive queries failed under ingest"
+        assert chunks_sent[0] > 0, "ingest thread made no progress"
+        floor = 0.05  # absolute floor: empty-node baselines are ~µs noisy
+        assert load_p99 <= max(3 * base_p99, floor), \
+            (load_p99, base_p99, backpressured[0])
+    finally:
+        client.close()
+        n.close()
