@@ -877,6 +877,128 @@ def bench_bsi(extra):
 
 
 # ---------------------------------------------------------------------------
+# config 3c: dispatch fusion + same-plan coalescing (one launch per query)
+# ---------------------------------------------------------------------------
+
+
+def bench_dispatch(extra):
+    """Fused plan-step programs + dispatch-coalescing A/B.
+
+    * count_dispatches_per_query — device launches for one uncached
+      3-step Intersect→Count (MUST be 1: the acceptance assertion).
+    * dispatch_agg_uncached_p50_ms_{on,off} — filtered BSI Range→Sum
+      with fusion FORCED on vs the stepped path (filter, plane stack,
+      reduce). Forced because ``auto`` steps filtered aggregates on the
+      XLA CPU backend (see MeshPlanner._fuse_agg_ok); the on/off delta
+      here is the CPU artifact that gate exists for.
+    * dispatch_agg_plain_uncached_p50_ms_{on,off} — unfiltered Sum,
+      where the cached plane cube makes the fused program win on every
+      backend (this one fuses under ``auto`` too).
+    * dispatch_count_uncached_p50_ms_{on,off} + coalesce_batch_width_p50
+      — per-call p50 of a concurrent identical-Count storm with
+      coalescing on vs off (result cache off throughout; fusion stays
+      on in both, the production pairing).
+    """
+    from pilosa_tpu.config import SHARD_WIDTH
+    from pilosa_tpu.core import Holder, FieldOptions
+    from pilosa_tpu.core.field import FIELD_TYPE_INT
+    from pilosa_tpu.exec import Executor
+    from pilosa_tpu.parallel import MeshPlanner, make_mesh
+
+    rng = np.random.default_rng(23)
+    n_shards = 4
+    total = n_shards * SHARD_WIDTH
+    h = Holder()
+    idx = h.create_index("d")
+    f = idx.create_field("f")
+    g = idx.create_field("g")
+    v = idx.create_field("v", FieldOptions(type=FIELD_TYPE_INT,
+                                           min=-100_000, max=100_000))
+    for field in (f, g):
+        field.import_bits(rng.integers(0, 4, 2_000_000),
+                          rng.integers(0, total, 2_000_000,
+                                       dtype=np.uint64))
+    vc = rng.choice(total, 1_000_000, replace=False).astype(np.uint64)
+    v.import_values(vc, rng.integers(-100_000, 100_000, len(vc)))
+
+    planner = MeshPlanner(h, make_mesh())
+    ex = Executor(h, planner=planner)
+    q = "Count(Intersect(Row(f=1), Row(g=2)))"
+    ex.execute("d", q, cache=False)  # compile + warm stacks
+
+    d0 = planner.dispatches
+    ex.execute("d", q, cache=False)
+    dpq = planner.dispatches - d0
+    extra["count_dispatches_per_query"] = dpq
+    assert dpq == 1, f"3-step Count took {dpq} dispatches, want 1"
+
+    # Fusion A/B on the BSI aggregates (the path fusion collapsed from
+    # three launches to one).
+    def agg_p50(agg):
+        ex.execute("d", agg, cache=False)  # warm this mode's path
+        _, p50, _ = _timer(lambda: ex.execute("d", agg, cache=False),
+                           max(10, N_LAT))
+        return p50
+
+    def agg_ab(agg, key, fuse_mode):
+        os.environ["PILOSA_TPU_DISPATCH_FUSE"] = fuse_mode
+        try:
+            fused50 = agg_p50(agg)
+            os.environ["PILOSA_TPU_DISPATCH_FUSE"] = "off"
+            stepped50 = agg_p50(agg)
+        finally:
+            del os.environ["PILOSA_TPU_DISPATCH_FUSE"]
+        extra[f"dispatch_{key}_uncached_p50_ms_on"] = round(fused50, 3)
+        extra[f"dispatch_{key}_uncached_p50_ms_off"] = round(stepped50, 3)
+        extra[f"dispatch_{key}_p50_speedup"] = round(stepped50 / fused50, 2)
+
+    # Filtered: force fusion so the A/B measures the fused program even
+    # on the CPU backend, where "auto" would route it to the stepped
+    # path (the comparator+reduction single-module pathology).
+    agg_ab("Sum(Row(v >< [-50000, 50000]), field=v)", "agg", "on")
+    # Unfiltered: fuses under "auto" on every backend.
+    agg_ab("Sum(field=v)", "agg_plain", "auto")
+    extra["dispatch_agg_auto_gate"] = (
+        "filtered aggs step under auto on backend=cpu; see _fuse_agg_ok")
+
+    # Coalescing A/B: identical uncached Counts from a thread pool —
+    # the repeated-dashboard-query shape coalescing targets.
+    storm_threads = min(THREADS, 16)
+    storm_q = max(min(N_QUERIES, 256), 128)
+    lat_lock = threading.Lock()
+
+    def storm():
+        lats: list[float] = []
+
+        def one(_):
+            t0 = time.perf_counter()
+            ex.execute("d", q, cache=False)
+            dt = time.perf_counter() - t0
+            with lat_lock:
+                lats.append(dt)
+
+        with ThreadPoolExecutor(max_workers=storm_threads) as pool:
+            list(pool.map(one, range(storm_q)))
+        return statistics.median(lats) * 1e3
+
+    os.environ["PILOSA_TPU_DISPATCH_COALESCE"] = "on"
+    try:
+        dstart = planner.dispatches
+        on50 = storm()
+        n_launch = planner.dispatches - dstart
+        widths = planner.batch_widths()[-n_launch:] if n_launch else [1]
+        os.environ["PILOSA_TPU_DISPATCH_COALESCE"] = "off"
+        off50 = storm()
+    finally:
+        del os.environ["PILOSA_TPU_DISPATCH_COALESCE"]
+    extra["coalesce_batch_width_p50"] = statistics.median(widths)
+    extra["dispatch_count_uncached_p50_ms_on"] = round(on50, 3)
+    extra["dispatch_count_uncached_p50_ms_off"] = round(off50, 3)
+    extra["dispatch_count_p50_speedup"] = round(off50 / on50, 2)
+    planner.close()
+
+
+# ---------------------------------------------------------------------------
 # config 3b: streaming ingestion (import stream + WAL group commit +
 # ingest/query isolation)
 # ---------------------------------------------------------------------------
@@ -1421,8 +1543,8 @@ def main() -> None:
 
     want = (set(c.strip() for c in CONFIGS.split(","))
             if CONFIGS != "all"
-            else {"star", "topn", "bsi", "ingest", "time", "cluster",
-                  "cache", "oversub", "backup", "overload"})
+            else {"star", "topn", "bsi", "dispatch", "ingest", "time",
+                  "cluster", "cache", "oversub", "backup", "overload"})
     extra: dict = {"backend": jax.default_backend(),
                    "devices": len(jax.devices())}
 
@@ -1454,6 +1576,7 @@ def main() -> None:
     if "star" in want:
         qps, cpu_qps = bench_star_trace(extra)
     for name, fn in (("topn", bench_topn), ("bsi", bench_bsi),
+                     ("dispatch", bench_dispatch),
                      ("ingest", bench_ingest),
                      ("time", bench_time), ("cluster", bench_cluster),
                      ("cache", bench_cache),

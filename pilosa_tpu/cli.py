@@ -123,6 +123,20 @@ _DEFAULTS = {
     # Import-stream in-flight byte budget, MB (0 = unbounded); over
     # budget trips 429 + Retry-After instead of queueing.
     "ingest_max_inflight_mb": 0,
+    # Query-dispatch pipeline (README "Query dispatch"). Fuse: hot read
+    # plans (Count trees, BSI Sum/Min/Max) trace to ONE jitted device
+    # program per query ("auto" resolves to on; "off" restores the
+    # stepped path, bit-identical). Coalesce: concurrent dispatches of
+    # the same plan signature batch into one launch within a sub-ms
+    # window ("auto" batches only while a same-plan launch is in
+    # flight; "on" always waits the window). Inline transfer: a solo
+    # waiter steals its own device->host wave instead of hopping
+    # through the resolver thread ("auto" steals only when the queue
+    # has a single entry).
+    "dispatch_fuse": "auto",
+    "dispatch_coalesce": "auto",
+    "dispatch_coalesce_us": 150.0,
+    "inline_transfer": "auto",
 }
 
 
@@ -224,6 +238,14 @@ def cmd_server(args) -> int:
         cfg["wal_group_commit_ms"] = args.wal_group_commit_ms
     if args.ingest_max_inflight_mb is not None:
         cfg["ingest_max_inflight_mb"] = args.ingest_max_inflight_mb
+    if args.dispatch_fuse is not None:
+        cfg["dispatch_fuse"] = args.dispatch_fuse
+    if args.dispatch_coalesce is not None:
+        cfg["dispatch_coalesce"] = args.dispatch_coalesce
+    if args.dispatch_coalesce_us is not None:
+        cfg["dispatch_coalesce_us"] = args.dispatch_coalesce_us
+    if args.inline_transfer is not None:
+        cfg["inline_transfer"] = args.inline_transfer
 
     from pilosa_tpu.server.node import ServerNode
     node = ServerNode(
@@ -272,6 +294,10 @@ def cmd_server(args) -> int:
         ingest_transpose=str(cfg["ingest_transpose"]) or "auto",
         wal_group_commit_ms=float(cfg["wal_group_commit_ms"]),
         ingest_max_inflight_mb=int(cfg["ingest_max_inflight_mb"]),
+        dispatch_fuse=str(cfg["dispatch_fuse"]) or "auto",
+        dispatch_coalesce=str(cfg["dispatch_coalesce"]) or "auto",
+        dispatch_coalesce_us=float(cfg["dispatch_coalesce_us"]),
+        inline_transfer=str(cfg["inline_transfer"]) or "auto",
     )
     node.open()  # starts the (single) serve loop in the background
     print(f"pilosa-tpu serving at {node.address}", file=sys.stderr)
@@ -701,7 +727,14 @@ def cmd_generate_config(args) -> int:
           'wal-group-commit-ms = 0.0\n'
           '# import-stream in-flight budget, MB (0 = unbounded;\n'
           '# over budget replies 429 + Retry-After + applied count)\n'
-          'ingest-max-inflight-mb = 0')
+          'ingest-max-inflight-mb = 0\n'
+          '# query dispatch: fused one-program-per-query plans, same-plan\n'
+          '# dispatch coalescing (window in microseconds), and inline\n'
+          '# transfer resolution — all bit-identical on|off|auto knobs\n'
+          'dispatch-fuse = "auto"\n'
+          'dispatch-coalesce = "auto"\n'
+          'dispatch-coalesce-us = 150.0\n'
+          'inline-transfer = "auto"')
     return 0
 
 
@@ -800,6 +833,24 @@ def main(argv: list[str] | None = None) -> int:
                    help="import-stream in-flight byte budget, MB "
                         "(default 0 = unbounded; over budget replies "
                         "429 + Retry-After)")
+    s.add_argument("--dispatch-fuse", choices=("on", "off", "auto"),
+                   default=None,
+                   help="fuse hot read plans into one jitted device "
+                        "program per query (default auto = on; "
+                        "bit-identical to the stepped path)")
+    s.add_argument("--dispatch-coalesce", choices=("on", "off", "auto"),
+                   default=None,
+                   help="batch concurrent same-plan dispatches into one "
+                        "launch (default auto = batch only while a "
+                        "same-plan launch is in flight)")
+    s.add_argument("--dispatch-coalesce-us", type=float, default=None,
+                   help="coalescing collection window, microseconds "
+                        "(default 150)")
+    s.add_argument("--inline-transfer", choices=("on", "off", "auto"),
+                   default=None,
+                   help="resolve a device->host wave on its waiter's "
+                        "thread when it is the only waiter (default "
+                        "auto)")
     s.add_argument("--config", default=None)
     s.set_defaults(fn=cmd_server)
 

@@ -42,6 +42,7 @@ from pilosa_tpu.errors import (
     IndexNotFoundError,
     QueryError,
 )
+from pilosa_tpu.exec import fuse as _fuse
 from pilosa_tpu.exec.result import (
     FieldRow,
     GroupCount,
@@ -166,6 +167,8 @@ class Executor:
         if raw is not None:
             query = self._parse_cached(raw)
         opt = opt or ExecOptions()
+        if not opt.remote:
+            _fuse.reset_fused_steps()
         idx = self.holder.index(index_name)
         if idx is None:
             raise IndexNotFoundError(f"index not found: {index_name!r}")
@@ -259,6 +262,8 @@ class Executor:
         """
         fut: Future = Future()
         opt = opt or ExecOptions()
+        if not opt.remote:
+            _fuse.reset_fused_steps()
         raw = query if isinstance(query, str) else None
         if shards is not None and not isinstance(shards, list):
             shards = list(shards)  # one materialization; never consume
@@ -288,7 +293,8 @@ class Executor:
                 if (e is not None
                         and ((shards is None and e[8])
                              or (shards is not None and shards == e[3]))):
-                    _, _, epoch, pshards, fn, arrays, rkey, post, _ = e
+                    (_, _, epoch, pshards, fn, arrays, rkey, post, _,
+                     steps) = e
                     with self._cache_lock:
                         if (index_name, raw) in self._prepared:
                             self._prepared.move_to_end((index_name, raw))
@@ -304,7 +310,6 @@ class Executor:
                             fut.set_result(hit)
                             return fut
                     try:
-                        out = fn(*arrays)
                         if cacheable:
                             # Store via the batcher callback; closure
                             # only on the cacheable path.
@@ -313,10 +318,15 @@ class Executor:
                                 results = _p(host)
                                 self.result_cache.put(_t, _k, _s, results)
                                 return results
-                        # Return the batcher future DIRECTLY: a second
+                        _fuse.add_fused_steps(steps)
+                        # Return the dispatch future DIRECTLY: a second
                         # Future + callback chain costs more than the
-                        # whole remaining fast path on a slow host.
-                        return self.planner.batcher.submit(out, post)
+                        # whole remaining fast path on a slow host. The
+                        # coalescer is the launch choke point — repeated
+                        # prepared queries are exactly the same-plan
+                        # waves it batches.
+                        return self.planner.dispatch_count(fn, arrays,
+                                                           post)
                     except Exception as exc:
                         fut.set_exception(exc)
                         return fut
@@ -386,6 +396,7 @@ class Executor:
             elif shards:
                 fn, arrays = self.planner.prepare_count(
                     idx, call.children[0], shards)
+                steps = _fuse.call_steps(call.children[0]) + 1
                 if raw is not None:
                     sum_host = self.planner._sum_host
                     with self._cache_lock:
@@ -400,9 +411,10 @@ class Executor:
                             idx.instance_id, idx.schema_epoch.value,
                             epoch, shards, fn, arrays, key,
                             lambda host, _s=sum_host: [_s(host)],
-                            shards_obj is None)
+                            shards_obj is None, steps)
                         while len(self._prepared) > self.PREPARED_CACHE_SIZE:
                             self._prepared.popitem(last=False)
+                _fuse.add_fused_steps(steps)
                 inner = self.planner.dispatch_count(fn, arrays)
             else:
                 inner = self.planner.execute_count_async(
@@ -449,8 +461,15 @@ class Executor:
         # executor.go:295 etc.).
         self.stats.with_tags(f"index:{idx.name}").count(name)
         from pilosa_tpu.obs import start_span
-        with start_span(f"Executor.execute{name}"):
-            return self._execute_call_inner(idx, c, shards, opt)
+        with start_span(f"Executor.execute{name}") as span:
+            before = _fuse.fused_steps()
+            try:
+                return self._execute_call_inner(idx, c, shards, opt)
+            finally:
+                # Plan-tree steps this call ran fused into device
+                # programs — the observable difference between a query
+                # that ran as ONE program and one that stepped.
+                span.set_tag("exec.fusedSteps", _fuse.fused_steps() - before)
 
     def _execute_call_inner(self, idx: Index, c: Call, shards: list[int],
                             opt: ExecOptions) -> Any:
@@ -551,8 +570,17 @@ class Executor:
         # this tag; untagged reduces keep the pairwise fold.
         reduce_fn.reduce_kind = "row_union"
 
-        local_batch = (lambda shs: planner.execute_bitmap(idx, c, shs)) \
-            if planner is not None else None
+        if planner is not None:
+            local_batch = lambda shs: planner.execute_bitmap(idx, c, shs)
+        else:
+            fusion = self._fuse_partial(c)
+            if fusion is not None:
+                fused_call, const_calls = fusion
+                local_batch = (lambda shs: self.planner.execute_bitmap(
+                    idx, fused_call, shs,
+                    const_rows=self._const_rows(idx, const_calls, shs)))
+            else:
+                local_batch = None
         row = self.map_reduce(idx, shards, c, opt, map_fn, reduce_fn,
                               local_batch_fn=local_batch) or Row()
 
@@ -572,6 +600,58 @@ class Executor:
         if opt.exclude_columns:
             row.segments = {}
         return row
+
+    def _fuse_partial(self, c: Call):
+        """Maximal-subtree fusion for MIXED trees: when the planner
+        rejects the whole bitmap tree, rewrite it so every maximal
+        plannable subtree still runs on device and each unplannable
+        subtree becomes a ``__const__`` leaf (a host-computed Row
+        uploaded as a device stack). Returns (fused_call, const_calls)
+        or None when partial fusion doesn't apply — the planner handles
+        the whole tree, fusion is off, or no plannable subtree remains
+        worth lowering."""
+        planner = self.planner
+        if (planner is None or not _fuse.enabled()
+                or not getattr(planner, "fuse_const_supported", False)):
+            return None
+        if planner.supports(c):
+            return None  # whole-tree path already covers it
+        consts: list[Call] = []
+        kept = [False]
+
+        def rewrite(node: Call) -> Call:
+            if planner.supports(node):
+                kept[0] = True
+                return node
+            # Only n-ary set ops descend: Not/Shift carry structural
+            # requirements (existence field, shift bounds) the planner
+            # validated as part of supports(); an unplannable child
+            # makes the whole unary subtree a const leaf.
+            if (node.name in ("Intersect", "Union", "Xor", "Difference")
+                    and node.children):
+                return Call(node.name, args=dict(node.args),
+                            children=[rewrite(ch) for ch in node.children])
+            consts.append(node)
+            return Call("__const__", args={"slot": len(consts) - 1})
+
+        fused = rewrite(c)
+        if not kept[0] or not consts:
+            return None
+        return fused, consts
+
+    def _const_rows(self, idx: Index, const_calls: list[Call],
+                    shards: list[int]) -> list[Row]:
+        """Evaluate each replaced subtree host-side over ``shards`` —
+        the same per-shard interpreter the full fallback would have run,
+        but only for the unplannable fraction of the tree."""
+        rows = []
+        for cc in const_calls:
+            segs: dict[int, Any] = {}
+            for shard in shards:
+                r = self._bitmap_call_shard(idx, cc, shard)
+                segs.update(r.segments)
+            rows.append(Row(segs))
+        return rows
 
     def _bitmap_call_shard(self, idx: Index, c: Call, shard: int) -> Row:
         """Reference executeBitmapCallShard (executor.go:659)."""
@@ -839,8 +919,18 @@ class Executor:
         def map_fn(shard):
             return self._bitmap_call_shard(idx, c.children[0], shard).count()
 
-        local_batch = (lambda shs: planner.execute_count(idx, c.children[0], shs)) \
-            if planner is not None else None
+        if planner is not None:
+            local_batch = (lambda shs:
+                           planner.execute_count(idx, c.children[0], shs))
+        else:
+            fusion = self._fuse_partial(c.children[0])
+            if fusion is not None:
+                fused_call, const_calls = fusion
+                local_batch = (lambda shs: self.planner.execute_count(
+                    idx, fused_call, shs,
+                    const_rows=self._const_rows(idx, const_calls, shs)))
+            else:
+                local_batch = None
         return self.map_reduce(idx, shards, c, opt, map_fn,
                                lambda p, v: (p or 0) + v,
                                local_batch_fn=local_batch) or 0

@@ -1,0 +1,95 @@
+"""Plan-segment fusion: one jitted device program per query.
+
+The reference executor pays one map+reduce round per plan *step*
+(executor.go:2561-2608); the planner already collapses a pure bitmap
+tree + Count into one XLA program, but two hot paths still dispatched
+per step until this module:
+
+* BSI aggregates (Sum/Min/Max over an optional Range filter) ran as
+  three device launches — the filter tree, an eager ``jnp.stack`` of
+  the magnitude planes, and the aggregate kernel. Fused, all three
+  trace into ONE jitted program (parallel/planner.py prepare_sum /
+  prepare_min_max), cached under the structural plan signature so pow2
+  plan-shape bucketing and the persistent compile cache apply
+  unchanged.
+* Mixed call trees with an unplannable subtree fell back to the
+  per-shard host interpreter for the WHOLE tree. The executor now
+  lowers the maximal pure-device subtree instead: each unplannable
+  subtree is evaluated host-side to a Row and injected as a ``const``
+  leaf slot of the fused program (Executor._fuse_partial).
+
+Selection: ``PILOSA_TPU_DISPATCH_FUSE`` = ``on`` | ``off`` | ``auto``
+(env wins over the server knob's ``set_mode``). ``auto`` fuses
+everything except one measured anti-case: a FILTERED aggregate on the
+XLA CPU backend, where compiling the bit-serial comparator into the
+same module as the broadcast reduction produces ~2x-slower code (see
+MeshPlanner._fuse_agg_ok) — that combination steps under ``auto`` and
+fuses only under ``on``. ``off`` exists for the bit-equivalence tests
+and for bisecting regressions. Both sides are bit-identical by
+generative test (tests/test_dispatch_fusion.py).
+
+This module also carries the per-query fused-step account: every
+planner dispatch records how many plan-tree calls its program fused,
+surfaced as the ``exec.fusedSteps`` span tag and the ``fusedSteps``
+field of slow-query log entries — the observable difference between a
+query that ran as one program and one that stepped.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import os
+
+_MODES = ("on", "off", "auto")
+_default_mode = "auto"
+
+
+def set_mode(mode: str) -> None:
+    """Server-knob default; the PILOSA_TPU_DISPATCH_FUSE env var (the
+    test/operator override) takes precedence when set."""
+    global _default_mode
+    if mode not in _MODES:
+        raise ValueError(f"dispatch_fuse mode must be one of {_MODES}")
+    _default_mode = mode
+
+
+def mode() -> str:
+    m = os.environ.get("PILOSA_TPU_DISPATCH_FUSE", "").strip().lower()
+    return m if m in _MODES else _default_mode
+
+
+def enabled() -> bool:
+    return mode() != "off"
+
+
+# -- per-query fused-step accounting ----------------------------------------
+
+#: plan-tree calls executed inside a single device program, accumulated
+#: over the current query's dispatches. A contextvar so the value rides
+#: the request thread through executor -> planner -> HTTP handler
+#: without threading a parameter through every dispatch signature.
+_fused_steps: contextvars.ContextVar[int] = contextvars.ContextVar(
+    "pilosa_tpu_fused_steps", default=0)
+
+
+def reset_fused_steps() -> None:
+    _fused_steps.set(0)
+
+
+def add_fused_steps(n: int) -> None:
+    if n:
+        _fused_steps.set(_fused_steps.get() + int(n))
+
+
+def fused_steps() -> int:
+    return _fused_steps.get()
+
+
+def call_steps(c) -> int:
+    """Number of Call nodes in a plan tree — the step count a fused
+    program absorbs (the per-step map+reduce rounds the reference would
+    have paid)."""
+    n = 1
+    for ch in getattr(c, "children", ()):
+        n += call_steps(ch)
+    return n

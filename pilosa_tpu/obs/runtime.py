@@ -42,6 +42,13 @@ def collect_runtime_gauges(stats, planner=None,
         out["plannerCacheBudgetBytes"] = float(snap["budget_bytes"])
         out["plannerCacheEntries"] = float(snap["entries"])
         out["plannerCacheEvictions"] = float(snap.get("evictions", 0))
+        # Dispatch accounting (fused programs + coalescing): launches
+        # and queries-absorbed-by-batching since boot. The live
+        # planner.dispatchCount/dispatchCoalesced counters on
+        # /debug/vars tick per launch; these gauges snapshot totals.
+        out["plannerDispatches"] = float(snap.get("dispatches", 0))
+        out["plannerDispatchesCoalesced"] = float(
+            snap.get("dispatches_coalesced", 0))
 
     if planner is not None and probe_device:
         # Only device-using nodes probe device memory: jax.local_devices

@@ -134,6 +134,14 @@ class DistributedMeshPlanner(MeshPlanner):
         self.owned_shards = frozenset(int(s) for s in owned_shards)
         self.batcher.close()
         self.batcher = SyncBatcher()
+        # Every process must run the SAME launch schedule: coalescing
+        # (thread-local batching) and fused/const programs that skip
+        # _replicate_small's resharding would desync the collective
+        # order, so the distributed planner keeps the stepped paths.
+        self.coalesce_supported = False
+        self.coalesce_vmap_supported = False
+        self.fuse_aggregates_supported = False
+        self.fuse_const_supported = False
         self._pid = jax.process_index()
         flat = list(self.mesh.devices.reshape(-1))
         #: (device, global mesh position) for this process's devices.

@@ -23,7 +23,7 @@ from __future__ import annotations
 
 import functools
 import threading
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from typing import Any, Callable
 
 import jax
@@ -40,8 +40,10 @@ from pilosa_tpu.errors import (
     FieldNotFoundError,
     QueryError,
 )
+from pilosa_tpu.exec import fuse as _fuse
 from pilosa_tpu.ops import bitops, bsi as bsi_ops
 from pilosa_tpu.parallel.batcher import TransferBatcher
+from pilosa_tpu.parallel.coalesce import DispatchCoalescer
 from pilosa_tpu.parallel.mesh import (
     SHARD_AXIS,
     make_mesh,
@@ -63,10 +65,12 @@ class MeshPlanner:
 
     def __init__(self, holder, mesh=None,
                  max_cache_bytes: int = DEFAULT_CACHE_BYTES,
-                 bucket_policy: str = "pow2"):
+                 bucket_policy: str = "pow2", stats=None,
+                 coalesce_window_us: float | None = None):
         self.holder = holder
         self.mesh = mesh if mesh is not None else make_mesh()
         self.n_devices = int(np.prod(self.mesh.devices.shape))
+        self.stats = stats
         #: plan-shape bucketing policy ("pow2" | "none"): stack heights
         #: round up to power-of-two buckets so a never-seen shard count
         #: dispatches into an already-compiled program (see _pad).
@@ -120,6 +124,42 @@ class MeshPlanner:
         #: traffic actually runs, not just the canonical set.
         self._observed: "OrderedDict[tuple, int]" = OrderedDict()
         self.OBSERVED_SIZE = 256
+        #: program metadata by compiled-function identity: full
+        #: structural signature (the coalescer's batch key — the result
+        #: cache already proved same-signature plans identical, so the
+        #: key comes free) and the raw unjitted program (vmappable for
+        #: the [B, ...] batched launch; None for programs that can't
+        #: vmap, e.g. Pallas kernels). Entries live exactly as long as
+        #: _fn_cache pins the function, so ids never recycle underneath.
+        self._fn_info: dict[int, tuple[tuple, Callable | None]] = {}
+        #: plan signature -> jitted vmapped program (jit re-specializes
+        #: per [B, ...] shape internally, so one entry per signature).
+        self._vmap_cache: dict[tuple, Callable] = {}
+        #: query-program launch accounting (planner.dispatchCount /
+        #: dispatchCoalesced / coalesceBatchWidth on /debug/vars; the
+        #: bench's dispatches-per-query series reads the raw counters).
+        self._dispatch_lock = threading.Lock()
+        self.dispatches = 0
+        self.dispatches_coalesced = 0
+        self._batch_widths: "deque[int]" = deque(maxlen=512)
+        #: same-plan dispatch coalescing (parallel.coalesce): every
+        #: Count / fused-aggregate launch goes through it.
+        self.coalescer = DispatchCoalescer(self, coalesce_window_us)
+        #: overridden off by the distributed planner: its outputs need
+        #: cross-process replication the coalescer doesn't reproduce.
+        self.coalesce_supported = True
+        #: the [B, ...] vmapped wave loses NamedShardings when stacking;
+        #: restrict it to single-device meshes (the identical-argument
+        #: shared wave is layout-preserving and stays available).
+        self.coalesce_vmap_supported = self.n_devices == 1
+        #: fused Sum/Min/Max programs (see exec/fuse.py); the
+        #: distributed planner keeps the stepped path, whose
+        #: _replicate_small hook reshards each output.
+        self.fuse_aggregates_supported = True
+        #: __const__ leaf injection (executor partial fusion of mixed
+        #: trees); off for the distributed planner, whose const upload
+        #: would need cross-process placement.
+        self.fuse_const_supported = True
 
     # ------------------------------------------------------------------
     # public API
@@ -140,12 +180,15 @@ class MeshPlanner:
                 return False
         return all(self.supports(ch) for ch in c.children)
 
-    def execute_count(self, idx: Index, c: Call, shards: list[int]) -> int:
+    def execute_count(self, idx: Index, c: Call, shards: list[int],
+                      const_rows: list | None = None) -> int:
         """Count(tree) as one device program with ICI all-reduce; the
         result transfer rides the shared batcher wave."""
-        return self.execute_count_async(idx, c, shards).result()
+        return self.execute_count_async(idx, c, shards,
+                                        const_rows=const_rows).result()
 
-    def execute_count_async(self, idx: Index, c: Call, shards: list[int]):
+    def execute_count_async(self, idx: Index, c: Call, shards: list[int],
+                            const_rows: list | None = None):
         """Dispatch Count(tree) and return a Future[int]. The device
         program is enqueued immediately; the per-shard popcounts are
         pulled through the TransferBatcher, so any number of concurrent
@@ -156,10 +199,13 @@ class MeshPlanner:
             fut: Future = Future()
             fut.set_result(0)
             return fut
-        fn, arrays = self.prepare_count(idx, c, shards)
+        fn, arrays = self.prepare_count(idx, c, shards,
+                                        const_rows=const_rows)
+        _fuse.add_fused_steps(_fuse.call_steps(c) + 1)
         return self.dispatch_count(fn, arrays)
 
-    def prepare_count(self, idx: Index, c: Call, shards: list[int]):
+    def prepare_count(self, idx: Index, c: Call, shards: list[int],
+                      const_rows: list | None = None):
         """Resolve Count(tree) to its (jitted fn, leaf device arrays)
         without dispatching — the executor's prepared-query fast path
         caches the pair and re-dispatches with zero per-query planning
@@ -167,12 +213,18 @@ class MeshPlanner:
         # schema_epoch: plans bake field STRUCTURE (a BSI comparator's
         # bit-depth, sign-class branches, base folds), so any schema
         # change — field create/delete, bit-depth growth — must miss.
-        plan_key = (idx.name, idx.instance_id, idx.schema_epoch.value,
-                    str(c), tuple(shards))
-        with self._cache_lock:
-            hit = self._plan_cache.get(plan_key)
-            if hit is not None:
-                self._plan_cache.move_to_end(plan_key)
+        # Const-leaf plans (partial fusion of a mixed tree) bypass the
+        # text-keyed plan cache: their __const__ slots print identically
+        # while holding per-query host rows. The structural _fn_cache
+        # still shares the compiled program across const values.
+        hit = None
+        if const_rows is None:
+            plan_key = (idx.name, idx.instance_id, idx.schema_epoch.value,
+                        str(c), tuple(shards))
+            with self._cache_lock:
+                hit = self._plan_cache.get(plan_key)
+                if hit is not None:
+                    self._plan_cache.move_to_end(plan_key)
         if hit is not None:
             leaves, fn = hit
         else:
@@ -180,19 +232,22 @@ class MeshPlanner:
             sig = self._signature(idx, c, leaves)
             fn = self._compiled(("count",) + sig, c, idx,
                                 reduce="per_shard")
-            with self._cache_lock:
-                self._plan_cache[plan_key] = (leaves, fn)
-                while len(self._plan_cache) > self.PLAN_CACHE_SIZE:
-                    self._plan_cache.popitem(last=False)
-                # Record the executable form (with the Count wrapper):
-                # warmup replays these strings through the Executor, and
-                # only a Count() reaches prepare_count again.
-                okey = (idx.name, f"Count({c})", len(shards))
-                self._observed[okey] = self._observed.get(okey, 0) + 1
-                self._observed.move_to_end(okey)
-                while len(self._observed) > self.OBSERVED_SIZE:
-                    self._observed.popitem(last=False)
-        arrays = [self._fetch_leaf(idx, leaf, tuple(shards))
+            if const_rows is None:
+                with self._cache_lock:
+                    self._plan_cache[plan_key] = (leaves, fn)
+                    while len(self._plan_cache) > self.PLAN_CACHE_SIZE:
+                        self._plan_cache.popitem(last=False)
+                    # Record the executable form (with the Count
+                    # wrapper): warmup replays these strings through the
+                    # Executor, and only a Count() reaches prepare_count
+                    # again.
+                    okey = (idx.name, f"Count({c})", len(shards))
+                    self._observed[okey] = self._observed.get(okey, 0) + 1
+                    self._observed.move_to_end(okey)
+                    while len(self._observed) > self.OBSERVED_SIZE:
+                        self._observed.popitem(last=False)
+        arrays = [self._fetch_leaf(idx, leaf, tuple(shards),
+                                   const_rows=const_rows)
                   for leaf in leaves]
         return fn, arrays
 
@@ -202,24 +257,79 @@ class MeshPlanner:
         # immune to int32 overflow past ~2k full shards.
         return int(host.astype(np.int64).sum())
 
-    def dispatch_count(self, fn, arrays):
-        """Enqueue a prepared count's device program; Future[int]."""
-        return self.batcher.submit(fn(*arrays), self._sum_host)
+    def dispatch_count(self, fn, arrays, post=None):
+        """Enqueue a prepared count's device program; Future[int].
+        Routed through the coalescer so concurrent dispatches of the
+        same plan signature share one launch."""
+        return self.coalescer.dispatch(fn, arrays, post or self._sum_host)
 
-    def _tree_stack(self, idx: Index, c: Call, shards: list[int]) -> jax.Array:
+    # -- launch accounting / program registry --------------------------
+
+    def _record_dispatch(self, width: int = 1) -> None:
+        """One device-program launch answering ``width`` queries."""
+        with self._dispatch_lock:
+            self.dispatches += 1
+            if width > 1:
+                self.dispatches_coalesced += width - 1
+            self._batch_widths.append(width)
+        if self.stats is not None:
+            self.stats.count("planner.dispatchCount", 1)
+            if width > 1:
+                self.stats.count("planner.dispatchCoalesced", width - 1)
+            self.stats.gauge("planner.coalesceBatchWidth", width)
+
+    def batch_widths(self) -> list[int]:
+        """Recent per-launch batch widths (bench's coalesce p50)."""
+        with self._dispatch_lock:
+            return list(self._batch_widths)
+
+    def _register_fn(self, fn, full_sig: tuple, raw) -> None:
+        self._fn_info[id(fn)] = (full_sig, raw)
+
+    def fn_key(self, fn):
+        """The coalescer's batch key for a compiled program — its full
+        structural signature (None for unregistered callables)."""
+        info = self._fn_info.get(id(fn))
+        return info[0] if info is not None else None
+
+    def fn_raw(self, fn):
+        """The raw (unjitted, vmappable) program behind a compiled fn."""
+        info = self._fn_info.get(id(fn))
+        return info[1] if info is not None else None
+
+    def vmapped(self, full_sig: tuple, raw) -> Callable:
+        """jit(vmap(program)) for the [B, ...] coalesced wave; cached by
+        signature (jit re-specializes per batch-shape internally)."""
+        with self._cache_lock:
+            vfn = self._vmap_cache.get(full_sig)
+        if vfn is None:
+            vfn = jax.jit(jax.vmap(raw))
+            with self._cache_lock:
+                self._vmap_cache[full_sig] = vfn
+        return vfn
+
+    def _tree_stack(self, idx: Index, c: Call, shards: list[int],
+                    const_rows: list | None = None) -> jax.Array:
         """Evaluate a bitmap tree to its stacked [S_pad, W] device array."""
         leaves: list[tuple] = []
         sig = self._signature(idx, c, leaves)
-        arrays = [self._fetch_leaf(idx, leaf, tuple(shards)) for leaf in leaves]
+        arrays = [self._fetch_leaf(idx, leaf, tuple(shards),
+                                   const_rows=const_rows)
+                  for leaf in leaves]
         fn = self._compiled(("row",) + sig, c, idx, reduce=None)
-        return fn(*arrays)
+        out = fn(*arrays)
+        self._record_dispatch(1)
+        _fuse.add_fused_steps(_fuse.call_steps(c))
+        return out
 
-    def execute_bitmap(self, idx: Index, c: Call, shards: list[int]) -> Row:
+    def execute_bitmap(self, idx: Index, c: Call, shards: list[int],
+                       const_rows: list | None = None) -> Row:
         """Evaluate the tree to a Row whose segments are device slices of
         the stacked result (no host sync)."""
         if not shards:
             return Row()
-        out = self._tree_stack(idx, c, shards)  # [S_pad, W]
+        out = self._tree_stack(idx, c, shards,
+                               const_rows=const_rows)  # [S_pad, W]
         return Row({shard: out[i] for i, shard in enumerate(shards)})
 
     # ------------------------------------------------------------------
@@ -255,15 +365,98 @@ class MeshPlanner:
             filt = self._tree_stack(idx, c.children[0], shards)
         else:
             filt = _jit_full_like(exists)
+            self._record_dispatch(1)
         stack = jnp.stack(bits, axis=0) if bits else \
             jnp.zeros((0,) + exists.shape, exists.dtype)
+        self._record_dispatch(1)  # the eager plane stack
         return f, exists, sign, stack, filt, depth
+
+    def _prepare_agg(self, idx: Index, c: Call, shards: list[int],
+                     kind: str, is_min: bool):
+        """Fused Sum/Min/Max: (jitted fn, leaf arrays, depth) for ONE
+        program tracing filter tree + plane stack + aggregate kernel.
+        Shares the prepared-plan cache, structural program cache, and
+        pow2 bucketing with the count path."""
+        field_name, _ = c.string_arg("field")
+        f = idx.field(field_name)
+        depth = f.bsi_group.bit_depth
+        plan_key = (idx.name, idx.instance_id, idx.schema_epoch.value,
+                    f"{kind}{int(is_min)}:{c}", tuple(shards))
+        with self._cache_lock:
+            hit = self._plan_cache.get(plan_key)
+            if hit is not None:
+                self._plan_cache.move_to_end(plan_key)
+        if hit is not None:
+            leaves, fn = hit
+        else:
+            leaves = [("bsiagg", field_name, depth)]
+            filt_sig = (self._signature(idx, c.children[0], leaves)
+                        if c.children else None)
+            full_sig = (kind, is_min, depth, filt_sig)
+            fn = self._compiled_agg(full_sig, kind, depth, filt_sig,
+                                    is_min)
+            with self._cache_lock:
+                self._plan_cache[plan_key] = (leaves, fn)
+                while len(self._plan_cache) > self.PLAN_CACHE_SIZE:
+                    self._plan_cache.popitem(last=False)
+        arrays = [self._fetch_leaf(idx, leaf, tuple(shards))
+                  for leaf in leaves]
+        return fn, arrays, depth
+
+    def _compiled_agg(self, full_sig: tuple, kind: str, depth: int,
+                      filt_sig, is_min: bool) -> Callable:
+        fn = self._fn_cache.get(full_sig)
+        if fn is not None:
+            return fn
+
+        def program(*args):
+            # args[0] is the "bsiagg" leaf: the plane cube arrives
+            # pre-stacked (and cached), so the program is filter+reduce.
+            exists, sign, stack = args[0]
+            if filt_sig is not None:
+                # The barrier pins the comparator output as a single
+                # shared value so the 2*depth intersection-count
+                # consumers can't each re-derive it. It does NOT undo
+                # the XLA:CPU slowdown from compiling the comparator
+                # and the broadcast reduction into one module — that
+                # case is routed to the stepped path by _fuse_agg_ok.
+                filt = jax.lax.optimization_barrier(
+                    _eval_node(filt_sig, args))
+            else:
+                filt = jnp.full_like(exists, jnp.uint32(0xFFFFFFFF))
+            if kind == "sum":
+                return bsi_ops.sum_counts(exists, sign, stack, filt,
+                                          depth)
+            return _agg_min_max(exists, sign, stack, filt, depth, is_min)
+
+        fn = self._jit_program(program, None)
+        self._fn_cache[full_sig] = fn
+        self._register_fn(fn, full_sig, program)
+        return fn
 
     def execute_sum(self, idx: Index, c: Call, shards: list[int]):
         """Global (sum-of-base-offsets, count) in one device program; the
         executor applies the BSI base (reference fragment.sum :1111 under
         executeSum :406)."""
         return self.dispatch_sum(idx, c, shards).result()
+
+    def _fuse_agg_ok(self, c: Call) -> bool:
+        """Fused-aggregate gate. Unfiltered aggregates fuse everywhere:
+        with the plane cube cached, one program is strictly cheaper than
+        the stepped path's per-query eager restack (measured 3.5x on the
+        CPU backend). A FILTERED aggregate fuses under ``auto`` only
+        off-CPU: XLA's CPU backend compiles the bit-serial comparator
+        and the broadcast reduction into a ~2x-slower loop structure
+        when they share one module (bench's dispatch config;
+        optimization barriers don't dissuade it), while the TPU tunnel
+        is dispatch-bound, so one launch instead of three wins there
+        regardless. ``on`` forces fusion — the bit-equivalence tests and
+        TPU-style measurement use it."""
+        if not (_fuse.enabled() and self.fuse_aggregates_supported):
+            return False
+        if not c.children or _fuse.mode() == "on":
+            return True
+        return jax.default_backend() != "cpu"
 
     def dispatch_sum(self, idx: Index, c: Call, shards: list[int]):
         """Async Sum: enqueue the device program and return a
@@ -276,9 +469,28 @@ class MeshPlanner:
             fut: Future = Future()
             fut.set_result((0, 0))
             return fut
+        if self._fuse_agg_ok(c):
+            # Fused: filter tree + plane stack + sum kernel trace into
+            # ONE jitted program; the host fold rides the coalescer's
+            # transfer wave.
+            fn, arrays, depth = self._prepare_agg(idx, c, shards,
+                                                  "sum", False)
+            _fuse.add_fused_steps(_fuse.call_steps(c))
+
+            def fold_fused(host):
+                cnt_host, pos, neg = host
+                count = int(np.asarray(cnt_host).astype(np.int64).sum())
+                p = np.asarray(pos, dtype=np.int64).sum(axis=-1)
+                n = np.asarray(neg, dtype=np.int64).sum(axis=-1)
+                total = sum((1 << i) * (int(p[i]) - int(n[i]))
+                            for i in range(depth))
+                return total, count
+
+            return self.coalescer.dispatch(fn, arrays, fold_fused)
         _, exists, sign, stack, filt, depth = self._bsi_inputs(idx, c, shards)
         cnt, pos, neg = self._replicate_small(
             *bsi_ops.sum_counts(exists, sign, stack, filt, depth))
+        self._record_dispatch(1)  # the aggregate kernel launch
         # Start all three device->host copies before reading any: the
         # copies pipeline, so total latency is ~one transfer round-trip
         # instead of three sequential ones (r2's 3x sum latency).
@@ -311,40 +523,32 @@ class MeshPlanner:
             fut: Future = Future()
             fut.set_result((0, 0))
             return fut
+        n_shards = len(shards)
+        if self._fuse_agg_ok(c):
+            fn, arrays, _ = self._prepare_agg(idx, c, shards,
+                                              "minmax", is_min)
+            _fuse.add_fused_steps(_fuse.call_steps(c))
+
+            def fold_fused(host):
+                cc, ac, av, bv = host
+                return _fold_min_max(np.asarray(cc), np.asarray(ac),
+                                     av, bv, n_shards, is_min)
+
+            return self.coalescer.dispatch(fn, arrays, fold_fused)
         _, exists, sign, stack, filt, depth = self._bsi_inputs(idx, c, shards)
         cons_cnt, alt_cnt, a, b = _agg_min_max(exists, sign, stack, filt,
                                                depth, is_min)
         cons_cnt, alt_cnt, *flat = self._replicate_small(
             cons_cnt, alt_cnt, *a, *b)
         a, b = tuple(flat[:len(a)]), tuple(flat[len(a):])
+        self._record_dispatch(1)  # the aggregate kernel launch
         # One pipelined transfer wave for all eight outputs (r2 paid ~8
         # sequential round-trips here: Min was 2.5x slower than Sum).
         _copy_async(cons_cnt, alt_cnt, *a, *b)
-        n_shards = len(shards)
 
         def fold(cons_host):
-            cc = cons_host
-            ac = np.asarray(alt_cnt)
-            # lo/hi stay scalar when no magnitude bit reached their half
-            # (e.g. hi for depth<=32); broadcast to per-shard vectors.
-            av = tuple(np.broadcast_to(np.asarray(x), cc.shape) for x in a)
-            bv = tuple(np.broadcast_to(np.asarray(x), cc.shape) for x in b)
-            best_val, best_cnt = 0, 0
-            for s in range(n_shards):
-                if cc[s] == 0:
-                    continue
-                if ac[s] > 0:
-                    v = bsi_ops._join_u64(av[0][s], av[1][s])
-                    cnt = int(av[2][s])
-                    v = -v if is_min else v
-                else:
-                    v = bsi_ops._join_u64(bv[0][s], bv[1][s])
-                    cnt = int(bv[2][s])
-                    v = v if is_min else -v
-                if best_cnt == 0 or (v < best_val if is_min
-                                     else v > best_val):
-                    best_val, best_cnt = v, cnt
-            return best_val, best_cnt
+            return _fold_min_max(cons_host, np.asarray(alt_cnt), a, b,
+                                 n_shards, is_min)
 
         return self.batcher.submit(cons_cnt, fold)
 
@@ -528,19 +732,24 @@ class MeshPlanner:
                     for (i, q, s), n in self._observed.items()]
 
     def close(self) -> None:
-        """Release caches and stop the batcher's resolver thread."""
+        """Release caches and stop the coalescer + batcher threads."""
+        self.coalescer.close()
         self.invalidate()
         self.batcher.close()
 
     def cache_stats(self) -> dict:
         """Locked snapshot of HBM-cache occupancy for monitoring."""
         with self._cache_lock:
-            return {"bytes": self._cache_bytes,
-                    "budget_bytes": self.max_cache_bytes,
-                    "entries": len(self._stack_cache),
-                    "evictions": self._cache_evictions,
-                    "bucket_policy": self.bucket_policy,
-                    "programs": len(self._fn_cache)}
+            out = {"bytes": self._cache_bytes,
+                   "budget_bytes": self.max_cache_bytes,
+                   "entries": len(self._stack_cache),
+                   "evictions": self._cache_evictions,
+                   "bucket_policy": self.bucket_policy,
+                   "programs": len(self._fn_cache)}
+        with self._dispatch_lock:
+            out["dispatches"] = self.dispatches
+            out["dispatches_coalesced"] = self.dispatches_coalesced
+        return out
 
     # ------------------------------------------------------------------
     # tree → structural signature + leaf list
@@ -597,6 +806,13 @@ class MeshPlanner:
                 raise QueryError(f"empty {name} query is currently not supported")
             kids = tuple(self._signature(idx, ch, leaves) for ch in c.children)
             return (name.lower(), kids)
+        if name == "__const__":
+            # Partial-fusion leaf: a host-computed Row injected as a
+            # device stack (Executor._fuse_partial). Plans with const
+            # leaves bypass the text-keyed plan cache (same str(c),
+            # different contents) but share the structural program cache.
+            leaves.append(("const", c.args["slot"]))
+            return ("leaf", len(leaves) - 1)
         raise QueryError(f"unsupported planner call: {name}")
 
     def _bsi_signature(self, idx: Index, c: Call, leaves: list[tuple]) -> tuple:
@@ -851,10 +1067,23 @@ class MeshPlanner:
     def _and_count(self, a, b):
         return _jit_and_count(a, b)
 
-    def _fetch_leaf(self, idx: Index, leaf: tuple, shards: tuple):
+    def _fetch_leaf(self, idx: Index, leaf: tuple, shards: tuple,
+                    const_rows: list | None = None):
         kind = leaf[0]
         if kind == "zero":
             return self._zeros_stack(len(shards))
+        if kind == "const":
+            # Host-computed Row (partial fusion) uploaded as a [S_pad, W]
+            # stack; not cached — contents vary per query even when the
+            # plan text doesn't.
+            row = const_rows[leaf[1]]
+            s_pad = self._pad(len(shards))
+            mat = np.zeros((s_pad, WORDS_PER_SHARD), dtype=np.uint32)
+            for i, shard in enumerate(shards):
+                seg = row.segments.get(shard)
+                if seg is not None:
+                    mat[i] = np.asarray(seg, dtype=np.uint32)
+            return jax.device_put(mat, shard_spec(self.mesh))
         if kind == "pred":
             lo, hi = bsi_ops.split_u64(leaf[1])
             return (np.uint32(lo), np.uint32(hi))
@@ -897,7 +1126,71 @@ class MeshPlanner:
                                      BSI_OFFSET_BIT + i, shards)
                     for i in range(depth)]
             return (exists, sign, bits)
+        if kind == "bsiagg":
+            # Fused-aggregate leaf: same exists/sign, but the magnitude
+            # planes come as ONE cached [depth, S_pad, W] cube so the
+            # fused program is exactly filter + reduce — stacking the
+            # planes (the most expensive prep step) happens once per
+            # (field, shards, epoch), not once per query.
+            _, field_name, depth = leaf
+            view = view_bsi_name(field_name)
+            from pilosa_tpu.core.fragment import (
+                BSI_EXISTS_BIT, BSI_SIGN_BIT,
+            )
+            exists = self._stack_rows(idx, field_name, view, BSI_EXISTS_BIT,
+                                      shards)
+            sign = self._stack_rows(idx, field_name, view, BSI_SIGN_BIT,
+                                    shards)
+            cube = self._stack_planes(idx, field_name, depth, shards)
+            return (exists, sign, cube)
         raise QueryError(f"unknown leaf kind {kind!r}")
+
+    def _stack_planes(self, idx: Index, field_name: str, depth: int,
+                      shards: tuple) -> jax.Array:
+        """[depth, S_pad, W] cube of a BSI field's magnitude planes,
+        stacked once and cached with the same two-tier (epoch, then
+        per-fragment generation) validation as _stack_rows."""
+        view = view_bsi_name(field_name)
+        key = (idx.name, idx.instance_id, field_name, view,
+               ("planes", depth), shards)
+        epoch = idx.epoch.value
+        with self._cache_lock:
+            hit = self._stack_cache.get(key)
+            if hit is not None:
+                if hit[0] == epoch:
+                    self._stack_cache.move_to_end(key)
+                    return hit[2]
+                gens = self._gens(idx.name, field_name, view, shards)
+                if gens == hit[1]:
+                    self._stack_cache[key] = (epoch, gens, hit[2])
+                    self._stack_cache.move_to_end(key)
+                    return hit[2]
+            else:
+                gens = None
+        if gens is None:
+            gens = self._gens(idx.name, field_name, view, shards)
+        from pilosa_tpu.core.fragment import BSI_OFFSET_BIT
+        bits = [self._stack_rows(idx, field_name, view, BSI_OFFSET_BIT + i,
+                                 shards)
+                for i in range(depth)]
+        if bits:
+            arr = jnp.stack(bits, axis=0)
+        else:
+            zero = self._fetch_leaf(idx, ("zero",), shards)
+            arr = jnp.zeros((0,) + zero.shape, zero.dtype)
+        nbytes = arr.nbytes
+        with self._cache_lock:
+            old = self._stack_cache.pop(key, None)
+            if old is not None:
+                self._cache_bytes -= old[2].nbytes
+            while (self._stack_cache
+                   and self._cache_bytes + nbytes > self.max_cache_bytes):
+                _, (_, _, dropped) = self._stack_cache.popitem(last=False)
+                self._cache_bytes -= dropped.nbytes
+                self._cache_evictions += 1
+            self._stack_cache[key] = (epoch, gens, arr)
+            self._cache_bytes += nbytes
+        return arr
 
     # ------------------------------------------------------------------
     # compile: signature → jitted evaluator
@@ -914,8 +1207,10 @@ class MeshPlanner:
         def evaluate(args):
             return _eval_node(sig, args)
 
+        is_pallas = False
         if reduce == "per_shard":
             program = self._pallas_count_program(sig)
+            is_pallas = program is not None
             if program is None:
                 def program(*args):
                     return bitops.count(evaluate(args))
@@ -925,11 +1220,21 @@ class MeshPlanner:
 
         fn = self._jit_program(program, reduce)
         self._fn_cache[full_sig] = fn
+        # Pallas kernels are not vmappable: register raw=None so the
+        # coalescer falls back to per-entry launches for them.
+        self._register_fn(fn, full_sig, None if is_pallas else program)
         return fn
 
     #: last measured bench A/B (BENCH_r05 ``pallas_vs_xla``): the Pallas
     #: pair-count delivered 0.415x the XLA-fused path, so "auto" mode
-    #: resolves to XLA until a bench run records a ratio > 1.
+    #: resolves to XLA until a bench run records a ratio > 1. Re-checked
+    #: after the dispatch-fusion PR: the Count pair-count XLA program is
+    #: byte-identical (fusion targeted BSI aggregates and mixed trees,
+    #: which Pallas never served), so the recorded ratio and the auto
+    #: decision stand; coalesced [B, ...] vmapped waves additionally
+    #: have no Pallas analog (pallas kernels register raw=None and fall
+    #: back to per-entry launches). bench.py's pallas_vs_xla A/B stays
+    #: live and re-measures per run on TPU rigs.
     PALLAS_VS_XLA_MEASURED = 0.415
 
     def _pallas_count_enabled(self) -> bool:
@@ -1029,6 +1334,8 @@ def _eval_node(sig: tuple, args) -> jax.Array:
 
     def _stacked(slot):
         exists, sign, bits = args[slot]
+        if not isinstance(bits, (list, tuple)):
+            return exists, sign, bits  # "bsiagg" leaf: pre-stacked cube
         stack = jnp.stack(bits, axis=0) if bits else \
             jnp.zeros((0,) + exists.shape, exists.dtype)
         return exists, sign, stack
@@ -1162,5 +1469,29 @@ def _agg_min_max(exists, sign, stack, filt, depth: int, is_min: bool):
     alt_cnt = bitops.count(alt)
     b = bsi_ops._min_unsigned(stack, consider, depth)
     return cons_cnt, alt_cnt, a, b
+
+
+def _fold_min_max(cc, ac, a, b, n_shards: int, is_min: bool):
+    """Host-side smaller/larger fold shared by the stepped and fused
+    Min/Max paths (fragment.go:1146/:1189 selection rule)."""
+    # lo/hi stay scalar when no magnitude bit reached their half
+    # (e.g. hi for depth<=32); broadcast to per-shard vectors.
+    av = tuple(np.broadcast_to(np.asarray(x), cc.shape) for x in a)
+    bv = tuple(np.broadcast_to(np.asarray(x), cc.shape) for x in b)
+    best_val, best_cnt = 0, 0
+    for s in range(n_shards):
+        if cc[s] == 0:
+            continue
+        if ac[s] > 0:
+            v = bsi_ops._join_u64(av[0][s], av[1][s])
+            cnt = int(av[2][s])
+            v = -v if is_min else v
+        else:
+            v = bsi_ops._join_u64(bv[0][s], bv[1][s])
+            cnt = int(bv[2][s])
+            v = v if is_min else -v
+        if best_cnt == 0 or (v < best_val if is_min else v > best_val):
+            best_val, best_cnt = v, cnt
+    return best_val, best_cnt
 
 

@@ -27,7 +27,8 @@ class SlowQueryLog:
         self._total = 0
 
     def observe(self, index: str, query: str, duration_ms: float,
-                qos_class: str = "", status: str = "ok") -> None:
+                qos_class: str = "", status: str = "ok",
+                fused_steps: int = 0) -> None:
         if duration_ms < self.threshold_ms:
             return
         entry = {
@@ -37,6 +38,10 @@ class SlowQueryLog:
             "durationMs": round(float(duration_ms), 3),
             "class": qos_class,
             "status": status,
+            # plan-tree steps that ran fused inside device programs —
+            # distinguishes a one-program query from a stepped one when
+            # triaging a slow entry (exec/fuse.py).
+            "fusedSteps": int(fused_steps),
         }
         with self._lock:
             self._ring.append(entry)

@@ -558,9 +558,11 @@ def _build_routes(api: API):
                 qos_deadline.reset_current_deadline(dtoken)
             slow_log = getattr(qos_ctl, "slow_log", None)
             if slow_log is not None and status not in ("shed", "quota"):
+                from pilosa_tpu.exec import fuse as _fuse
                 slow_log.observe(pv["index"], body.decode(errors="replace"),
                                  (time.perf_counter() - t0) * 1000.0,
-                                 qos_class=cls, status=status)
+                                 qos_class=cls, status=status,
+                                 fused_steps=_fuse.fused_steps())
         if isinstance(resp, bytes):
             return 200, resp, {"Content-Type": wire.FRAMES_CONTENT_TYPE}
         return 200, resp

@@ -87,7 +87,11 @@ class ServerNode:
                  multiplex: bool = True,
                  ingest_transpose: str = "auto",
                  wal_group_commit_ms: float = 0.0,
-                 ingest_max_inflight_mb: int = 0):
+                 ingest_max_inflight_mb: int = 0,
+                 dispatch_fuse: str = "auto",
+                 dispatch_coalesce: str = "auto",
+                 dispatch_coalesce_us: float = 150.0,
+                 inline_transfer: str = "auto"):
         host, _, port = bind.partition(":")
         self.host, self.port = host or "127.0.0.1", int(port or 10101)
         # Node identity IS the address — member ids are built the same
@@ -173,7 +177,9 @@ class ServerNode:
             try:
                 from pilosa_tpu.parallel import MeshPlanner
                 planner = MeshPlanner(self.holder,
-                                      bucket_policy=plan_buckets)
+                                      bucket_policy=plan_buckets,
+                                      stats=self.stats,
+                                      coalesce_window_us=dispatch_coalesce_us)
             except Exception:
                 planner = None
         # Plan-keyed result cache (pilosa_tpu.cache): byte-bounded,
@@ -298,6 +304,16 @@ class ServerNode:
         # (exec/ingest_transpose); PILOSA_TPU_INGEST_TRANSPOSE overrides.
         from pilosa_tpu.exec import ingest_transpose as _ingest_transpose
         _ingest_transpose.set_mode(ingest_transpose)
+        # Query-dispatch knobs (README "Query dispatch"): fused one-
+        # program-per-query plans, same-plan dispatch coalescing, and
+        # inline transfer resolution. Env vars PILOSA_TPU_DISPATCH_FUSE /
+        # _DISPATCH_COALESCE / _INLINE_TRANSFER override per-run.
+        from pilosa_tpu.exec import fuse as _dispatch_fuse
+        _dispatch_fuse.set_mode(dispatch_fuse)
+        from pilosa_tpu.parallel import coalesce as _dispatch_coalesce
+        _dispatch_coalesce.set_mode(dispatch_coalesce)
+        from pilosa_tpu.parallel import batcher as _transfer_batcher
+        _transfer_batcher.set_inline_mode(inline_transfer)
         # In-flight byte budget for the /internal/import-stream pipeline
         # (0 = unbounded); trips 429 + Retry-After, never queues.
         from pilosa_tpu.qos import IngestGate
