@@ -1536,6 +1536,89 @@ def bench_overload(extra):
 
 
 # ---------------------------------------------------------------------------
+# config 9: observability overhead — profiled vs unprofiled query storm
+# ---------------------------------------------------------------------------
+
+
+def bench_obs(extra):
+    """Observability overhead A/B (the profiling-cost acceptance): an
+    identical concurrent Count storm with per-query profiling ON (a
+    QueryProfile activated around every call, exactly what the served
+    ``?profile=true`` path does) vs OFF (every hook degenerates to one
+    None contextvar read). The storm p50 must not move more than 3%.
+
+    Methodology: the work unit is a device-bound TopN (per-query cost
+    ~1 ms of dispatch, not pure-Python parse), so the fixed per-query
+    bookkeeping cost is measured against a realistic denominator rather
+    than a degenerate micro-query where GIL queueing amplifies any µs
+    of extra service time into a p50 cliff. Rounds alternate OFF/ON so
+    machine drift lands on both modes equally, and each mode's p50 is
+    the min across rounds — the standard noise-robust estimator."""
+    from pilosa_tpu.config import SHARD_WIDTH
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.exec import Executor
+    from pilosa_tpu.obs import profile as obs_profile
+    from pilosa_tpu.parallel import MeshPlanner, make_mesh
+
+    rng = np.random.default_rng(29)
+    total = 8 * SHARD_WIDTH
+    h = Holder()
+    idx = h.create_index("ob")
+    f = idx.create_field("f")
+    f.import_bits(rng.integers(0, 64, 4_000_000),
+                  rng.integers(0, total, 4_000_000, dtype=np.uint64))
+    planner = MeshPlanner(h, make_mesh())
+    ex = Executor(h, planner=planner)
+    q = "TopN(f, n=8)"
+    ex.execute("ob", q, cache=False)  # compile + warm stacks
+
+    storm_threads = min(THREADS, 8)
+    storm_q = max(min(N_QUERIES, 192), 96)
+    lock = threading.Lock()
+
+    def storm(profiled):
+        lats: list[float] = []
+
+        def one(i):
+            tok = None
+            if profiled:
+                tok = obs_profile.activate(obs_profile.QueryProfile(
+                    f"bench-{i}", query=q, index="ob"))
+            t0 = time.perf_counter()
+            try:
+                ex.execute("ob", q, cache=False)
+            finally:
+                dt = time.perf_counter() - t0
+                if tok is not None:
+                    prof = obs_profile.current()
+                    obs_profile.deactivate(tok)
+                    prof.finish()
+            with lock:
+                lats.append(dt)
+
+        with ThreadPoolExecutor(max_workers=storm_threads) as pool:
+            list(pool.map(one, range(storm_q)))
+        return statistics.median(lats) * 1e3
+
+    storm(False)
+    storm(True)  # warm both code paths before measuring
+    off_rounds: list[float] = []
+    on_rounds: list[float] = []
+    for _ in range(4):
+        off_rounds.append(storm(False))
+        on_rounds.append(storm(True))
+    on50 = min(on_rounds)
+    off50 = min(off_rounds)
+    overhead = (on50 - off50) / off50
+    extra["obs_storm_p50_ms_profile_on"] = round(on50, 3)
+    extra["obs_storm_p50_ms_profile_off"] = round(off50, 3)
+    extra["obs_profile_overhead_pct"] = round(overhead * 100, 2)
+    planner.close()
+    assert overhead <= 0.03, \
+        f"profiling overhead {overhead * 100:.2f}% > 3%"
+
+
+# ---------------------------------------------------------------------------
 
 
 def main() -> None:
@@ -1544,7 +1627,8 @@ def main() -> None:
     want = (set(c.strip() for c in CONFIGS.split(","))
             if CONFIGS != "all"
             else {"star", "topn", "bsi", "dispatch", "ingest", "time",
-                  "cluster", "cache", "oversub", "backup", "overload"})
+                  "cluster", "cache", "oversub", "backup", "overload",
+                  "obs"})
     extra: dict = {"backend": jax.default_backend(),
                    "devices": len(jax.devices())}
 
@@ -1582,7 +1666,8 @@ def main() -> None:
                      ("cache", bench_cache),
                      ("oversub", bench_oversubscribed),
                      ("backup", bench_backup),
-                     ("overload", bench_overload)):
+                     ("overload", bench_overload),
+                     ("obs", bench_obs)):
         if name in want:
             t0 = time.perf_counter()
             try:

@@ -137,6 +137,11 @@ _DEFAULTS = {
     "dispatch_coalesce": "auto",
     "dispatch_coalesce_us": 150.0,
     "inline_transfer": "auto",
+    # Per-query cost profiles: retain the slowest N at /debug/queries
+    # (0 disables the ring). profile_queries=False limits profiling to
+    # explicit ?profile=true requests.
+    "profile_ring_n": 64,
+    "profile_queries": True,
 }
 
 
@@ -246,6 +251,10 @@ def cmd_server(args) -> int:
         cfg["dispatch_coalesce_us"] = args.dispatch_coalesce_us
     if args.inline_transfer is not None:
         cfg["inline_transfer"] = args.inline_transfer
+    if args.profile_ring is not None:
+        cfg["profile_ring_n"] = args.profile_ring
+    if args.profile_queries is not None:
+        cfg["profile_queries"] = args.profile_queries
 
     from pilosa_tpu.server.node import ServerNode
     node = ServerNode(
@@ -298,6 +307,9 @@ def cmd_server(args) -> int:
         dispatch_coalesce=str(cfg["dispatch_coalesce"]) or "auto",
         dispatch_coalesce_us=float(cfg["dispatch_coalesce_us"]),
         inline_transfer=str(cfg["inline_transfer"]) or "auto",
+        profile_ring_n=int(cfg["profile_ring_n"]),
+        profile_queries=(str(cfg["profile_queries"]).lower()
+                         in ("1", "true", "yes", "on")),
     )
     node.open()  # starts the (single) serve loop in the background
     print(f"pilosa-tpu serving at {node.address}", file=sys.stderr)
@@ -734,7 +746,12 @@ def cmd_generate_config(args) -> int:
           'dispatch-fuse = "auto"\n'
           'dispatch-coalesce = "auto"\n'
           'dispatch-coalesce-us = 150.0\n'
-          'inline-transfer = "auto"')
+          'inline-transfer = "auto"\n'
+          '# per-query cost profiles: slowest-N retention ring served\n'
+          '# at /debug/queries (0 disables); profile-queries = false\n'
+          '# limits profiling to explicit ?profile=true requests\n'
+          'profile-ring-n = 64\n'
+          'profile-queries = true')
     return 0
 
 
@@ -851,6 +868,14 @@ def main(argv: list[str] | None = None) -> int:
                    help="resolve a device->host wave on its waiter's "
                         "thread when it is the only waiter (default "
                         "auto)")
+    s.add_argument("--profile-ring", type=int, default=None,
+                   help="retain the slowest N query cost profiles at "
+                        "/debug/queries (default 64; 0 disables)")
+    s.add_argument("--profile-queries", choices=("true", "false"),
+                   default=None,
+                   help="profile every query into the retention ring "
+                        "(default true; false limits profiling to "
+                        "?profile=true requests)")
     s.add_argument("--config", default=None)
     s.set_defaults(fn=cmd_server)
 

@@ -27,6 +27,7 @@ from pilosa_tpu.cluster.node import Node
 from pilosa_tpu.cluster.placement import jump_hash, partition
 from pilosa_tpu.cluster.scrub import DirtyShards
 from pilosa_tpu.errors import PilosaError
+from pilosa_tpu.obs import profile as _profile
 from pilosa_tpu.obs.stats import NopStats
 from pilosa_tpu.storage.quarantine import ShardCorruptError
 
@@ -413,12 +414,14 @@ class Cluster:
                 return
             result = acc if result is None else reduce_fn(result, acc)
         # The fan-out pool's threads don't inherit contextvars; carry
-        # the active trace id AND deadline into them so remote
-        # sub-queries join the trace and stay cancellable.
+        # the active trace id, deadline AND query profile into them so
+        # remote sub-queries join the trace, stay cancellable, and
+        # charge their legs to the right ledger.
         from pilosa_tpu.obs import tracing
         from pilosa_tpu.qos import deadline as qos_deadline
         tid = tracing.current_trace_id()
         dl = qos_deadline.current_deadline()
+        prof = _profile.current()
 
         def _with_trace(fn):
             tokens = []
@@ -428,6 +431,9 @@ class Cluster:
             if dl is not None:
                 tokens.append((qos_deadline.reset_current_deadline,
                                qos_deadline.set_current_deadline(dl)))
+            if prof is not None:
+                tokens.append((_profile.deactivate,
+                               _profile.activate(prof)))
             try:
                 return fn()
             finally:
@@ -444,7 +450,15 @@ class Cluster:
                 return acc
             return _with_trace(go)
 
-        def run_remote(node_id: str, node_shards: list[int]):
+        def _leg_wire() -> dict:
+            """This thread's last wire accounting (the HTTP transport
+            sets it just before returning; empty for other clients)."""
+            nbytes = getattr(self.client, "leg_wire_bytes", None)
+            b = nbytes() if nbytes is not None else None
+            return b or {}
+
+        def run_remote(node_id: str, node_shards: list[int],
+                       hedged: bool = False):
             node = self.node_by_id(node_id)
             t0 = time.perf_counter()
 
@@ -467,15 +481,42 @@ class Cluster:
                         self.epoch_sink(idx.name, node_id, epochs)
                     # HTTP transports expose the leg's wire payload sizes
                     # (thread-local, set just before returning).
-                    nbytes = getattr(self.client, "leg_wire_bytes", None)
-                    if nbytes is not None:
-                        b = nbytes()
-                        if b:
-                            span.set_tag("bytesOut", b.get("out", 0))
-                            span.set_tag("bytesIn", b.get("in", 0))
+                    b = _leg_wire()
+                    if b:
+                        span.set_tag("bytesOut", b.get("out", 0))
+                        span.set_tag("bytesIn", b.get("in", 0))
                     return results[0]
 
-            res = _with_trace(go)
+            try:
+                res = _with_trace(go)
+            except Exception as e:
+                if prof is not None:
+                    # Error legs are part of the timeline too (their
+                    # bytes are unknowable: the transport may not have
+                    # reached the stash point, and a stale value from
+                    # this pool thread's PREVIOUS leg must not leak in).
+                    prof.add_remote_leg(
+                        node=node_id, shards=len(node_shards),
+                        bytes_out=0, bytes_in=0, decode_ms=0.0,
+                        rtt_ms=(time.perf_counter() - t0) * 1e3,
+                        hedged=hedged, error=type(e).__name__)
+                raise
+            if prof is not None:
+                # Same thread that ran the request: the thread-local
+                # wire stash is THIS leg's. Exactly-once: recorded here
+                # and nowhere else (hedge backups record as their own
+                # hedged=True leg).
+                b = _leg_wire()
+                rprof = None
+                rp = getattr(self.client, "leg_remote_profile", None)
+                if rp is not None:
+                    rprof = rp()
+                prof.add_remote_leg(
+                    node=node_id, shards=len(node_shards),
+                    bytes_out=b.get("out", 0), bytes_in=b.get("in", 0),
+                    decode_ms=b.get("decodeMs", 0.0),
+                    rtt_ms=(time.perf_counter() - t0) * 1e3,
+                    hedged=hedged, remote=rprof)
             if self.hedge is not None:
                 # Successful remote legs feed the p95 the hedge delay
                 # derives from.
@@ -502,9 +543,11 @@ class Cluster:
                 except FuturesTimeoutError:
                     pass  # primary is in the tail: consider hedging
                 if hedge.try_fire():
+                    if prof is not None:
+                        prof.bump("hedgeFired")
                     backup = hpool.submit(
                         run_local if backup_id == self.local_id
-                        else lambda s: run_remote(backup_id, s),
+                        else lambda s: run_remote(backup_id, s, True),
                         node_shards)
                     legs = {primary, backup}
                     while legs:
@@ -514,6 +557,8 @@ class Cluster:
                             if fut.exception() is None:
                                 if fut is backup:
                                     hedge.record_win()
+                                    if prof is not None:
+                                        prof.bump("hedgeWins")
                                 return fut.result()
                     # Both legs failed; surface the PRIMARY's error so
                     # the failover wave remaps off the primary node.
@@ -542,6 +587,8 @@ class Cluster:
                     # dead node: drop it, remap its shards to replicas.
                     nodes = [n for n in nodes if n.id != node_id]
                     failed.extend(node_shards)
+                    if prof is not None:
+                        prof.bump("failovers")
             else:
                 # Remote hops dispatch as futures on the SHARED pool and
                 # the LOCAL batch runs on this thread concurrently with
@@ -595,6 +642,8 @@ class Cluster:
                             # onto replicas (executor.go:2492-2503).
                             nodes = [n for n in nodes if n.id != node_id]
                             failed.extend(node_shards)
+                            if prof is not None:
+                                prof.bump("failovers")
                             continue
                         fold(acc)
             pending = failed

@@ -21,6 +21,7 @@ TPU-first departures (same semantics, different math):
 from __future__ import annotations
 
 import threading
+import time
 from collections import OrderedDict
 from concurrent.futures import Future
 from dataclasses import dataclass, field as dc_field, replace
@@ -43,6 +44,7 @@ from pilosa_tpu.errors import (
     QueryError,
 )
 from pilosa_tpu.exec import fuse as _fuse
+from pilosa_tpu.obs import profile as _profile
 from pilosa_tpu.exec.result import (
     FieldRow,
     GroupCount,
@@ -164,8 +166,14 @@ class Executor:
         it) for this call — used by benchmarks to measure the cold path.
         """
         raw = query if isinstance(query, str) else None
+        prof = _profile.current()
         if raw is not None:
-            query = self._parse_cached(raw)
+            if prof is not None:
+                t0 = time.perf_counter()
+                query = self._parse_cached(raw)
+                prof.add_ms("parseMs", (time.perf_counter() - t0) * 1e3)
+            else:
+                query = self._parse_cached(raw)
         opt = opt or ExecOptions()
         if not opt.remote:
             _fuse.reset_fused_steps()
@@ -199,9 +207,15 @@ class Executor:
             # fresh; may conservatively recompute).
             sch = idx.schema_epoch.value
             loc = idx.epoch.max_shard_epoch(shards)
+            if prof is not None:
+                t0 = time.perf_counter()
             hit = self.result_cache.get(
                 tenant, key,
                 (sch, loc, self.remote_epochs.rows_for(idx.name, shards)))
+            if prof is not None:
+                prof.add_ms("cacheLookupMs",
+                            (time.perf_counter() - t0) * 1e3)
+                prof.cache_hit = hit is not None
             if hit is not None:
                 return hit
 

@@ -7,8 +7,14 @@ monitor in server.go:813-855. Diagnostics phone-home (diagnostics.go) is
 intentionally NOT implemented (always off).
 """
 
+from pilosa_tpu.obs.histogram import (
+    SECONDS_BOUNDS,
+    WIDTH_BOUNDS,
+    LogHistogram,
+)
 from pilosa_tpu.obs.logger import Logger, NopLogger, StandardLogger
 from pilosa_tpu.obs.otlp import OTLPTracer
+from pilosa_tpu.obs.profile import ProfileRing, QueryProfile
 from pilosa_tpu.obs.profiler import sample_profile
 from pilosa_tpu.obs.runtime import RuntimeMonitor, collect_runtime_gauges
 from pilosa_tpu.obs.stats import (
@@ -25,16 +31,19 @@ from pilosa_tpu.obs.tracing import (
     Tracer,
     current_trace_id,
     get_tracer,
+    new_trace_id,
     set_tracer,
     start_span,
 )
 
 __all__ = [
     "Logger", "NopLogger", "StandardLogger",
+    "LogHistogram", "SECONDS_BOUNDS", "WIDTH_BOUNDS",
     "MemoryStats", "NopStats", "StatsClient", "StatsdStats",
+    "ProfileRing", "QueryProfile",
     "prometheus_text",
     "RuntimeMonitor", "collect_runtime_gauges",
     "NopTracer", "OTLPTracer", "SimpleTracer", "Span", "Tracer",
-    "current_trace_id", "get_tracer", "sample_profile", "set_tracer",
-    "start_span",
+    "current_trace_id", "get_tracer", "new_trace_id", "sample_profile",
+    "set_tracer", "start_span",
 ]

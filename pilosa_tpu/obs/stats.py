@@ -12,6 +12,9 @@ from __future__ import annotations
 import threading
 from typing import Protocol
 
+from pilosa_tpu.obs.histogram import LogHistogram
+from pilosa_tpu.obs.tracing import current_trace_id
+
 
 class StatsClient(Protocol):
     def with_tags(self, *tags: str) -> "StatsClient": ...
@@ -45,7 +48,10 @@ class MemoryStats:
             self._lock = threading.Lock()
             self.counters: dict[tuple[str, tuple], float] = {}
             self.gauges: dict[tuple[str, tuple], float] = {}
-            self.timings: dict[tuple[str, tuple], list[float]] = {}
+            # Bounded log-bucket histograms, NOT lists: a sustained-
+            # traffic node used to grow one float per observation per
+            # series forever (ISSUE 11 leak). Each value is O(buckets).
+            self.timings: dict[tuple[str, tuple], LogHistogram] = {}
         else:
             self._lock = _parent._lock
             self.counters = _parent.counters
@@ -67,11 +73,31 @@ class MemoryStats:
             self.gauges[(name, self.tags)] = value
 
     def timing(self, name: str, seconds: float) -> None:
+        # Exemplar = the active trace id, read OUTSIDE the lock (one
+        # contextvar get; None when untraced).
+        tid = current_trace_id()
         with self._lock:
-            self.timings.setdefault((name, self.tags), []).append(seconds)
+            key = (name, self.tags)
+            h = self.timings.get(key)
+            if h is None:
+                # The registry lock already serializes observes.
+                h = self.timings[key] = LogHistogram(lock=False)
+            h.observe(seconds, trace_id=tid)
 
     def counter_value(self, name: str, *tags: str) -> float:
         return self.counters.get((name, tuple(sorted(tags))), 0)
+
+    def timing_count(self, name: str, *tags: str) -> int:
+        h = self.timings.get((name, tuple(sorted(tags))))
+        return 0 if h is None else h.count
+
+    def timing_sum(self, name: str, *tags: str) -> float:
+        h = self.timings.get((name, tuple(sorted(tags))))
+        return 0.0 if h is None else h.sum
+
+    def timing_quantile(self, name: str, q: float, *tags: str) -> float:
+        h = self.timings.get((name, tuple(sorted(tags))))
+        return 0.0 if h is None else h.quantile(q)
 
 
 class StatsdStats:
@@ -116,13 +142,17 @@ class StatsdStats:
         self._send(f"{self.prefix}{name}:{seconds * 1e3:.3f}|ms")
 
 
-def _fmt_labels(tags: tuple[str, ...]) -> str:
-    if not tags:
-        return ""
+def _fmt_labels(tags: tuple[str, ...], extra: str = "") -> str:
+    """Render ``{k="v",...}``; ``extra`` is a pre-formatted pair (the
+    histogram ``le=...`` label) merged after the tag labels."""
     pairs = []
     for t in tags:
         k, _, v = t.partition(":")
         pairs.append(f'{_sanitize(k)}="{v or "true"}"')
+    if extra:
+        pairs.append(extra)
+    if not pairs:
+        return ""
     return "{" + ",".join(pairs) + "}"
 
 
@@ -141,9 +171,29 @@ def prometheus_text(stats: MemoryStats) -> str:
         for (name, tags), v in sorted(stats.gauges.items()):
             lines.append(f"# TYPE pilosa_{_sanitize(name)} gauge")
             lines.append(f"pilosa_{_sanitize(name)}{_fmt_labels(tags)} {v}")
-        for (name, tags), vals in sorted(stats.timings.items()):
+        for (name, tags), h in sorted(stats.timings.items()):
             n = _sanitize(name)
-            lines.append(f"# TYPE pilosa_{n}_seconds summary")
-            lines.append(f"pilosa_{n}_seconds_count{_fmt_labels(tags)} {len(vals)}")
-            lines.append(f"pilosa_{n}_seconds_sum{_fmt_labels(tags)} {sum(vals)}")
+            # Timing keys like "qos.waitSeconds" already name the unit;
+            # don't render pilosa_qos_waitSeconds_seconds.
+            if n.lower().endswith("seconds"):
+                n = n[:-len("seconds")].rstrip("_")
+            lines.append(f"# TYPE pilosa_{n}_seconds histogram")
+            p99 = h.p99_bucket_index()
+            for i, (le, cum) in enumerate(h.bucket_items()):
+                le_label = f'le="{le}"'
+                line = (f"pilosa_{n}_seconds_bucket"
+                        f"{_fmt_labels(tags, le_label)} {cum}")
+                # OpenMetrics exemplar on p99-and-above buckets only:
+                # the slow tail links to a retained /debug/queries
+                # profile; fast buckets stay exemplar-free (payload
+                # size, and nobody clicks into a p50 bucket).
+                ex = h.exemplar(i) if i >= p99 else None
+                if ex is not None:
+                    val, tid = ex
+                    line += f' # {{trace_id="{tid}"}} {val:g}'
+                lines.append(line)
+            lines.append(f"pilosa_{n}_seconds_count{_fmt_labels(tags)} "
+                         f"{h.count}")
+            lines.append(f"pilosa_{n}_seconds_sum{_fmt_labels(tags)} "
+                         f"{h.sum}")
     return "\n".join(lines) + "\n"

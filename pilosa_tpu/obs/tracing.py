@@ -32,6 +32,11 @@ def current_trace_id() -> str | None:
     return _current_trace.get()
 
 
+def new_trace_id() -> str:
+    """Mint a fresh trace id (same scheme spans use: pid-hex + seq)."""
+    return f"{_trace_prefix}-{next(_trace_seq)}"
+
+
 def set_current_trace(trace_id: str | None):
     """Returns a token for contextvars reset."""
     return _current_trace.set(trace_id)

@@ -35,6 +35,9 @@ from typing import Any, Callable
 
 import numpy as np
 
+from pilosa_tpu.obs import profile as _profile
+from pilosa_tpu.obs.histogram import WIDTH_BOUNDS, LogHistogram
+
 _INLINE_MODES = ("on", "off", "auto")
 _default_inline = "auto"
 
@@ -83,12 +86,21 @@ class TransferBatcher:
         self._closed = False
         #: waves resolved on the waiter's thread (the knob's observable)
         self.inline_resolved = 0
+        #: lifetime wave-width distribution (queue length at each
+        #: submit), rendered by /debug/device; observed under _cv.
+        self._wave_hist = LogHistogram(bounds=WIDTH_BOUNDS, lock=False)
 
     # -- public --------------------------------------------------------
 
-    def submit(self, arr, postproc: Callable[[np.ndarray], Any]) -> "Future[Any]":
+    def submit(self, arr, postproc: Callable[[np.ndarray], Any],
+               profs=None) -> "Future[Any]":
         """Start ``arr``'s async copy and return a future resolving to
-        ``postproc(host_array)``."""
+        ``postproc(host_array)``.
+
+        ``profs``: QueryProfiles to charge this wave to — passed by the
+        coalescer (whose flusher thread has no query context); when
+        omitted, the submitting thread's active profile is charged.
+        """
         fut: Future = _StealFuture()
         fut._batcher = self
         try:
@@ -101,12 +113,21 @@ class TransferBatcher:
                 closed = True
             else:
                 self._queue.append((arr, fut, postproc))
+                width = len(self._queue)
+                self._wave_hist.observe(width)
                 if self._thread is None:
                     self._thread = threading.Thread(
                         target=self._run, name="transfer-batcher",
                         daemon=True)
                     self._thread.start()
                 self._cv.notify()
+        if not closed:
+            if profs is None:
+                p = _profile.current()
+                profs = (p,) if p is not None else ()
+            for p in profs:
+                if p is not None:
+                    p.add_wave(width)
         if closed:
             # Shutdown grace OUTSIDE the lock (the pull can take a full
             # link round-trip): a query racing node close resolves
@@ -117,6 +138,18 @@ class TransferBatcher:
             except Exception as e:
                 fut.set_exception(e)
         return fut
+
+    def queue_depth(self) -> int:
+        """Transfers awaiting resolution right now."""
+        with self._lock:
+            return len(self._queue)
+
+    def debug(self) -> dict:
+        """The /debug/device payload's transfer half."""
+        with self._lock:
+            return {"queue_depth": len(self._queue),
+                    "inline_resolved": self.inline_resolved,
+                    "wave_width_hist": self._wave_hist.snapshot()}
 
     def close(self, timeout: float | None = 30.0) -> None:
         """Drain-and-join: mark closed, wake the resolver, and wait for
@@ -155,6 +188,9 @@ class TransferBatcher:
                 self.inline_resolved += 1
         if entry is None:
             return
+        p = _profile.current()   # the stealer IS the query thread
+        if p is not None:
+            p.add_inline_steal()
         arr, _, post = entry
         try:
             result = post(np.asarray(arr))

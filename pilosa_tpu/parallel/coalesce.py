@@ -48,7 +48,15 @@ from typing import Any, Callable
 
 import numpy as np
 
+from pilosa_tpu.obs import profile as _profile
+
 _MODES = ("on", "off", "auto")
+
+#: sentinel: "read the caller's contextvar" — distinct from None, which
+#: means "profiling is off for this entry" (the flusher thread passes
+#: the profile captured at dispatch() time; its own contextvar is
+#: always empty and must not be consulted).
+_CTX = object()
 _default_mode = "auto"
 
 DEFAULT_WINDOW_US = 150.0
@@ -88,8 +96,9 @@ class _Batch:
         self.key = key
         self.fn = fn
         self.deadline = deadline
-        #: list of (args, post, fut)
-        self.entries: list[tuple[tuple, Callable, Future]] = []
+        #: list of (args, post, fut, profile-or-None) — the profile is
+        #: captured on the DISPATCHING thread; the flusher has none.
+        self.entries: list[tuple[tuple, Callable, Future, Any]] = []
 
 
 class DispatchCoalescer:
@@ -135,12 +144,15 @@ class DispatchCoalescer:
         key = planner.fn_key(fn) if m != "off" else None
         if key is None or not getattr(planner, "coalesce_supported", False):
             return self._launch_one(None, fn, args, post)
+        # Captured HERE, not in the flusher: batches launch on the
+        # coalescer thread, where the query's contextvars are absent.
+        prof = _profile.current()
         with self._cv:
             if not self._closed:
                 batch = self._open.get(key)
                 if batch is not None:
                     fut: Future = Future()
-                    batch.entries.append((tuple(args), post, fut))
+                    batch.entries.append((tuple(args), post, fut, prof))
                     if len(batch.entries) >= MAX_BATCH:
                         # Seal: the batch stays pending until flushed,
                         # but the next arrival opens a fresh one.
@@ -151,7 +163,7 @@ class DispatchCoalescer:
                     batch = _Batch(key, fn,
                                    time.monotonic() + self.window_us * 1e-6)
                     fut = Future()
-                    batch.entries.append((tuple(args), post, fut))
+                    batch.entries.append((tuple(args), post, fut, prof))
                     self._pending[self._seq] = batch
                     self._seq += 1
                     self._open[key] = batch
@@ -165,6 +177,12 @@ class DispatchCoalescer:
         # "auto" with nothing in flight (or closed): launch now — the
         # serial path must not pay the window.
         return self._launch_one(key, fn, args, post)
+
+    def queue_depth(self) -> int:
+        """Entries sitting in unflushed batches right now (the
+        /debug/device dispatch-queue gauge)."""
+        with self._lock:
+            return sum(len(b.entries) for b in self._pending.values())
 
     def hold(self) -> None:
         """Test hook: freeze flushing so a batch can be assembled
@@ -240,19 +258,30 @@ class DispatchCoalescer:
             else:
                 self._inflight.pop(key, None)
 
-    def _launch_one(self, key, fn, args, post: Callable) -> Future:
+    def _launch_one(self, key, fn, args, post: Callable,
+                    prof=_CTX) -> Future:
         """Unbatched launch: the zero-overhead serial path. Returns the
         TransferBatcher future directly — no second future/callback."""
         import jax
 
         planner = self.planner
+        if prof is _CTX:
+            prof = _profile.current()
         try:
-            out = fn(*args)
+            if prof is not None:
+                t0 = time.perf_counter()
+                out = fn(*args)
+                dev_ms = (time.perf_counter() - t0) * 1e3
+            else:
+                out = fn(*args)
         except Exception as e:
             fut: Future = Future()
             fut.set_exception(e)
             return fut
-        planner._record_dispatch(1)
+        if prof is not None:
+            planner._record_dispatch(1, dev_ms, profs=(prof,))
+        else:
+            planner._record_dispatch(1, profs=())
         self._note_inflight(key, +1)
         leaves, treedef = jax.tree_util.tree_flatten(out)
         _copy_async(leaves)
@@ -269,13 +298,14 @@ class DispatchCoalescer:
     def _flush(self, batch: _Batch) -> None:
         entries = batch.entries
         if len(entries) == 1:
-            args, post, fut = entries[0]
-            _chain(self._launch_one(batch.key, batch.fn, args, post), fut)
+            args, post, fut, prof = entries[0]
+            _chain(self._launch_one(batch.key, batch.fn, args, post,
+                                    prof=prof), fut)
             return
         try:
             self._flush_batched(batch)
         except Exception as e:
-            for _, _, fut in entries:
+            for _, _, fut, _ in entries:
                 if not fut.done():
                     fut.set_exception(e)
 
@@ -286,6 +316,9 @@ class DispatchCoalescer:
         entries = batch.entries
         b = len(entries)
         args0 = entries[0][0]
+        profs = [e[3] for e in entries]
+        any_prof = any(p is not None for p in profs)
+        t0 = time.perf_counter() if any_prof else 0.0
         shared = all(_args_identical(e[0], args0) for e in entries[1:])
         if shared:
             # N callers, same plan, same leaf arrays (the cached-stack
@@ -299,9 +332,9 @@ class DispatchCoalescer:
                 # No vmappable program (e.g. a Pallas kernel): launch
                 # per entry — still one trip through this thread, and
                 # the accounting stays honest (B launches recorded).
-                for args, post, fut in entries:
+                for args, post, fut, prof in entries:
                     _chain(self._launch_one(batch.key, batch.fn, args,
-                                            post), fut)
+                                            post, prof=prof), fut)
                 return
             # Same plan shape, different literals/leaves: stack each
             # argument leaf to [B, ...] (padded to a pow2 bucket by
@@ -314,7 +347,8 @@ class DispatchCoalescer:
                 lambda *xs: jnp.stack(xs), *rows)
             out = planner.vmapped(batch.key, raw)(*stacked)
             slot = True
-        planner._record_dispatch(b)
+        dev_ms = (time.perf_counter() - t0) * 1e3 if any_prof else 0.0
+        planner._record_dispatch(b, dev_ms, profs=profs)
         self._note_inflight(batch.key, +1)
         leaves, treedef = jax.tree_util.tree_flatten(out)
         _copy_async(leaves)
@@ -323,7 +357,7 @@ class DispatchCoalescer:
             try:
                 flat = [host_anchor] + [np.asarray(a) for a in _l[1:]]
                 host = jax.tree_util.tree_unflatten(_t, flat)
-                for i, (_, post, fut) in enumerate(entries):
+                for i, (_, post, fut, _prof) in enumerate(entries):
                     if fut.done():
                         continue
                     try:

@@ -41,6 +41,8 @@ from pilosa_tpu.errors import (
     QueryError,
 )
 from pilosa_tpu.exec import fuse as _fuse
+from pilosa_tpu.obs import profile as _profile
+from pilosa_tpu.obs.histogram import WIDTH_BOUNDS, LogHistogram
 from pilosa_tpu.ops import bitops, bsi as bsi_ops
 from pilosa_tpu.parallel.batcher import TransferBatcher
 from pilosa_tpu.parallel.coalesce import DispatchCoalescer
@@ -88,6 +90,12 @@ class MeshPlanner:
         #: runtime monitor / /debug/heap — churn in the oversubscribed
         #: regime is invisible without it.
         self._cache_evictions = 0
+        #: lifetime host->device stack builds and their bytes: with the
+        #: eviction counter these are THE oversubscription signal — a
+        #: working set over budget shows as uploads tracking queries
+        #: instead of flatlining after warmup (/debug/device).
+        self._uploads = 0
+        self._upload_bytes = 0
         self.max_cache_bytes = max_cache_bytes
         #: guards _stack_cache/_cache_bytes — one planner serves every
         #: thread of the HTTP server.
@@ -142,6 +150,9 @@ class MeshPlanner:
         self.dispatches = 0
         self.dispatches_coalesced = 0
         self._batch_widths: "deque[int]" = deque(maxlen=512)
+        #: bounded width histogram over the node's lifetime (the deque
+        #: above is a recency window); /debug/device renders it.
+        self._width_hist = LogHistogram(bounds=WIDTH_BOUNDS, lock=False)
         #: same-plan dispatch coalescing (parallel.coalesce): every
         #: Count / fused-aggregate launch goes through it.
         self.coalescer = DispatchCoalescer(self, coalesce_window_us)
@@ -265,18 +276,35 @@ class MeshPlanner:
 
     # -- launch accounting / program registry --------------------------
 
-    def _record_dispatch(self, width: int = 1) -> None:
-        """One device-program launch answering ``width`` queries."""
+    def _record_dispatch(self, width: int = 1, device_ms: float = 0.0,
+                         profs=None) -> None:
+        """One device-program launch answering ``width`` queries.
+
+        ``profs``: the QueryProfiles of the queries this launch served.
+        The coalescer passes them explicitly — its flusher thread has no
+        query context, so the profiles were captured at dispatch() time.
+        Planner-internal call sites omit it and the active profile (if
+        any) is charged.
+        """
         with self._dispatch_lock:
             self.dispatches += 1
             if width > 1:
                 self.dispatches_coalesced += width - 1
             self._batch_widths.append(width)
+            self._width_hist.observe(width)
         if self.stats is not None:
             self.stats.count("planner.dispatchCount", 1)
             if width > 1:
                 self.stats.count("planner.dispatchCoalesced", width - 1)
             self.stats.gauge("planner.coalesceBatchWidth", width)
+        if profs is None:
+            p = _profile.current()
+            if p is not None:
+                p.add_dispatch(width, device_ms)
+            return
+        for p in profs:
+            if p is not None:
+                p.add_dispatch(width, device_ms)
 
     def batch_widths(self) -> list[int]:
         """Recent per-launch batch widths (bench's coalesce p50)."""
@@ -744,11 +772,24 @@ class MeshPlanner:
                    "budget_bytes": self.max_cache_bytes,
                    "entries": len(self._stack_cache),
                    "evictions": self._cache_evictions,
+                   "uploads": self._uploads,
+                   "upload_bytes": self._upload_bytes,
                    "bucket_policy": self.bucket_policy,
                    "programs": len(self._fn_cache)}
         with self._dispatch_lock:
             out["dispatches"] = self.dispatches
             out["dispatches_coalesced"] = self.dispatches_coalesced
+        return out
+
+    def device_debug(self) -> dict:
+        """The /debug/device payload's planner half: residency, churn,
+        compiled-program population, and the lifetime coalesce
+        batch-width histogram."""
+        out = self.cache_stats()
+        with self._dispatch_lock:
+            out["batch_width_hist"] = self._width_hist.snapshot()
+        out["queue_depth"] = self.coalescer.queue_depth()
+        out["transfer"] = self.batcher.debug()
         return out
 
     # ------------------------------------------------------------------
@@ -940,6 +981,8 @@ class MeshPlanner:
             gens = self._gens(idx.name, field_name, view, shards)
         arr, nbytes = self._build_stack(idx, field_name, view, row_id, shards)
         with self._cache_lock:
+            self._uploads += 1
+            self._upload_bytes += nbytes
             old = self._stack_cache.pop(key, None)
             if old is not None:
                 self._cache_bytes -= old[2].nbytes

@@ -27,6 +27,8 @@ import threading
 import time
 from collections import deque
 
+from pilosa_tpu.obs import profile as _profile
+
 from .deadline import Deadline, DeadlineExceededError, current_deadline
 
 CLASS_INTERACTIVE = "interactive"
@@ -293,6 +295,9 @@ class AdmissionController:
         t0 = time.perf_counter()
         self.acquire(cls, deadline)
         t1 = time.perf_counter()
+        prof = _profile.current()
+        if prof is not None:
+            prof.add_ms("admissionWaitMs", (t1 - t0) * 1000.0)
         try:
             yield
         finally:

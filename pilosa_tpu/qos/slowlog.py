@@ -28,7 +28,7 @@ class SlowQueryLog:
 
     def observe(self, index: str, query: str, duration_ms: float,
                 qos_class: str = "", status: str = "ok",
-                fused_steps: int = 0) -> None:
+                fused_steps: int = 0, trace_id: str = "") -> None:
         if duration_ms < self.threshold_ms:
             return
         entry = {
@@ -43,6 +43,12 @@ class SlowQueryLog:
             # triaging a slow entry (exec/fuse.py).
             "fusedSteps": int(fused_steps),
         }
+        if trace_id:
+            # A slow entry links to its retained cost breakdown: the
+            # profile ring keeps the slowest N, and slow-log qualifiers
+            # are exactly the queries it retains.
+            entry["traceId"] = trace_id
+            entry["profile"] = f"/debug/queries/{trace_id}"
         with self._lock:
             self._ring.append(entry)
             self._total += 1

@@ -33,6 +33,7 @@ from pilosa_tpu.errors import (
 )
 from pilosa_tpu.exec.executor import ExecOptions, Executor
 from pilosa_tpu.exec.result import result_to_json
+from pilosa_tpu.obs import profile as _profile
 from pilosa_tpu.pql import parse
 
 
@@ -59,6 +60,9 @@ class API:
         #: ServerNode; None = no admission gate, no default deadline,
         #: no slow-query log — the pre-QoS behavior.
         self.qos = None
+        #: slowest-N retained query profiles (obs.profile.ProfileRing),
+        #: set by ServerNode; served at /debug/queries.
+        self.profile_ring = None
 
     #: method-availability matrix per cluster state (reference
     #: api.go:99-105 validAPIMethods + :1379-1411 method sets): during
@@ -125,6 +129,15 @@ class API:
             from pilosa_tpu.server import wire
             extra = ({"shardEpochs": {str(s): e for s, e in epochs.items()}}
                      if epochs else None)
+            prof = _profile.current()
+            if prof is not None:
+                # The coordinator asked for a nested per-leg timeline:
+                # close this node's ledger and ride it home in the
+                # response header next to the epoch stamp.
+                from pilosa_tpu.exec import fuse as _fuse
+                prof.fused_steps = _fuse.fused_steps()
+                extra = dict(extra or {})
+                extra["profile"] = prof.finish()
             if accept_frames:
                 # accept_frames == 2 means the peer negotiated the v2
                 # layout (aggregates as typed array blobs); plain True

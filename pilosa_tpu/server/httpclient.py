@@ -39,6 +39,7 @@ from typing import Any
 import numpy as np
 
 from pilosa_tpu.cluster.node import Node
+from pilosa_tpu.obs import profile as _profile
 from pilosa_tpu.qos.deadline import DeadlineExceededError
 from pilosa_tpu.qos.deadline import inject_http_headers as _inject_deadline
 from pilosa_tpu.qos.deadline import current_deadline as _current_deadline
@@ -179,15 +180,16 @@ class _MuxLeg:
     """One outbound query leg riding a multiplexed peer channel."""
 
     __slots__ = ("index", "query", "shards", "timeout_ms", "trace",
-                 "done", "frame", "error", "bytes_out")
+                 "profile", "done", "frame", "error", "bytes_out")
 
     def __init__(self, index: str, query: str, shards, timeout_ms,
-                 trace: str | None):
+                 trace: str | None, profile: bool = False):
         self.index = index
         self.query = query
         self.shards = shards
         self.timeout_ms = timeout_ms
         self.trace = trace
+        self.profile = profile
         self.done = False
         self.frame: bytes | None = None
         self.error: BaseException | None = None
@@ -201,6 +203,8 @@ class _MuxLeg:
             d["timeoutMs"] = self.timeout_ms
         if self.trace:
             d["trace"] = self.trace
+        if self.profile:
+            d["profile"] = True
         return d
 
 
@@ -557,6 +561,15 @@ class HTTPInternalClient:
         the coordinator's per-leg tracing span right after the call."""
         return getattr(self._leg_local, "bytes", None)
 
+    def leg_remote_profile(self) -> dict | None:
+        """The remote node's own QueryProfile for the LAST leg this
+        thread sent (carried in the frames header when the coordinator
+        asked for profiling), or None. Read by map_reduce's per-leg
+        profile recorder right after the call returns — remote calls
+        are synchronous on the pool thread, so the thread-local stash
+        always belongs to the leg just completed."""
+        return getattr(self._leg_local, "remote_profile", None)
+
     def _count_wire(self, n_out: int, n_in: int, decode_ms: float = 0.0):
         st = self.stats
         if st is not None:
@@ -663,12 +676,14 @@ class HTTPInternalClient:
         falls back per-query)."""
         from pilosa_tpu.obs import tracing
         from pilosa_tpu.server import wire
+        want_profile = _profile.current() is not None
         attempt = 0
         while True:
             # Deadline-capped per-leg budget; raises if already expired.
             timeout_ms = int(self._deadline_timeout() * 1000)
             leg = _MuxLeg(index, query, shards, timeout_ms,
-                          tracing.current_trace_id())
+                          tracing.current_trace_id(),
+                          profile=want_profile)
             self._channel(node).submit(self, node, leg)
             if leg.error is not None:
                 e = leg.error
@@ -695,7 +710,9 @@ class HTTPInternalClient:
             if st is not None:
                 st.count("cluster.wireDecodeMs", decode_ms)
             self._leg_local.bytes = {"out": leg.bytes_out,
-                                     "in": len(frame)}
+                                     "in": len(frame),
+                                     "decodeMs": decode_ms}
+            self._leg_local.remote_profile = header.get("profile")
             return results, _epoch_vector(header.get("shardEpochs"))
 
     # -- InternalClient protocol -------------------------------------------
@@ -713,6 +730,10 @@ class HTTPInternalClient:
         path = f"/index/{index}/query?remote={'true' if remote else 'false'}"
         if shards:
             path += "&shards=" + ",".join(str(s) for s in shards)
+        if _profile.current() is not None:
+            # The coordinator is profiling: ask the peer to send its own
+            # ledger back in the frames header (nested per-leg timeline).
+            path += "&profile=true"
         from pilosa_tpu.server import wire
         if remote:
             if self._mux_allowed(node):
@@ -751,16 +772,20 @@ class HTTPInternalClient:
                 raise ShardCorruptError() from e
             raise
         self._leg_local.bytes = {"out": len(body), "in": len(data)}
+        self._leg_local.remote_profile = None
         if ctype.startswith(wire.FRAMES_CONTENT_TYPE):
             t0 = time.perf_counter()
             results, header = wire.decode_frames_meta(data)
-            self._count_wire(len(body), len(data),
-                             (time.perf_counter() - t0) * 1000.0)
+            decode_ms = (time.perf_counter() - t0) * 1000.0
+            self._count_wire(len(body), len(data), decode_ms)
+            self._leg_local.bytes["decodeMs"] = decode_ms
+            self._leg_local.remote_profile = header.get("profile")
             return results, _epoch_vector(header.get("shardEpochs"))
         self._count_wire(len(body), len(data))
         resp = json.loads(data) if data else {}
         if "error" in resp:
             raise RuntimeError(resp["error"])
+        self._leg_local.remote_profile = resp.get("profile")
         return ([wire.decode_result(r) for r in resp["results"]],
                 _epoch_vector(resp.get("shardEpochs")))
 

@@ -41,6 +41,7 @@ from pilosa_tpu.qos import (
     QuotaExceededError,
     normalize_class,
 )
+from pilosa_tpu.obs import profile as _profile
 from pilosa_tpu.qos import deadline as qos_deadline
 from pilosa_tpu.server.api import API
 from pilosa_tpu.storage.quarantine import ShardCorruptError
@@ -484,8 +485,12 @@ def _build_routes(api: API):
         # INTERNAL-class requests (backups, maintenance sweeps) must not
         # churn interactive tenants' partitions. Remote fan-out legs
         # keep caching — per-node caches are what make repeated
-        # cluster dashboards cheap.
+        # cluster dashboards cheap. An explicitly profiled query is
+        # exempt too: a cache hit would profile the lookup, not the
+        # cost the caller asked to see.
+        want_inline_profile = params.get("profile") == "true"
         use_cache = (params.get("noCache") != "true"
+                     and not want_inline_profile
                      and (remote or cls != CLASS_INTERNAL))
         # Tenant partition: same identity the quota table charges
         # (X-API-Key, falling back to the index name). Remote legs run
@@ -493,6 +498,33 @@ def _build_routes(api: API):
         # the query once.
         ttoken = set_current_tenant(
             "" if remote else (params.get("_api_key") or pv["index"]))
+        # Per-query cost profile: armed by ?profile=true (rides inline
+        # in the response; on remote legs api.query sends it home in the
+        # frames header) or by the node's always-on slowest-N retention
+        # ring. A query with neither pays one dict lookup here and a
+        # None contextvar read per downstream hook.
+        from pilosa_tpu.obs import tracing as _tr
+        ring = getattr(api, "profile_ring", None)
+        want_profile = want_inline_profile or (
+            not remote and ring is not None
+            and getattr(api, "profile_default", True))
+        prof = None
+        ptoken = trace_token = None
+        prof_doc = None
+        if want_profile:
+            tid = _tr.current_trace_id()
+            if not tid:
+                tid = _tr.new_trace_id()
+                trace_token = _tr.set_current_trace(tid)
+            cluster = getattr(api, "cluster", None)
+            node_id = (cluster.local_id if cluster is not None
+                       else getattr(getattr(api, "local_node", None),
+                                    "id", "") or "standalone")
+            prof = _profile.QueryProfile(
+                tid, query=body.decode(errors="replace"),
+                index=pv["index"], node=node_id, qos_class=cls,
+                remote=remote)
+            ptoken = _profile.activate(prof)
         status = "ok"
         t0 = time.perf_counter()
         try:
@@ -556,15 +588,33 @@ def _build_routes(api: API):
             reset_current_tenant(ttoken)
             if dtoken is not None:
                 qos_deadline.reset_current_deadline(dtoken)
+            from pilosa_tpu.exec import fuse as _fuse
+            if prof is not None:
+                _profile.deactivate(ptoken)
+                if trace_token is not None:
+                    _tr.reset_current_trace(trace_token)
+                prof.status = status
+                prof.fused_steps = _fuse.fused_steps()
+                if not remote:
+                    # Remote legs already shipped their ledger home in
+                    # the response header (api.query); the coordinator's
+                    # ring is the retention point for the whole timeline.
+                    prof_doc = prof.finish()
+                    if ring is not None:
+                        ring.record(prof_doc)
             slow_log = getattr(qos_ctl, "slow_log", None)
             if slow_log is not None and status not in ("shed", "quota"):
-                from pilosa_tpu.exec import fuse as _fuse
                 slow_log.observe(pv["index"], body.decode(errors="replace"),
                                  (time.perf_counter() - t0) * 1000.0,
                                  qos_class=cls, status=status,
-                                 fused_steps=_fuse.fused_steps())
+                                 fused_steps=_fuse.fused_steps(),
+                                 trace_id=(prof.trace_id
+                                           if prof is not None else ""))
         if isinstance(resp, bytes):
             return 200, resp, {"Content-Type": wire.FRAMES_CONTENT_TYPE}
+        if want_inline_profile and prof_doc is not None \
+                and isinstance(resp, dict):
+            resp["profile"] = prof_doc
         return 200, resp
 
     def post_query_mux(pv, params, body):
@@ -601,6 +651,19 @@ def _build_routes(api: API):
             # Remote legs run under the default tenant — the
             # coordinator already attributed the query once.
             ttoken = set_current_tenant("")
+            # A profiled leg ledgers this node's own costs; api.query
+            # ships the finished doc home in the leg's frames header.
+            # Same cache exemption as ?profile=true on the per-query
+            # path: the coordinator asked to see the real cost.
+            ptoken = None
+            use_cache = not leg.get("profile")
+            if leg.get("profile"):
+                cluster = getattr(api, "cluster", None)
+                node_id = (cluster.local_id if cluster is not None
+                           else "standalone")
+                ptoken = _profile.activate(_profile.QueryProfile(
+                    trace or "", query=leg["query"], index=leg["index"],
+                    node=node_id, qos_class=cls, remote=True))
             try:
                 if fault_slow > 0:
                     time.sleep(fault_slow)
@@ -610,12 +673,13 @@ def _build_routes(api: API):
                         frame = api.query(
                             leg["index"], leg["query"],
                             shards=leg.get("shards"),
-                            remote=True, accept_frames=2, cache=True)
+                            remote=True, accept_frames=2,
+                            cache=use_cache)
                 else:
                     frame = api.query(
                         leg["index"], leg["query"],
                         shards=leg.get("shards"),
-                        remote=True, accept_frames=2, cache=True)
+                        remote=True, accept_frames=2, cache=use_cache)
                 outcomes.append({"frame": frame})
             except QueryShedError as e:
                 outcomes.append({"status": 503, "error": str(e),
@@ -632,6 +696,8 @@ def _build_routes(api: API):
             except (QueryError, ParseError, ValueError, PilosaError) as e:
                 outcomes.append({"status": 400, "error": str(e)})
             finally:
+                if ptoken is not None:
+                    _profile.deactivate(ptoken)
                 reset_current_tenant(ttoken)
                 if dtoken is not None:
                     qos_deadline.reset_current_deadline(dtoken)
@@ -697,6 +763,36 @@ def _build_routes(api: API):
                             if slow_log is not None else None),
             "admission": qos_ctl.snapshot(),
         }
+
+    def get_debug_queries(pv, params, body):
+        """Slowest-N retained query profiles (obs.profile.ProfileRing),
+        slowest first — the place to go when the slow-query log names a
+        trace id and you want the full cost breakdown."""
+        ring = getattr(api, "profile_ring", None)
+        if ring is None:
+            return 200, {"queries": [], "capacity": 0}
+        return 200, {"queries": ring.snapshot(), "capacity": ring.capacity}
+
+    def get_debug_query_profile(pv, params, body):
+        """One retained profile by trace id — the target of /metrics
+        exemplars and slow-query-log ``profile`` pointers."""
+        ring = getattr(api, "profile_ring", None)
+        doc = ring.get(pv["trace"]) if ring is not None else None
+        if doc is None:
+            return 404, {"error": f"no retained profile for {pv['trace']}"}
+        return 200, doc
+
+    def get_debug_device(pv, params, body):
+        """Device telemetry in one view: plane-stack residency bytes and
+        generation/eviction/upload counters, compile-cache hits, the
+        coalescer's batch-width histogram and queue depth, and the
+        TransferBatcher's wave widths and inline-steal count."""
+        planner = getattr(api.executor, "planner", None)
+        if planner is None or not hasattr(planner, "device_debug"):
+            return 200, {"enabled": False}
+        out = planner.device_debug()
+        out["enabled"] = True
+        return 200, out
 
     def get_debug_overload(pv, params, body):
         """One view of the whole overload-resilience layer: adaptive
@@ -1049,6 +1145,10 @@ def _build_routes(api: API):
         (r"/version", {"GET": get_version}),
         (r"/metrics", {"GET": get_metrics}),
         (r"/debug/vars", {"GET": get_debug_vars}),
+        (r"/debug/queries/(?P<trace>[^/]+)",
+         {"GET": get_debug_query_profile}),
+        (r"/debug/queries", {"GET": get_debug_queries}),
+        (r"/debug/device", {"GET": get_debug_device}),
         (r"/debug/slow-queries", {"GET": get_debug_slow_queries}),
         (r"/debug/overload", {"GET": get_debug_overload}),
         (r"/debug/cache", {"GET": get_debug_cache}),

@@ -91,7 +91,9 @@ class ServerNode:
                  dispatch_fuse: str = "auto",
                  dispatch_coalesce: str = "auto",
                  dispatch_coalesce_us: float = 150.0,
-                 inline_transfer: str = "auto"):
+                 inline_transfer: str = "auto",
+                 profile_ring_n: int = 64,
+                 profile_queries: bool = True):
         host, _, port = bind.partition(":")
         self.host, self.port = host or "127.0.0.1", int(port or 10101)
         # Node identity IS the address — member ids are built the same
@@ -232,6 +234,16 @@ class ServerNode:
                                   stats=self.stats),
             adaptive=adaptive)
         self.api.qos = self.qos
+        # Per-query cost profiles: retain the slowest N at
+        # /debug/queries; profile_queries=False limits profiling to
+        # explicit ?profile=true requests (the zero-overhead posture —
+        # every hook degenerates to one None contextvar read).
+        self.profile_ring = None
+        if profile_ring_n > 0:
+            from pilosa_tpu.obs import ProfileRing
+            self.profile_ring = ProfileRing(capacity=profile_ring_n)
+        self.api.profile_ring = self.profile_ring
+        self.api.profile_default = bool(profile_queries)
         # Per-tenant token buckets above class admission (429 vs the
         # gate's 503: "you are over YOUR limit" vs "I am over mine").
         self.quotas = None

@@ -192,9 +192,12 @@ def _encode_agg_frame(r: Any, blobs: list[bytes]) -> dict | None:
 
 def encode_frames(results: list, extra: dict | None = None,
                   version: int = 2) -> bytes:
-    """``extra`` merges response-level metadata (e.g. ``shardEpochs``,
-    the serving node's pre-execution epoch vector) into the frame
-    header; decoders that don't know the keys ignore them.
+    """``extra`` merges response-level metadata into the frame header;
+    decoders that don't know the keys ignore them. Current keys:
+    ``shardEpochs`` (the serving node's pre-execution epoch vector) and
+    ``profile`` (the node's own QueryProfile ledger when the
+    coordinator queried with profiling on — obs/profile.py; the client
+    stashes it per thread for map_reduce's per-leg recorder).
 
     ``version=1`` keeps aggregates in the JSON envelope — the shape an
     old (pre-v2) coordinator can decode; peers answer v1 unless the
@@ -478,7 +481,8 @@ def decode_frames(data: bytes) -> list[Any]:
 
 def decode_frames_meta(data: bytes) -> tuple[list[Any], dict]:
     """(results, header) — the header exposes response-level metadata
-    (``shardEpochs``) alongside the decoding bookkeeping. Routed through
+    (``shardEpochs``, ``profile``) alongside the decoding bookkeeping.
+    Routed through
     the module-level ``decode_frames`` so call-site instrumentation
     (tests patch it to assert the frame path was taken) still observes
     every decode."""

@@ -367,3 +367,272 @@ def test_debug_heap_route():
         assert out.get("vmrss_kib", 1) > 0
     finally:
         n.close()
+
+
+# -- ISSUE 11: SLO histograms, per-query profiles, device telemetry ------
+
+
+def test_log_histogram_observe_merge_quantile():
+    from pilosa_tpu.obs import LogHistogram, SECONDS_BOUNDS
+    h = LogHistogram()
+    for v in (0.0002, 0.0002, 0.01, 0.5):
+        h.observe(v)
+    assert h.count == 4 and abs(h.sum - 0.5104) < 1e-12
+    assert 0.0001 <= h.quantile(0.5) <= 0.01
+    items = h.bucket_items()
+    assert items[-1] == ("+Inf", 4)
+    cums = [c for _, c in items]
+    assert cums == sorted(cums)          # cumulative by construction
+    other = LogHistogram()
+    other.observe(100.0)                 # overflows into +Inf
+    h.merge(other)
+    assert h.count == 5 and h.bucket_items()[-1] == ("+Inf", 5)
+    # a +Inf rank floors to the last finite bound (documented behavior)
+    assert other.quantile(0.99) == SECONDS_BOUNDS[-1]
+    # memory stays O(buckets) no matter how many observations land
+    for _ in range(10_000):
+        h.observe(0.001)
+    assert len(h.counts) == len(h.bounds) + 1
+    snap = h.snapshot()
+    assert snap["count"] == h.count and snap["p99"] > 0
+
+
+def test_log_histogram_exemplars():
+    from pilosa_tpu.obs import LogHistogram
+    h = LogHistogram()
+    for _ in range(200):
+        h.observe(0.0002)
+    h.observe(5.0, trace_id="t-slow")
+    slow_i = next(j for j in range(len(h.counts))
+                  if h.exemplar(j) is not None)
+    # the slow observation's bucket sits at/above the p99 bucket and
+    # keeps its trace id
+    assert slow_i >= h.p99_bucket_index()
+    assert h.exemplar(slow_i) == (5.0, "t-slow")
+
+
+def test_memory_stats_timings_bounded():
+    """Satellite: the unbounded per-series timing lists are gone —
+    10k observations cost O(buckets), and the accessors still work."""
+    from pilosa_tpu.obs import LogHistogram
+    s = MemoryStats()
+    for _ in range(10_000):
+        s.timing("exec", 0.001)
+    h = s.timings[("exec", ())]
+    assert isinstance(h, LogHistogram)
+    assert len(h.counts) == len(h.bounds) + 1
+    assert s.timing_count("exec") == 10_000
+    assert abs(s.timing_sum("exec") - 10.0) < 1e-6
+    assert 0.0005 < s.timing_quantile("exec", 0.5) < 0.005
+
+
+def test_prometheus_histogram_scrape_reparse():
+    """Satellite: real `histogram` exposition — scrape the payload and
+    re-parse the bucket series, _count/_sum, and the p99 exemplar."""
+    import re
+    from pilosa_tpu.obs import tracing as tr
+    s = MemoryStats()
+    for _ in range(200):
+        s.timing("exec", 0.0002)
+    tok = tr.set_current_trace("trace-slow-1")
+    try:
+        s.timing("exec", 2.0)     # slow observation carries the trace
+    finally:
+        tr.reset_current_trace(tok)
+    text = prometheus_text(s)
+    assert "# TYPE pilosa_exec_seconds histogram" in text
+    bucket_re = re.compile(
+        r'^pilosa_exec_seconds_bucket\{le="([^"]+)"\} (\d+)'
+        r'(?: # \{trace_id="([^"]+)"\} ([0-9.eE+-]+))?$')
+    buckets, exemplars = [], {}
+    count = total_sum = None
+    for line in text.splitlines():
+        m = bucket_re.match(line)
+        if m:
+            buckets.append((m.group(1), int(m.group(2))))
+            if m.group(3):
+                exemplars[m.group(1)] = (m.group(3), float(m.group(4)))
+        elif line.startswith("pilosa_exec_seconds_count "):
+            count = int(line.split()[-1])
+        elif line.startswith("pilosa_exec_seconds_sum "):
+            total_sum = float(line.split()[-1])
+    assert buckets and buckets[-1][0] == "+Inf"
+    cums = [c for _, c in buckets]
+    assert cums == sorted(cums)              # cumulative and monotone
+    assert count == 201 and buckets[-1][1] == count
+    assert total_sum is not None
+    assert abs(total_sum - (200 * 0.0002 + 2.0)) < 1e-9
+    # the slow tail carries the exemplar, linked by trace id; the fast
+    # (p50) bucket stays exemplar-free
+    assert any(tid == "trace-slow-1" for tid, _ in exemplars.values())
+    assert "0.0002" not in exemplars
+
+
+def _free_ports(n):
+    import socket
+    ports = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        s.close()
+    return ports
+
+
+def test_cluster_profile_accounts_every_leg():
+    """Acceptance: ?profile=true on a 3-node cluster returns a timeline
+    whose per-peer wire bytes and decode ms sum to the coordinator's
+    totals, every remote leg accounted exactly once, each carrying the
+    peer's own nested ledger home in the frames header."""
+    import json
+    from pilosa_tpu.config import SHARD_WIDTH
+    from pilosa_tpu.server.node import ServerNode
+
+    addrs = [f"127.0.0.1:{p}" for p in _free_ports(3)]
+    nodes = [ServerNode(bind=a, peers=addrs, use_planner=False,
+                        anti_entropy_interval=0.0,
+                        check_nodes_interval=0.0,
+                        qos_slow_query_ms=0.0) for a in addrs]
+    for n in nodes:
+        n.open()
+    try:
+        base = nodes[0].address
+
+        def post(path, body=""):
+            r = urllib.request.Request(base + path, data=body.encode(),
+                                       method="POST")
+            return json.loads(urllib.request.urlopen(r, timeout=10).read()
+                              or b"{}")
+
+        post("/index/p", "{}")
+        post("/index/p/field/f", "{}")
+        for s in range(8):
+            post("/index/p/query", f"Set({s * SHARD_WIDTH}, f=1)")
+        resp = post("/index/p/query?profile=true", "Count(Row(f=1))")
+        assert resp["results"] == [8]
+        prof = resp["profile"]
+        legs = prof["remoteLegs"]
+        tot = prof["remoteTotals"]
+        # every remote peer appears EXACTLY once (no hedging configured)
+        leg_nodes = [leg["node"] for leg in legs]
+        assert len(leg_nodes) == len(set(leg_nodes))
+        assert set(leg_nodes) <= {n.id for n in nodes[1:]}
+        assert not any(leg["hedged"] for leg in legs)
+        # the acceptance invariant: totals are the sums of the legs
+        assert tot["legs"] == len(legs) >= 1
+        assert tot["bytesOut"] == sum(leg["bytesOut"] for leg in legs)
+        assert tot["bytesIn"] == sum(leg["bytesIn"] for leg in legs)
+        assert abs(tot["decodeMs"]
+                   - sum(leg["decodeMs"] for leg in legs)) < 0.01
+        assert tot["hedgedLegs"] == 0 and tot["errorLegs"] == 0
+        # each leg's nested remote ledger joined the coordinator's trace
+        for leg in legs:
+            rp = leg["remote"]
+            assert rp["traceId"] == prof["traceId"]
+            assert rp["node"] == leg["node"]
+        # retention: addressable by trace id and listed slowest-first
+        tid = prof["traceId"]
+        got = json.loads(urllib.request.urlopen(
+            base + f"/debug/queries/{tid}", timeout=10).read())
+        assert got["remoteTotals"] == tot
+        listing = json.loads(urllib.request.urlopen(
+            base + "/debug/queries", timeout=10).read())
+        assert any(d["traceId"] == tid for d in listing["queries"])
+        # satellite: the slow-query log entry links to the profile
+        slow = json.loads(urllib.request.urlopen(
+            base + "/debug/slow-queries", timeout=10).read())
+        entry = next(e for e in slow["queries"]
+                     if e.get("traceId") == tid)
+        assert entry["profile"] == f"/debug/queries/{tid}"
+    finally:
+        for n in nodes:
+            try:
+                n.close()
+            except Exception:
+                pass
+
+
+def test_profile_off_bit_identical_and_allocation_free():
+    """Satellite: with profiling fully off the query path constructs no
+    QueryProfile at all (the ctor is boobytrapped for the duration) and
+    answers bit-identically to a profiling node."""
+    import json
+    from pilosa_tpu.obs import profile as _profile
+    from pilosa_tpu.server.node import ServerNode
+
+    def run(node, trap=False):
+        base = node.address
+
+        def post(path, body=""):
+            r = urllib.request.Request(base + path, data=body.encode(),
+                                       method="POST")
+            return json.loads(urllib.request.urlopen(r, timeout=10).read()
+                              or b"{}")
+
+        post("/index/q", "{}")
+        post("/index/q/field/f", "{}")
+        for c in (1, 2, 3, 70):
+            post("/index/q/query", f"Set({c}, f=1)")
+        orig = _profile.QueryProfile.__init__
+        if trap:
+            def boom(self, *a, **k):
+                raise AssertionError("QueryProfile built on the off path")
+            _profile.QueryProfile.__init__ = boom
+        try:
+            return post("/index/q/query", "Row(f=1)")
+        finally:
+            _profile.QueryProfile.__init__ = orig
+
+    n_off = ServerNode(bind="127.0.0.1:0", use_planner=False,
+                       profile_ring_n=0, profile_queries=False)
+    n_off.open()
+    try:
+        off = run(n_off, trap=True)
+    finally:
+        n_off.close()
+    n_on = ServerNode(bind="127.0.0.1:0", use_planner=False)
+    n_on.open()
+    try:
+        on = run(n_on)
+    finally:
+        n_on.close()
+    assert off == on
+    assert "profile" not in off
+
+
+def test_debug_device_route_and_dispatch_profile():
+    """/debug/device gathers residency bytes, upload counters, and the
+    batch/wave width histograms in one view; a profiled query on a
+    planner node ledgers its device dispatches."""
+    import json
+    from pilosa_tpu.server.node import ServerNode
+
+    n = ServerNode(bind="127.0.0.1:0")   # planner ON
+    n.open()
+    try:
+        base = n.address
+
+        def post(path, body=""):
+            r = urllib.request.Request(base + path, data=body.encode(),
+                                       method="POST")
+            return json.loads(urllib.request.urlopen(r, timeout=30).read()
+                              or b"{}")
+
+        post("/index/dv", "{}")
+        post("/index/dv/field/f", "{}")
+        for c in range(64):
+            post("/index/dv/query", f"Set({c}, f=1)")
+        resp = post("/index/dv/query?profile=true", "Count(Row(f=1))")
+        assert resp["results"] == [64]
+        prof = resp["profile"]
+        assert prof["dispatch"]["count"] >= 1
+        assert len(prof["dispatch"]["widths"]) >= 1
+        out = json.loads(urllib.request.urlopen(
+            base + "/debug/device", timeout=10).read())
+        assert out["enabled"]
+        assert out["uploads"] >= 1 and out["upload_bytes"] > 0
+        assert out["batch_width_hist"]["count"] >= 1
+        assert "queue_depth" in out
+        assert "wave_width_hist" in out["transfer"]
+    finally:
+        n.close()
