@@ -1,0 +1,28 @@
+"""Project-specific static analysis: AST checkers for the invariants
+this codebase has historically broken (see CHANGES.md), plus a runtime
+lock-order witness (witness.py).
+
+The reference Pilosa leans on ``go vet`` and ``-race`` to keep a ~70k-LoC
+concurrent index honest; this package is the Python port's equivalent —
+rules encoding *our* bug catalog (silent epoch-bump skips, shared-list
+mutation through caches, asymmetric wire codecs, trace-time side effects
+baked into jitted programs, leaked contextvar tokens, unjoined threads).
+
+Run it three ways:
+
+  python -m pilosa_tpu.analysis          # CLI, exit 1 on findings
+  pytest tests/test_analysis.py          # tier-1: zero findings on tree
+  PILOSA_TPU_WITNESS=1 pytest tests/     # runtime lock-order witness
+
+Suppress a justified false positive with a pragma on the finding line or
+on the enclosing ``def`` line::
+
+  # analysis: ignore[RULE]  -- why this is safe
+"""
+
+from pilosa_tpu.analysis.engine import (  # noqa: F401
+    Finding,
+    ModuleInfo,
+    load_project,
+    run_analysis,
+)

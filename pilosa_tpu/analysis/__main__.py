@@ -1,0 +1,38 @@
+"""CLI: ``python -m pilosa_tpu.analysis [--rule RULE]...``.
+
+Exit status 1 when any unsuppressed finding exists — the CI contract.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from pilosa_tpu.analysis.engine import load_project, run_analysis
+
+
+def main(argv: list[str] | None = None) -> int:
+    from pilosa_tpu.analysis.checkers import RULES
+
+    ap = argparse.ArgumentParser(
+        prog="python -m pilosa_tpu.analysis",
+        description="Project invariant checkers (see analysis/__init__.py).")
+    ap.add_argument("--rule", action="append", choices=RULES,
+                    help="run only this rule (repeatable)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+    if args.list_rules:
+        for r in RULES:
+            print(r)
+        return 0
+    project = load_project()
+    findings, suppressed = run_analysis(project, rules=args.rule)
+    for f in findings:
+        print(f)
+    print(f"{len(findings)} finding(s), {suppressed} suppressed by pragma, "
+          f"{len(project)} file(s) analyzed", file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,22 @@
+"""Checker registry. Each checker module exposes ``RULE`` (pragma name)
+and ``check(mod, project) -> list[Finding]``."""
+
+from pilosa_tpu.analysis.checkers import (
+    contextvar_hygiene,
+    epoch_audit,
+    executor_lifecycle,
+    jit_purity,
+    shared_return,
+    wire_symmetry,
+)
+
+ALL_CHECKERS = [
+    epoch_audit,
+    shared_return,
+    wire_symmetry,
+    jit_purity,
+    contextvar_hygiene,
+    executor_lifecycle,
+]
+
+RULES = [c.RULE for c in ALL_CHECKERS]

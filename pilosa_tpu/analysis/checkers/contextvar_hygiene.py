@@ -1,0 +1,110 @@
+"""contextvar-hygiene: every ``ContextVar.set(...)`` needs its token
+``reset`` in a ``finally``.
+
+Query-scoped state (deadline, trace id, tenant, profile) rides
+contextvars; the HTTP server reuses threads across requests, so a set
+without a reset leaks one query's deadline/tenant into the next
+request served by that thread — quota mischarges and spurious 504s.
+
+Sanctioned shapes (not flagged):
+
+* the wrapper definition itself: ``def set_current_x(v): return
+  _cvar.set(v)`` (or ``activate``/``deactivate`` pairs) — a function
+  that RETURNS the set-call hands token ownership to its caller by
+  construction; the caller's reset discipline is checked at its site;
+* any set-call inside a function that also resets in a ``finally``
+  (covers the plain token pattern and the tokens-list pattern used by
+  ``cluster._with_trace``).
+
+Flagged: a set-call (direct ``_cvar.set`` or a ``set_current_*``
+wrapper call) in a function with no ``finally``-reset, or whose token
+is discarded outright.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections.abc import Mapping
+
+from pilosa_tpu.analysis.engine import (
+    Finding,
+    ModuleInfo,
+    call_name,
+    functions,
+    shallow_walk,
+)
+
+RULE = "contextvar-hygiene"
+
+
+def _module_contextvars(tree: ast.Module) -> set[str]:
+    out: set[str] = set()
+    for node in tree.body:
+        value = None
+        targets: list[ast.expr] = []
+        if isinstance(node, ast.Assign):
+            value, targets = node.value, node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            value, targets = node.value, [node.target]
+        if isinstance(value, ast.Call) and call_name(value) in (
+                "contextvars.ContextVar", "ContextVar"):
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def _set_calls(fn: ast.AST, cvars: set[str]) -> list[ast.Call]:
+    out = []
+    for node in shallow_walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "set" \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id in cvars:
+            out.append(node)
+        elif isinstance(node.func, ast.Name) \
+                and node.func.id.startswith("set_current_"):
+            out.append(node)
+    return out
+
+
+def _is_wrapper(fn: ast.FunctionDef | ast.AsyncFunctionDef,
+                call: ast.Call) -> bool:
+    for node in shallow_walk(fn):
+        if isinstance(node, ast.Return) and node.value is call:
+            return True
+    return False
+
+
+def _has_finally_reset(fn: ast.AST) -> bool:
+    for node in shallow_walk(fn):
+        if isinstance(node, ast.Try) and node.finalbody:
+            for inner in node.finalbody:
+                for sub in ast.walk(inner):
+                    if isinstance(sub, ast.Call):
+                        name = call_name(sub) or ""
+                        if "reset" in name.rsplit(".", 1)[-1]:
+                            return True
+    return False
+
+
+def check(mod: ModuleInfo, project: Mapping[str, ModuleInfo]) -> list[Finding]:
+    cvars = _module_contextvars(mod.tree)
+    findings: list[Finding] = []
+    for fn in functions(mod.tree):
+        calls = _set_calls(fn, cvars)
+        if not calls:
+            continue
+        if _has_finally_reset(fn):
+            continue
+        for call in calls:
+            if _is_wrapper(fn, call):
+                continue
+            what = call_name(call) or "<contextvar>.set"
+            findings.append(Finding(
+                RULE, mod.path, call.lineno,
+                f"'{what}' in {fn.name} has no reset in a finally — the "
+                f"token leaks and the value bleeds into the next request "
+                f"on this thread"))
+    return findings

@@ -1,0 +1,188 @@
+"""epoch-audit: any function in the bitmap/translate/attr state layers
+that writes tracked store state must reach an epoch bump.
+
+The result cache stamps entries with ``(schema_epoch, shard epochs)``
+(cache/signature.py); a mutation path that skips the bump serves stale
+results forever — the exact bug class CHANGES.md records for
+``merge_row_words`` -style paths. Tracked stores and their invalidation
+hooks:
+
+  Fragment.rows            -> Fragment._invalidate / epoch.bump(shard=)
+  TranslateStore._fwd/_rev -> epoch.bump (schema-grain)
+  AttrStore._attrs         -> epoch.bump
+
+"Reaches" is a per-class fixed point over ``self.<method>()`` calls, so
+a mutator that delegates invalidation to a helper still passes.
+``__init__`` (and helpers reachable only from it) are exempt: nothing
+can have cached results against an object that does not exist yet.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections.abc import Mapping
+
+from pilosa_tpu.analysis.engine import Finding, ModuleInfo, call_name
+
+RULE = "epoch-audit"
+
+#: module path suffixes this rule applies to (the state-bearing layers).
+SCOPE = ("core/fragment.py", "core/translate.py", "core/attrs.py")
+SCOPE_DIRS = ("storage/",)
+
+#: attribute names holding epoch-stamped store state. Derived caches
+#: (_dev_rows, _count_cache, ...) are deliberately absent: they are
+#: rebuilt from tracked state and carry no epoch.
+TRACKED = {"rows", "_fwd", "_rev", "_attrs"}
+
+#: container methods that mutate in place.
+MUTATORS = {"pop", "popitem", "update", "clear", "setdefault",
+            "add", "discard", "remove", "append", "extend", "insert"}
+
+#: reaching any of these counts as invalidation.
+BUMPS = {"bump", "bump_shards", "_invalidate"}
+
+
+def _in_scope(path: str) -> bool:
+    if any(path.endswith(s) for s in SCOPE):
+        return True
+    return any(f"/{d}" in path or path.startswith(d) for d in SCOPE_DIRS)
+
+
+def _tracked_attr(node: ast.expr) -> str | None:
+    """The tracked attribute name if ``node`` is ``<expr>.rows`` etc."""
+    if isinstance(node, ast.Attribute) and node.attr in TRACKED:
+        return node.attr
+    return None
+
+
+def _mutations(fn: ast.FunctionDef | ast.AsyncFunctionDef,
+               is_init: bool) -> list[tuple[int, str]]:
+    """(lineno, tracked-attr) for each in-place write of tracked state,
+    including writes through a tainted local alias
+    (``hr = self.rows.get(k); hr.add(pos)``)."""
+    muts: list[tuple[int, str]] = []
+    tainted: dict[str, str] = {}
+    for node in ast.walk(fn):
+        # x = self.rows.get(k) / self.rows[k] / self.rows.setdefault(...)
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            src = node.value
+            attr = None
+            if isinstance(src, ast.Call) and isinstance(src.func, ast.Attribute):
+                attr = _tracked_attr(src.func.value)
+            elif isinstance(src, ast.Subscript):
+                attr = _tracked_attr(src.value)
+            if attr:
+                tainted[node.targets[0].id] = attr
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if isinstance(t, ast.Subscript):
+                    attr = _tracked_attr(t.value)
+                    if attr:
+                        muts.append((t.lineno, attr))
+                elif not is_init:
+                    attr = _tracked_attr(t)
+                    if attr:
+                        muts.append((t.lineno, attr))
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    attr = _tracked_attr(t.value)
+                    if attr:
+                        muts.append((t.lineno, attr))
+        elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr in MUTATORS:
+                attr = _tracked_attr(node.func.value)
+                if attr:
+                    muts.append((node.lineno, attr))
+                elif isinstance(node.func.value, ast.Name) \
+                        and node.func.value.id in tainted:
+                    muts.append((node.lineno, tainted[node.func.value.id]))
+    return muts
+
+
+def _bumps_directly(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in BUMPS:
+            return True
+    return False
+
+
+def _self_calls(fn: ast.AST) -> set[str]:
+    out = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name and name.startswith("self."):
+                out.add(name.split(".", 1)[1].split(".")[0])
+    return out
+
+
+def _check_class(mod: ModuleInfo, cls: ast.ClassDef) -> list[Finding]:
+    methods = {n.name: n for n in cls.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    calls = {name: _self_calls(fn) & set(methods) for name, fn in methods.items()}
+
+    # fixed point: m reaches a bump if it bumps directly or any
+    # self-callee reaches one.
+    reaches = {name: _bumps_directly(fn) for name, fn in methods.items()}
+    changed = True
+    while changed:
+        changed = False
+        for name in methods:
+            if not reaches[name] and any(reaches[c] for c in calls[name]):
+                reaches[name] = changed = True
+
+    # __init__-only reachability: helpers called solely from exempt
+    # methods run before the object is visible to any cache.
+    callers: dict[str, set[str]] = {name: set() for name in methods}
+    for name, callees in calls.items():
+        for c in callees:
+            callers[c].add(name)
+    exempt = {"__init__"} & set(methods)
+    changed = True
+    while changed:
+        changed = False
+        for name in methods:
+            if name not in exempt and callers[name] \
+                    and callers[name] <= exempt:
+                exempt.add(name)
+                changed = True
+
+    findings = []
+    for name, fn in methods.items():
+        if name in exempt:
+            continue
+        muts = _mutations(fn, is_init=False)
+        if muts and not reaches[name]:
+            lineno, attr = muts[0]
+            findings.append(Finding(
+                RULE, mod.path, lineno,
+                f"{cls.name}.{name} writes tracked state '{attr}' but "
+                f"never reaches an epoch bump/_invalidate — cached "
+                f"results go stale"))
+    return findings
+
+
+def check(mod: ModuleInfo, project: Mapping[str, ModuleInfo]) -> list[Finding]:
+    if not _in_scope(mod.path):
+        return []
+    findings: list[Finding] = []
+    for node in mod.tree.body:
+        if isinstance(node, ast.ClassDef):
+            findings.extend(_check_class(mod, node))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            muts = _mutations(node, is_init=False)
+            if muts and not _bumps_directly(node):
+                lineno, attr = muts[0]
+                findings.append(Finding(
+                    RULE, mod.path, lineno,
+                    f"{node.name} writes tracked state '{attr}' but never "
+                    f"reaches an epoch bump/_invalidate — cached results "
+                    f"go stale"))
+    return findings

@@ -1,0 +1,89 @@
+"""executor-lifecycle: every Thread/ThreadPoolExecutor construction
+needs a reachable join/shutdown.
+
+The hedge-pool fan-out deadlock and the coalescer's stranded futures
+(CHANGES.md) were both lifecycle bugs: workers nobody owned. The rule:
+a non-daemon ``threading.Thread`` or a ``ThreadPoolExecutor`` must be
+(a) constructed as a ``with`` context manager, (b) marked
+``daemon=True`` (fire-and-forget by declaration), or (c) constructed in
+a class that somewhere calls ``.join(``/``.shutdown(`` — the owning
+``close()`` pattern batcher/coalescer/diskstore use.
+
+The reachability is per-class (per-module outside classes), a
+deliberately coarse grain: it catches the real bug class — a worker
+with no owner at all — without demanding interprocedural proof.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections.abc import Mapping
+
+from pilosa_tpu.analysis.engine import Finding, ModuleInfo, call_name
+
+RULE = "executor-lifecycle"
+
+_CTORS = ("Thread", "ThreadPoolExecutor", "ProcessPoolExecutor")
+_RELEASERS = {"join", "shutdown"}
+
+
+def _is_ctor(node: ast.Call) -> str | None:
+    name = call_name(node)
+    if name and name.rsplit(".", 1)[-1] in _CTORS:
+        return name.rsplit(".", 1)[-1]
+    return None
+
+
+def _is_daemon(node: ast.Call) -> bool:
+    for kw in node.keywords:
+        if kw.arg == "daemon" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is True:
+            return True
+    return False
+
+
+def _scope_has_releaser(scope: ast.AST) -> bool:
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _RELEASERS:
+            return True
+    return False
+
+
+def check(mod: ModuleInfo, project: Mapping[str, ModuleInfo]) -> list[Finding]:
+    findings: list[Finding] = []
+    # parent links to find the enclosing class and with-statements
+    parents: dict[ast.AST, ast.AST] = {}
+    for parent in ast.walk(mod.tree):
+        for child in ast.iter_child_nodes(parent):
+            parents[child] = parent
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        ctor = _is_ctor(node)
+        if ctor is None:
+            continue
+        if ctor == "Thread" and _is_daemon(node):
+            continue
+        # `with ThreadPoolExecutor(...) as pool:` — scoped lifetime
+        p = parents.get(node)
+        if isinstance(p, ast.withitem):
+            continue
+        # find enclosing class (or fall back to the module)
+        scope: ast.AST = node
+        enclosing: ast.AST = mod.tree
+        while scope in parents:
+            scope = parents[scope]
+            if isinstance(scope, ast.ClassDef):
+                enclosing = scope
+                break
+        if _scope_has_releaser(enclosing):
+            continue
+        findings.append(Finding(
+            RULE, mod.path, node.lineno,
+            f"{ctor} constructed with no daemon=True, no `with` scope, "
+            f"and no join/shutdown anywhere in the enclosing "
+            f"{'class' if isinstance(enclosing, ast.ClassDef) else 'module'}"
+            f" — an unowned worker (the hedge-pool deadlock class)"))
+    return findings

@@ -1,0 +1,124 @@
+"""jit-purity: functions compiled by jax.jit/vmap/pmap or lowered as
+Pallas kernels must not perform trace-time side effects.
+
+A jitted Python body runs ONCE per (shape, static-args) signature; any
+``time.*``/``random.*`` call, stats emission, or contextvar write
+executes at trace time only and its result is baked into the cached
+program — the plan-program analog of the stale-closure bug. (Use
+``jax.random`` with explicit keys for randomness; hoist telemetry to
+the host-side call sites.)
+
+Detection is name-based and intra-module: decorated defs
+(``@jax.jit``, ``@functools.partial(jax.jit, ...)``, ``@jax.vmap``),
+wrap-calls whose argument is a local function name (``jax.jit(count)``,
+``jax.vmap(raw)``), and first arguments to ``pl.pallas_call``.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections.abc import Mapping
+
+from pilosa_tpu.analysis.engine import (
+    Finding,
+    ModuleInfo,
+    call_name,
+    dotted_name,
+    functions,
+)
+
+RULE = "jit-purity"
+
+_COMPILERS = ("jax.jit", "jax.vmap", "jax.pmap", "jit", "vmap", "pmap")
+_IMPURE_ROOTS = ("time.", "random.", "np.random.", "numpy.random.")
+_STATS_RECEIVERS = {"stats", "_stats", "statsd"}
+_STATS_METHODS = {"count", "gauge", "timing"}
+
+
+def _is_compiler(name: str | None) -> bool:
+    return name in _COMPILERS
+
+
+def _expr_name(node: ast.expr) -> str | None:
+    return dotted_name(node)
+
+
+def _compiled_names(tree: ast.AST) -> set[str]:
+    """Names of module functions that get compiled somewhere."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for d in node.decorator_list:
+                if isinstance(d, ast.Call):
+                    name = _expr_name(d.func)
+                    # @functools.partial(jax.jit, ...) / @jax.jit(...)
+                    if name in ("functools.partial", "partial") and d.args:
+                        name = _expr_name(d.args[0])
+                    if _is_compiler(name):
+                        out.add(node.name)
+                elif _is_compiler(_expr_name(d)):
+                    out.add(node.name)
+        elif isinstance(node, ast.Call):
+            name = call_name(node)
+            if _is_compiler(name) and node.args:
+                arg = node.args[0]
+                # unwrap nested jax.jit(jax.vmap(raw))
+                while isinstance(arg, ast.Call) and _is_compiler(call_name(arg)) \
+                        and arg.args:
+                    arg = arg.args[0]
+                if isinstance(arg, ast.Name):
+                    out.add(arg.id)
+            elif name and name.endswith("pallas_call") and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Name):
+                    out.add(arg.id)
+    return out
+
+
+def _impure_calls(fn: ast.AST) -> list[tuple[int, str]]:
+    out: list[tuple[int, str]] = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        if name:
+            if any(name.startswith(r) for r in _IMPURE_ROOTS):
+                out.append((node.lineno, name))
+                continue
+            last = name.rsplit(".", 1)[-1]
+            if last.startswith("set_current_"):
+                out.append((node.lineno, name))
+                continue
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr == "set":
+                # contextvar write through a module-level ContextVar
+                recv = node.func.value
+                if isinstance(recv, ast.Name) and (
+                        recv.id.startswith("_") or "var" in recv.id.lower()):
+                    out.append((node.lineno, f"{recv.id}.set"))
+                continue
+            if node.func.attr in _STATS_METHODS:
+                recv = node.func.value
+                recv_name = (recv.attr if isinstance(recv, ast.Attribute)
+                             else recv.id if isinstance(recv, ast.Name)
+                             else None)
+                if recv_name in _STATS_RECEIVERS:
+                    out.append((node.lineno, f"{recv_name}.{node.func.attr}"))
+    return out
+
+
+def check(mod: ModuleInfo, project: Mapping[str, ModuleInfo]) -> list[Finding]:
+    compiled = _compiled_names(mod.tree)
+    if not compiled:
+        return []
+    findings: list[Finding] = []
+    for fn in functions(mod.tree):
+        if fn.name not in compiled:
+            continue
+        for lineno, what in _impure_calls(fn):
+            findings.append(Finding(
+                RULE, mod.path, lineno,
+                f"jit-compiled '{fn.name}' calls '{what}' — the side "
+                f"effect runs at trace time only and its value is baked "
+                f"into the cached program"))
+    return findings

@@ -1,0 +1,80 @@
+"""shared-mutable-return: public methods returning a list/dict/set
+attribute uncopied hand callers an alias into live internal state.
+
+The GroupBy-merge incident (CHANGES.md): ``merge_group_counts`` extended
+a list that an earlier call had returned straight out of the result
+cache, corrupting every later cache hit. The durable rule: a *public*
+method's return value is a handoff — copy containers at the boundary
+(``list(self._x)``, ``dict(self._x)``) or return read-only views.
+Private helpers are exempt: intra-class aliasing is the class's own
+business (e.g. Fragment._mutex_map works on the live map by design).
+"""
+
+from __future__ import annotations
+
+import ast
+from collections.abc import Mapping
+
+from pilosa_tpu.analysis.engine import Finding, ModuleInfo
+
+RULE = "shared-mutable-return"
+
+#: constructors whose result is a mutable container.
+_CONTAINER_CALLS = {"list", "dict", "set", "defaultdict", "OrderedDict",
+                    "collections.defaultdict", "collections.OrderedDict"}
+
+
+def _is_container_expr(node: ast.expr) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        name = node.func.id if isinstance(node.func, ast.Name) else (
+            node.func.attr if isinstance(node.func, ast.Attribute) else None)
+        return name in {"list", "dict", "set", "defaultdict", "OrderedDict"}
+    return False
+
+
+def _container_attrs(cls: ast.ClassDef) -> set[str]:
+    """Attribute names ever assigned a mutable container in any method."""
+    out: set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            value = node.value
+            if value is None or not _is_container_expr(value):
+                continue
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and t.value.id == "self":
+                    out.add(t.attr)
+    return out
+
+
+def check(mod: ModuleInfo, project: Mapping[str, ModuleInfo]) -> list[Finding]:
+    findings: list[Finding] = []
+    for cls in ast.walk(mod.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        attrs = _container_attrs(cls)
+        if not attrs:
+            continue
+        for fn in cls.body:
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if fn.name.startswith("_"):
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Return) or node.value is None:
+                    continue
+                v = node.value
+                if isinstance(v, ast.Attribute) and v.attr in attrs and \
+                        isinstance(v.value, ast.Name) and v.value.id == "self":
+                    findings.append(Finding(
+                        RULE, mod.path, node.lineno,
+                        f"{cls.name}.{fn.name} returns self.{v.attr} "
+                        f"uncopied — callers can mutate live internal "
+                        f"state (the GroupBy-merge aliasing class); "
+                        f"return a copy"))
+    return findings

@@ -1,0 +1,179 @@
+"""wire-symmetry: the node-to-node codec must round-trip.
+
+Three sub-checks over ``server/wire.py``:
+
+1. Every public ``encode_X`` has a ``decode_X`` (and every ``decode_X``
+   some ``encode_`` base it inverts; ``decode_frames_meta`` matches
+   ``encode_frames`` by prefix).
+2. Every string key *written* by an encode function is *read* by some
+   decode-side function — an encoder shipping a key nobody reads is a
+   field silently dropped on the floor at the far end.
+3. Every field of a result dataclass (exec/result.py) that the encode
+   side reads must be passed by at least one decode-side constructor
+   call — the exact shape of the ``Pair.key`` bug, where keyed TopN
+   results lost their keys crossing the node boundary.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections.abc import Mapping
+
+from pilosa_tpu.analysis.engine import Finding, ModuleInfo, const_str
+
+RULE = "wire-symmetry"
+
+WIRE_PATH = "server/wire.py"
+RESULT_PATH = "exec/result.py"
+
+#: name fragments marking a function as decode-side (incl. helpers like
+#: _read_arr/_split_blobs that do the actual key reads).
+_DECODE_MARKS = ("decode", "read", "iter", "split")
+
+
+def _top_functions(mod: ModuleInfo) -> list[ast.FunctionDef]:
+    return [n for n in mod.tree.body if isinstance(n, ast.FunctionDef)]
+
+
+def _is_decode_fn(name: str) -> bool:
+    return any(m in name for m in _DECODE_MARKS)
+
+
+def _written_keys(fns: list[ast.FunctionDef]) -> list[tuple[str, int]]:
+    out: list[tuple[str, int]] = []
+    for fn in fns:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Dict):
+                for k in node.keys:
+                    s = const_str(k) if k is not None else None
+                    if s is not None:
+                        out.append((s, k.lineno))
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if isinstance(t, ast.Subscript):
+                        s = const_str(t.slice)
+                        if s is not None:
+                            out.append((s, t.lineno))
+    return out
+
+
+def _read_keys(fns: list[ast.FunctionDef]) -> set[str]:
+    out: set[str] = set()
+    for fn in fns:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Subscript) and \
+                    isinstance(node.ctx, ast.Load):
+                s = const_str(node.slice)
+                if s is not None:
+                    out.add(s)
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ("get", "pop") and node.args:
+                s = const_str(node.args[0])
+                if s is not None:
+                    out.add(s)
+            elif isinstance(node, ast.Compare) and any(
+                    isinstance(op, (ast.In, ast.NotIn)) for op in node.ops):
+                for operand in [node.left, *node.comparators]:
+                    s = const_str(operand)
+                    if s is not None:
+                        out.add(s)
+    return out
+
+
+def _dataclasses(mod: ModuleInfo) -> dict[str, list[str]]:
+    """dataclass name -> ordered field names (AnnAssign order)."""
+    out: dict[str, list[str]] = {}
+    for node in mod.tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        deco_names = set()
+        for d in node.decorator_list:
+            target = d.func if isinstance(d, ast.Call) else d
+            if isinstance(target, ast.Attribute):
+                deco_names.add(target.attr)
+            elif isinstance(target, ast.Name):
+                deco_names.add(target.id)
+        if "dataclass" not in deco_names:
+            continue
+        fields = [s.target.id for s in node.body
+                  if isinstance(s, ast.AnnAssign)
+                  and isinstance(s.target, ast.Name)]
+        if fields:
+            out[node.name] = fields
+    return out
+
+
+def check(mod: ModuleInfo, project: Mapping[str, ModuleInfo]) -> list[Finding]:
+    if not mod.path.endswith(WIRE_PATH):
+        return []
+    findings: list[Finding] = []
+    top = _top_functions(mod)
+
+    # 1. encode_X <-> decode_X name pairing (public functions only).
+    enc_bases = {f.name[len("encode_"):]: f for f in top
+                 if f.name.startswith("encode_")}
+    dec_bases = {f.name[len("decode_"):]: f for f in top
+                 if f.name.startswith("decode_")}
+    for base, fn in enc_bases.items():
+        if not any(d == base or d.startswith(base + "_") for d in dec_bases):
+            findings.append(Finding(
+                RULE, mod.path, fn.lineno,
+                f"encode_{base} has no matching decode_{base} — one-way "
+                f"wire format"))
+    for base, fn in dec_bases.items():
+        if not any(base == e or base.startswith(e + "_") for e in enc_bases):
+            findings.append(Finding(
+                RULE, mod.path, fn.lineno,
+                f"decode_{base} has no matching encode_{base}"))
+
+    # 2. keys written by encoders must be read by some decode-side fn.
+    enc_fns = [f for f in top if "encode" in f.name]
+    dec_fns = [f for f in top if _is_decode_fn(f.name)]
+    reads = _read_keys(dec_fns)
+    seen: set[str] = set()
+    for key, lineno in _written_keys(enc_fns):
+        if key not in reads and key not in seen:
+            seen.add(key)
+            findings.append(Finding(
+                RULE, mod.path, lineno,
+                f"encode-side key '{key}' is never read by any decode "
+                f"function — silently dropped at the far end"))
+
+    # 3. dataclass field coverage: fields the encoders read must be
+    # reconstructible on the decode side (the Pair.key class).
+    result_mod = next((m for p, m in project.items()
+                       if p.endswith(RESULT_PATH)), None)
+    if result_mod is None:
+        return findings
+    classes = _dataclasses(result_mod)
+    enc_attr_reads = {node.attr for fn in enc_fns for node in ast.walk(fn)
+                      if isinstance(node, ast.Attribute)
+                      and isinstance(node.ctx, ast.Load)}
+    # constructor call sites per class on the decode side
+    sites: dict[str, list[tuple[int, set[str]]]] = {}
+    for fn in dec_fns:
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in classes):
+                continue
+            fields = classes[node.func.id]
+            provided = {fields[i] for i in range(min(len(node.args),
+                                                     len(fields)))}
+            provided |= {kw.arg for kw in node.keywords if kw.arg}
+            sites.setdefault(node.func.id, []).append((node.lineno, provided))
+    for cname, call_sites in sites.items():
+        covered = set().union(*(p for _, p in call_sites))
+        for f in classes[cname]:
+            if f in covered or f not in enc_attr_reads:
+                continue
+            for lineno, _ in call_sites:
+                findings.append(Finding(
+                    RULE, mod.path, lineno,
+                    f"{cname}.{f} is read by the encode side but no "
+                    f"decode-side {cname}(...) ever passes it — the "
+                    f"field dies crossing the wire (the Pair.key bug)"))
+    return findings

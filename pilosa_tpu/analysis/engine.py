@@ -1,0 +1,165 @@
+"""AST visitor engine: file discovery, pragma suppression, rule runner.
+
+A checker is a module exposing ``RULE`` (the pragma name) and
+``check(mod, project)`` returning ``list[Finding]``; ``project`` maps
+logical paths to every analyzed ModuleInfo so cross-module rules
+(wire-symmetry reads the result dataclasses) can look siblings up.
+
+Pragmas: ``# analysis: ignore[rule-a, rule-b]`` suppresses those rules
+on that line; placed on a ``def`` line (anywhere in the signature,
+through the closing paren) it suppresses for the whole function body.
+Every pragma is expected to carry an inline justification after ``--``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import pathlib
+import re
+from collections.abc import Iterable, Mapping
+
+PRAGMA_RE = re.compile(r"#\s*analysis:\s*ignore\[([A-Za-z0-9_\-, ]+)\]")
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str
+    lineno: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.lineno}: [{self.rule}] {self.message}"
+
+
+class ModuleInfo:
+    """One parsed source file: AST, source lines, pragma map, and the
+    function-signature intervals used for def-level suppression."""
+
+    def __init__(self, path: str, source: str):
+        self.path = str(pathlib.PurePosixPath(path))
+        self.source = source
+        self.tree = ast.parse(source, filename=self.path)
+        self.lines = source.splitlines()
+        #: lineno -> set of rule names suppressed on that line
+        self.pragmas: dict[int, set[str]] = {}
+        for i, line in enumerate(self.lines, 1):
+            m = PRAGMA_RE.search(line)
+            if m:
+                self.pragmas[i] = {r.strip() for r in m.group(1).split(",")
+                                   if r.strip()}
+        # (sig_start, sig_end, body_end) per def: a pragma anywhere in
+        # the signature suppresses findings across the whole body.
+        self._defs: list[tuple[int, int, int]] = []
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                sig_end = (node.body[0].lineno - 1 if node.body
+                           else node.end_lineno or node.lineno)
+                self._defs.append((node.lineno, max(node.lineno, sig_end),
+                                   node.end_lineno or node.lineno))
+
+    def suppressed(self, rule: str, lineno: int) -> bool:
+        if rule in self.pragmas.get(lineno, ()):
+            return True
+        for sig_start, sig_end, body_end in self._defs:
+            if sig_start <= lineno <= body_end:
+                for ln in range(sig_start, sig_end + 1):
+                    if rule in self.pragmas.get(ln, ()):
+                        return True
+        return False
+
+
+# -- shared AST helpers ------------------------------------------------------
+
+
+def dotted_name(node: ast.expr) -> str | None:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> str | None:
+    return dotted_name(call.func)
+
+
+def const_str(node: ast.expr) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def functions(tree: ast.AST) -> Iterable[ast.FunctionDef | ast.AsyncFunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def shallow_walk(fn: ast.AST) -> Iterable[ast.AST]:
+    """``ast.walk`` that does not descend into nested function/class
+    definitions — for rules where scope boundaries matter (a closure's
+    finally is not the enclosing function's finally)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+# -- discovery + runner ------------------------------------------------------
+
+
+def repo_root() -> pathlib.Path:
+    return pathlib.Path(__file__).resolve().parents[2]
+
+
+def iter_source_files(root: pathlib.Path) -> Iterable[pathlib.Path]:
+    pkg = root / "pilosa_tpu"
+    for p in sorted(pkg.rglob("*.py")):
+        if "__pycache__" in p.parts:
+            continue
+        yield p
+
+
+def load_project(root: pathlib.Path | None = None) -> dict[str, ModuleInfo]:
+    root = root or repo_root()
+    project: dict[str, ModuleInfo] = {}
+    for p in iter_source_files(root):
+        logical = p.relative_to(root).as_posix()
+        project[logical] = ModuleInfo(logical, p.read_text())
+    return project
+
+
+def run_analysis(
+    project: Mapping[str, ModuleInfo] | None = None,
+    rules: Iterable[str] | None = None,
+) -> tuple[list[Finding], int]:
+    """Run every registered checker over ``project``; returns
+    ``(findings, suppressed_count)`` with pragma-suppressed findings
+    filtered out (and counted)."""
+    from pilosa_tpu.analysis.checkers import ALL_CHECKERS
+
+    if project is None:
+        project = load_project()
+    wanted = set(rules) if rules is not None else None
+    findings: list[Finding] = []
+    suppressed = 0
+    for checker in ALL_CHECKERS:
+        if wanted is not None and checker.RULE not in wanted:
+            continue
+        for mod in project.values():
+            for f in checker.check(mod, project):
+                if mod.suppressed(f.rule, f.lineno):
+                    suppressed += 1
+                else:
+                    findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.lineno, f.rule))
+    return findings, suppressed

@@ -1,0 +1,269 @@
+"""Runtime lock-order witness: instrumented Lock/RLock that records the
+global lock-acquisition graph and flags cycles.
+
+The Go reference gets this from ``-race`` plus deadlock-detector
+builds; here a test-mode wrapper does the half we can: if thread A ever
+acquires site-X-then-site-Y while some path acquires site-Y-then-
+site-X, those two orders can interleave into a deadlock even if the
+test run never actually deadlocked. Aimed at the breaker / hedge-pool /
+coalescer / WAL-group-commit lock web.
+
+Locks are keyed by ALLOCATION SITE (``file:line`` of the factory
+call), not instance, so an order between two lock *roles* is learned
+from any pair of instances. Two consequences, both deliberate:
+
+* same-site edges are skipped — per-fragment sibling locks acquired
+  together (shard loops) would otherwise self-cycle; ordering *within*
+  one allocation site is out of scope for this witness;
+* non-blocking ``acquire(False)`` records no edge — trylock cannot
+  deadlock, and breaker-style opportunistic paths would otherwise FP.
+
+Enable via ``PILOSA_TPU_WITNESS=1`` (tests/conftest.py installs the
+wrapper before product imports run); ``install()`` monkeypatches the
+``threading.Lock``/``threading.RLock`` factories, so only locks created
+afterwards are witnessed — which covers everything tests construct.
+
+The RLock wrapper implements the ``_release_save``/``_acquire_restore``/
+``_is_owned`` protocol so ``threading.Condition`` (with or without an
+explicit lock) keeps working on witnessed locks.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+
+
+def enabled() -> bool:
+    return os.environ.get("PILOSA_TPU_WITNESS") == "1"
+
+
+def _call_site() -> str:
+    """file:line of the nearest frame outside this module."""
+    f = sys._getframe(1)
+    while f is not None and f.f_globals.get("__name__") == __name__:
+        f = f.f_back
+    if f is None:
+        return "<unknown>"
+    path = f.f_code.co_filename
+    parts = path.replace("\\", "/").rsplit("/", 3)
+    return f"{'/'.join(parts[-3:])}:{f.f_lineno}"
+
+
+class WitnessViolation(AssertionError):
+    """A lock-order cycle was observed (potential deadlock)."""
+
+
+class LockWitness:
+    """The shared acquisition graph + per-thread held stacks."""
+
+    def __init__(self) -> None:
+        self._graph: dict[str, set[str]] = {}
+        self._meta = _REAL_LOCK()
+        self._held = threading.local()
+        self.violations: list[str] = []
+
+    # -- factories (drop-in for threading.Lock / threading.RLock) ------
+
+    def Lock(self):  # noqa: N802 - mirrors threading.Lock
+        return _WitnessLock(self, _call_site())
+
+    def RLock(self):  # noqa: N802 - mirrors threading.RLock
+        return _WitnessRLock(self, _call_site())
+
+    # -- bookkeeping ----------------------------------------------------
+
+    def _stack(self) -> list[str]:
+        st = getattr(self._held, "stack", None)
+        if st is None:
+            st = self._held.stack = []
+        return st
+
+    def _note_edges(self, site: str) -> None:
+        """Record held-site -> site edges for a blocking acquire and
+        flag any cycle the new edges close."""
+        st = self._stack()
+        if not st:
+            return
+        with self._meta:
+            for prev in st:
+                if prev == site:
+                    continue
+                succ = self._graph.setdefault(prev, set())
+                if site in succ:
+                    continue
+                succ.add(site)
+                path = self._find_path(site, prev)
+                if path is not None:
+                    cycle = " -> ".join([prev, *path])
+                    self.violations.append(
+                        f"lock-order cycle: {cycle} (edge {prev} -> "
+                        f"{site} closed it)")
+
+    def _find_path(self, start: str, goal: str) -> list[str] | None:
+        stack = [(start, [start])]
+        seen = {start}
+        while stack:
+            node, path = stack.pop()
+            if node == goal:
+                return path
+            for nxt in self._graph.get(node, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, [*path, nxt]))
+        return None
+
+    def _push(self, site: str) -> None:
+        self._stack().append(site)
+
+    def _pop(self, site: str) -> None:
+        st = self._stack()
+        for i in range(len(st) - 1, -1, -1):
+            if st[i] == site:
+                del st[i]
+                return
+
+    def check(self) -> None:
+        if self.violations:
+            raise WitnessViolation("\n".join(self.violations))
+
+
+class _WitnessLock:
+    """threading.Lock stand-in. No ``_release_save`` on purpose:
+    Condition detects its absence and falls back to plain
+    acquire/release, which routes through the witness."""
+
+    def __init__(self, witness: LockWitness, site: str):
+        self._w = witness
+        self._site = site
+        self._lock = _REAL_LOCK()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if blocking:
+            self._w._note_edges(self._site)
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            self._w._push(self._site)
+        return ok
+
+    def release(self) -> None:
+        self._lock.release()
+        self._w._pop(self._site)
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def _at_fork_reinit(self) -> None:
+        self._lock._at_fork_reinit()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<WitnessLock {self._site} {self._lock!r}>"
+
+
+class _WitnessRLock:
+    """threading.RLock stand-in; re-entrant acquires record no edges
+    (the order was established by the outermost acquire)."""
+
+    def __init__(self, witness: LockWitness, site: str):
+        self._w = witness
+        self._site = site
+        self._lock = _REAL_RLOCK()
+        self._count = 0
+        self._owner: int | None = None
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        me = threading.get_ident()
+        # _owner == me can only be true if WE hold it, so the unlocked
+        # read is safe; any other value means this is a first acquire.
+        if blocking and self._owner != me:
+            self._w._note_edges(self._site)
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            self._owner = me
+            self._count += 1
+            if self._count == 1:
+                self._w._push(self._site)
+        return ok
+
+    def release(self) -> None:
+        if self._owner != threading.get_ident():
+            raise RuntimeError("cannot release un-acquired lock")
+        self._count -= 1
+        last = self._count == 0
+        if last:
+            self._owner = None
+            self._w._pop(self._site)
+        self._lock.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def _at_fork_reinit(self) -> None:
+        self._lock._at_fork_reinit()
+        self._count = 0
+        self._owner = None
+
+    # -- threading.Condition protocol ----------------------------------
+
+    def _release_save(self):
+        state = (self._count, self._owner)
+        self._count = 0
+        self._owner = None
+        self._w._pop(self._site)
+        return (state, self._lock._release_save())
+
+    def _acquire_restore(self, token) -> None:
+        state, inner = token
+        self._lock._acquire_restore(inner)
+        self._count, self._owner = state
+        self._w._push(self._site)
+
+    def _is_owned(self) -> bool:
+        return self._lock._is_owned()
+
+    def __repr__(self) -> str:
+        return f"<WitnessRLock {self._site} count={self._count}>"
+
+
+_installed: LockWitness | None = None
+
+
+def install() -> LockWitness:
+    """Patch the threading.Lock/RLock factories; idempotent."""
+    global _installed
+    if _installed is None:
+        w = LockWitness()
+        threading.Lock = w.Lock  # type: ignore[assignment]
+        threading.RLock = w.RLock  # type: ignore[assignment]
+        _installed = w
+    return _installed
+
+
+def uninstall() -> LockWitness | None:
+    """Restore the real factories; returns the retired witness (its
+    graph/violations stay readable). Already-created witnessed locks
+    keep working — they wrap real locks."""
+    global _installed
+    threading.Lock = _REAL_LOCK  # type: ignore[assignment]
+    threading.RLock = _REAL_RLOCK  # type: ignore[assignment]
+    w, _installed = _installed, None
+    return w
+
+
+def current() -> LockWitness | None:
+    return _installed

@@ -41,7 +41,7 @@ def _verify_wal_bytes(data: bytes) -> dict:
     consumed = 0
     from pilosa_tpu.storage.wal import _HEADER
     off = 0
-    for code, rows, cols in iter_wal_records(data):
+    for _code, rows, cols in iter_wal_records(data):
         ops += 1
         off += _HEADER.size + 8 * (len(rows) + len(cols))
     consumed = off

@@ -11,10 +11,13 @@ string, so steady-state queries pay a single attribute read.
 
 from __future__ import annotations
 
+from collections.abc import Iterable
+from typing import Any
 
-def plan_signature(query) -> str:
+
+def plan_signature(query: Any) -> str:
     """Canonical text of a parsed ``pql.ast.Query``."""
-    sig = getattr(query, "_plan_signature", None)
+    sig: str | None = getattr(query, "_plan_signature", None)
     if sig is None:
         sig = ";".join(str(c) for c in query.calls)
         try:
@@ -24,7 +27,8 @@ def plan_signature(query) -> str:
     return sig
 
 
-def cache_key(idx, query, shards, opt) -> tuple:
+def cache_key(idx: Any, query: Any, shards: Iterable[int],
+              opt: Any) -> tuple[object, ...]:
     """Full result-cache key: identity of the index instance (epoch
     counters restart on delete/recreate), the canonical plan, the shard
     set the plan runs over, and every ExecOptions flag that changes the

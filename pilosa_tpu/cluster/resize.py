@@ -279,7 +279,7 @@ class ResizeJob:
     #: fleets tune it down via PILOSA_TPU_RESIZE_ACK_TIMEOUT.
     try:
         ACK_TIMEOUT = float(
-            os.environ.get("PILOSA_TPU_RESIZE_ACK_TIMEOUT", 600.0))
+            os.environ.get("PILOSA_TPU_RESIZE_ACK_TIMEOUT", "600"))
     except ValueError:  # malformed env must not make this module (and
         # with it the whole membership control plane) unimportable
         import sys as _sys
@@ -546,7 +546,7 @@ def check_nodes(cluster: Cluster, client, retries: int = 2,
                 # failures behind this suspect.
                 from concurrent.futures import ThreadPoolExecutor
                 with ThreadPoolExecutor(len(picked)) as pool:
-                    def ask(via):
+                    def ask(via, node=node):
                         try:
                             return client.indirect_probe(via, node)
                         except (ConnectionError, OSError, RuntimeError):

@@ -16,7 +16,8 @@ _DEFAULT_EXPONENT = 20
 # Capped at 30 (reference goes to 32): count kernels accumulate per-row in
 # int32, which holds up to 2^31-1 set bits — one 2^30-bit row can never
 # overflow it, 2^31+ could.
-_exp = int(os.environ.get("PILOSA_TPU_SHARD_WIDTH_EXP", _DEFAULT_EXPONENT))
+_exp = int(os.environ.get("PILOSA_TPU_SHARD_WIDTH_EXP",
+                          str(_DEFAULT_EXPONENT)))
 if not (16 <= _exp <= 30):
     raise ValueError("PILOSA_TPU_SHARD_WIDTH_EXP must be in [16, 30]")
 

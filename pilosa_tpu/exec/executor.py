@@ -1326,7 +1326,7 @@ class Executor:
         # once a level can't resume exactly at its previous row, deeper
         # levels restart from the beginning.
         prev: list[int | None] = []
-        for i, child in enumerate(c.children):
+        for child in c.children:
             p, has_p = child.uint_arg("previous")
             prev.append(p if has_p else None)
         any_prev = any(p is not None for p in prev)
@@ -1485,7 +1485,7 @@ class Executor:
 
         def map_fn(shard):
             changed = False
-            for view_name, v in list(f.views.items()):
+            for _view_name, v in list(f.views.items()):
                 frag = v.fragment(shard)
                 if frag is not None:
                     changed |= frag.clear_row(row_id)

@@ -72,11 +72,15 @@ _fused_steps: contextvars.ContextVar[int] = contextvars.ContextVar(
     "pilosa_tpu_fused_steps", default=0)
 
 
-def reset_fused_steps() -> None:
+def reset_fused_steps() -> None:  # analysis: ignore[contextvar-hygiene]
+    # -- tokenless by design: this is a per-query ACCUMULATOR, zeroed at
+    # query entry, not scoped state restored on exit; the default (0) is
+    # also the reset value, so a leak is indistinguishable from fresh.
     _fused_steps.set(0)
 
 
-def add_fused_steps(n: int) -> None:
+def add_fused_steps(n: int) -> None:  # analysis: ignore[contextvar-hygiene]
+    # -- tokenless by design: see reset_fused_steps above.
     if n:
         _fused_steps.set(_fused_steps.get() + int(n))
 

@@ -59,9 +59,8 @@ class MemoryStats:
             self.timings = _parent.timings
 
     def with_tags(self, *tags: str) -> "MemoryStats":
-        child = MemoryStats(tuple(sorted(set(self.tags) | set(tags))),
-                            _parent=self)
-        return child
+        return MemoryStats(tuple(sorted(set(self.tags) | set(tags))),
+                           _parent=self)
 
     def count(self, name: str, value: int = 1, rate: float = 1.0) -> None:
         with self._lock:

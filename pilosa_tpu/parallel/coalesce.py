@@ -362,7 +362,7 @@ class DispatchCoalescer:
                         continue
                     try:
                         per = host if slot is None else \
-                            jax.tree_util.tree_map(lambda a: a[i], host)
+                            jax.tree_util.tree_map(lambda a, i=i: a[i], host)
                         fut.set_result(post(per))
                     except Exception as e:
                         fut.set_exception(e)

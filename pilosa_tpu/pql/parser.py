@@ -9,7 +9,7 @@ back to the generic ``IDENT(allargs)`` rule, exactly like PEG backtracking.
 from __future__ import annotations
 
 import re
-from typing import Any
+from typing import Any, Callable, NoReturn
 
 from pilosa_tpu.pql.ast import (
     BETWEEN, EQ, GT, GTE, LT, LTE, NEQ, Call, Condition, Query,
@@ -17,7 +17,7 @@ from pilosa_tpu.pql.ast import (
 
 
 class ParseError(Exception):
-    def __init__(self, msg: str, pos: int = -1):
+    def __init__(self, msg: str, pos: int = -1) -> None:
         super().__init__(f"parse error at {pos}: {msg}" if pos >= 0 else msg)
         self.pos = pos
 
@@ -43,13 +43,13 @@ DUPLICATE_ARG_ERROR = "duplicate argument provided"
 
 
 class _Parser:
-    def __init__(self, src: str):
+    def __init__(self, src: str) -> None:
         self.src = src
         self.pos = 0
 
     # -- low-level ---------------------------------------------------------
 
-    def error(self, msg: str):
+    def error(self, msg: str) -> NoReturn:
         raise ParseError(msg, self.pos)
 
     def eof(self) -> bool:
@@ -58,7 +58,7 @@ class _Parser:
     def peek(self) -> str:
         return self.src[self.pos] if self.pos < len(self.src) else ""
 
-    def sp(self):
+    def sp(self) -> None:
         while self.pos < len(self.src) and self.src[self.pos] in " \t\n\r":
             self.pos += 1
 
@@ -68,11 +68,11 @@ class _Parser:
             return True
         return False
 
-    def expect(self, s: str):
+    def expect(self, s: str) -> None:
         if not self.lit(s):
             self.error(f"expected {s!r}")
 
-    def rx(self, pattern: re.Pattern) -> str | None:
+    def rx(self, pattern: re.Pattern[str]) -> str | None:
         m = pattern.match(self.src, self.pos)
         if m is None:
             return None
@@ -88,11 +88,11 @@ class _Parser:
         self.pos = save
         return False
 
-    def open(self):
+    def open(self) -> None:
         self.expect("(")
         self.sp()
 
-    def close(self):
+    def close(self) -> None:
         self.sp()
         self.expect(")")
 
@@ -130,7 +130,8 @@ class _Parser:
         name = self.rx(_IDENT_RE)
         if name is None:
             self.error("expected call name")
-        special = getattr(self, f"_call_{name}", None)
+        special: Callable[[], Call] | None = getattr(
+            self, f"_call_{name}", None)
         if special is not None:
             try:
                 return special()
@@ -268,13 +269,13 @@ class _Parser:
 
     # - positional helpers -
 
-    def _pos_col(self, call: Call):
+    def _pos_col(self, call: Call) -> None:
         self._pos_arg(call, "_col")
 
-    def _pos_row(self, call: Call):
+    def _pos_row(self, call: Call) -> None:
         self._pos_arg(call, "_row")
 
-    def _pos_arg(self, call: Call, key: str):
+    def _pos_arg(self, call: Call, key: str) -> None:
         u = self.rx(_UINT_RE)
         if u is not None:
             call.args[key] = int(u)
@@ -305,7 +306,7 @@ class _Parser:
 
     # - args -
 
-    def allargs(self, call: Call):
+    def allargs(self, call: Call) -> None:
         """allargs <- Call (comma Call)* (comma args)? / args / sp"""
         save = self.pos
         m = _IDENT_RE.match(self.src, self.pos)
@@ -348,7 +349,7 @@ class _Parser:
         if self.peek() not in (")", ""):
             self.args(call)
 
-    def args(self, call: Call):
+    def args(self, call: Call) -> None:
         """args <- arg (comma args)? sp"""
         self.arg(call)
         while True:
@@ -369,7 +370,7 @@ class _Parser:
                 break
         self.sp()
 
-    def arg(self, call: Call):
+    def arg(self, call: Call) -> None:
         # conditional: int <(=) field <(=) int
         save = self.pos
         cond = self._try_conditional()
@@ -428,7 +429,7 @@ class _Parser:
             high -= 1
         return field, Condition(BETWEEN, [low, high])
 
-    def _set_arg(self, call: Call, key: str, value: Any):
+    def _set_arg(self, call: Call, key: str, value: Any) -> None:
         if key in call.args:
             raise SemanticError(f"{DUPLICATE_ARG_ERROR}: {key}", self.pos)
         call.args[key] = value
@@ -493,7 +494,7 @@ class _Parser:
             return self._quoted('"')
         if self.lit("'"):
             return self._quoted("'")
-        self.error("expected value")
+        self.error("expected value")  # noqa: RET503 - error() is NoReturn
 
 
 def parse(src: str) -> Query:

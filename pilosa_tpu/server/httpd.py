@@ -213,7 +213,7 @@ def _make_handler(api: API):
                     if token is not None:
                         _tr.reset_current_trace(token)
                 return self._reply(status, payload, headers)
-            self._reply(404, {"error": "not found"})
+            return self._reply(404, {"error": "not found"})
 
         def _handle_import_stream(self):
             """POST /internal/import-stream: length-prefixed PTI1 frames
@@ -1098,7 +1098,7 @@ def _build_routes(api: API):
         try:
             pair = capture_fragment(store, key)
         except LookupError:
-            raise FragmentNotFoundError()
+            raise FragmentNotFoundError() from None
         import base64
         return 200, {
             "snap": (base64.b64encode(pair["snap"]).decode()

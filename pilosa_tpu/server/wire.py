@@ -82,7 +82,10 @@ def decode_result(d: dict) -> Any:
                     for (i, c), k in zip(d["items"], keys)]
         return [Pair(id=i, count=c) for i, c in d["items"]]
     if t == "groupcounts":
-        return [GroupCount(group=[FieldRow(field=f, row_id=rid)
+        # FieldRow.row_key deliberately does not cross the wire: group
+        # keys are translated ONCE, coordinator-side, after the reduce
+        # (exec/executor.py), so remote legs ship ids only.
+        return [GroupCount(group=[FieldRow(field=f, row_id=rid)  # analysis: ignore[wire-symmetry]
                                   for f, rid in item["group"]],
                            count=item["count"])
                 for item in d["items"]]
@@ -456,8 +459,10 @@ def decode_frames(data: bytes) -> list[Any]:
                 if len(counts) != n or len(rows) != n * len(fields):
                     raise ValueError("groupcounts frame shape mismatch")
                 d = len(fields)
+                # row_key stays off the wire by design — see the
+                # decode_result groupcounts branch.
                 out.append([
-                    GroupCount(group=[FieldRow(field=f,
+                    GroupCount(group=[FieldRow(field=f,  # analysis: ignore[wire-symmetry]
                                                row_id=int(rows[i * d + j]))
                                       for j, f in enumerate(fields)],
                                count=int(counts[i]))

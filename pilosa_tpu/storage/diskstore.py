@@ -606,8 +606,13 @@ class DiskStore:
                 f.row_attr_store.save()
                 f.translate_store.save()
 
-    def _attach_paths_for_new_objects(self) -> None:
-        """Objects created after open() need their stores path-bound."""
+    def _attach_paths_for_new_objects(self) -> None:  # analysis: ignore[epoch-audit]
+        """Objects created after open() need their stores path-bound.
+
+        The ``store._attrs = ...`` writes below rebind a fresh
+        path-bound AttrStore to the SAME live dict the old store held —
+        contents are bit-identical before and after, so no epoch-visible
+        state changes and no bump is owed (pragma above)."""
         for iname in self.holder.index_names():
             idx = self.holder.index(iname)
             idir = os.path.join(self.data_dir, iname)

@@ -25,6 +25,26 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# Opt-in runtime lock-order witness (analysis/witness.py): installed
+# HERE — after jax (its internal locks are not ours to audit) and
+# before any pilosa_tpu module is imported by test collection — so
+# every lock the product creates during the suite is witnessed. CI
+# wires PILOSA_TPU_WITNESS=1 into the overload/chaos jobs.
+_witness = None
+if os.environ.get("PILOSA_TPU_WITNESS") == "1":
+    from pilosa_tpu.analysis import witness as _witness_mod  # noqa: E402
+
+    _witness = _witness_mod.install()
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _lock_order_witness():
+    """Fail the session if the suite ever acquired two lock sites in
+    both orders — a latent deadlock even when this run got lucky."""
+    yield
+    if _witness is not None:
+        _witness.check()
+
 
 @pytest.fixture
 def rng():

@@ -1,0 +1,553 @@
+"""Self-tests for the invariant analyzer suite (pilosa_tpu/analysis/).
+
+Each checker gets a positive fixture — a mutated copy of the historical
+bug it encodes (CHANGES.md catalog) — and a negative (clean) fixture,
+plus pragma-suppression coverage. The capstone test runs the whole
+suite over the real tree and demands zero findings: the analyzer's CI
+contract, exercised as a tier-1 test.
+"""
+
+import textwrap
+import threading
+import time
+
+import pytest
+
+from pilosa_tpu.analysis import witness as witness_mod
+from pilosa_tpu.analysis.checkers import (
+    contextvar_hygiene,
+    epoch_audit,
+    executor_lifecycle,
+    jit_purity,
+    shared_return,
+    wire_symmetry,
+)
+from pilosa_tpu.analysis.engine import ModuleInfo, load_project, run_analysis
+
+
+def run_rule(checker, src, path="pilosa_tpu/mod.py", extra=None):
+    mod = ModuleInfo(path, textwrap.dedent(src))
+    project = {path: mod}
+    for p, s in (extra or {}).items():
+        project[p] = ModuleInfo(p, textwrap.dedent(s))
+    return [f for f in checker.check(mod, project)
+            if not mod.suppressed(f.rule, f.lineno)]
+
+
+# -- epoch-audit -------------------------------------------------------------
+
+FRAGMENT_BUG = """
+class Fragment:
+    def __init__(self):
+        self.rows = {}
+        self.epoch = object()
+
+    def set_bit(self, row_id, pos):
+        hr = self.rows.get(row_id)
+        if hr is None:
+            self.rows[row_id] = hr = set()
+        hr.add(pos)
+        return True
+
+    def clear_row(self, row_id):
+        self.rows.pop(row_id, None)
+        self._invalidate()
+
+    def _invalidate(self):
+        self.epoch.bump(shard=0)
+"""
+
+
+def test_epoch_audit_catches_silent_bump_skip():
+    # The historical stale-result-cache bug: a mutator that writes
+    # Fragment.rows without reaching _invalidate/bump.
+    fs = run_rule(epoch_audit, FRAGMENT_BUG, path="pilosa_tpu/core/fragment.py")
+    assert len(fs) == 1 and "set_bit" in fs[0].message
+    assert fs[0].rule == "epoch-audit"
+
+
+def test_epoch_audit_passes_bumping_mutators():
+    clean = FRAGMENT_BUG.replace("return True",
+                                 "self._invalidate()\n        return True")
+    assert run_rule(epoch_audit, clean,
+                    path="pilosa_tpu/core/fragment.py") == []
+
+
+def test_epoch_audit_delegated_bump_fixed_point():
+    src = """
+    class TranslateStore:
+        def __init__(self):
+            self._fwd = {}
+
+        def translate_key(self, k):
+            self._fwd[k] = len(self._fwd)
+            self._dirty()
+
+        def _dirty(self):
+            self._mark()
+
+        def _mark(self):
+            self.epoch.bump()
+    """
+    assert run_rule(epoch_audit, src,
+                    path="pilosa_tpu/core/translate.py") == []
+
+
+def test_epoch_audit_init_only_helpers_exempt():
+    src = """
+    class TranslateStore:
+        def __init__(self):
+            self._fwd = {}
+            self._load()
+
+        def _load(self):
+            self._fwd["boot"] = 0
+    """
+    assert run_rule(epoch_audit, src,
+                    path="pilosa_tpu/core/translate.py") == []
+
+
+def test_epoch_audit_out_of_scope_module_ignored():
+    assert run_rule(epoch_audit, FRAGMENT_BUG,
+                    path="pilosa_tpu/server/api.py") == []
+
+
+# -- shared-mutable-return ---------------------------------------------------
+
+SHARED_RETURN_BUG = """
+class ResultCache:
+    def __init__(self):
+        self._groups = []
+
+    def groups(self):
+        return self._groups
+
+    def snapshot(self):
+        return list(self._groups)
+
+    def _raw(self):
+        return self._groups
+"""
+
+
+def test_shared_return_catches_uncopied_attr():
+    # The GroupBy-merge aliasing bug: a public method handing out the
+    # live cached list that merge_group_counts then extended in place.
+    fs = run_rule(shared_return, SHARED_RETURN_BUG)
+    assert len(fs) == 1 and "groups" in fs[0].message
+    assert fs[0].rule == "shared-mutable-return"
+
+
+def test_shared_return_copies_and_private_helpers_pass():
+    fs = run_rule(shared_return, SHARED_RETURN_BUG)
+    assert all("snapshot" not in f.message and "_raw" not in f.message
+               for f in fs)
+
+
+# -- wire-symmetry -----------------------------------------------------------
+
+RESULT_DATACLASSES = """
+from dataclasses import dataclass
+
+@dataclass
+class Pair:
+    id: int = 0
+    count: int = 0
+    key: str = ""
+"""
+
+PAIR_KEY_BUG = """
+def encode_result(r):
+    return {"t": "pair", "id": r.id, "count": r.count, "key": r.key}
+
+def decode_result(d):
+    if d["t"] != "pair":
+        raise ValueError(d)
+    return Pair(id=d["id"], count=d["count"])
+"""
+
+
+def test_wire_symmetry_catches_pair_key_drop():
+    # The Pair.key bug verbatim: the key is serialized but the decoder
+    # reconstructs Pairs without it — keyed TopN dies at the far end.
+    fs = run_rule(wire_symmetry, PAIR_KEY_BUG,
+                  path="pilosa_tpu/server/wire.py",
+                  extra={"pilosa_tpu/exec/result.py": RESULT_DATACLASSES})
+    assert any("Pair.key" in f.message for f in fs)
+    assert any("'key'" in f.message for f in fs)  # write-without-read too
+
+
+def test_wire_symmetry_symmetric_codec_passes():
+    src = PAIR_KEY_BUG.replace(
+        'count=d["count"])', 'count=d["count"], key=d.get("key", ""))')
+    assert run_rule(wire_symmetry, src, path="pilosa_tpu/server/wire.py",
+                    extra={"pilosa_tpu/exec/result.py":
+                           RESULT_DATACLASSES}) == []
+
+
+def test_wire_symmetry_catches_missing_decoder():
+    src = """
+    def encode_frames(results):
+        return b""
+    """
+    fs = run_rule(wire_symmetry, src, path="pilosa_tpu/server/wire.py")
+    assert len(fs) == 1 and "decode_frames" in fs[0].message
+
+
+def test_wire_symmetry_prefix_match_and_helpers_exempt():
+    src = """
+    def encode_frames(results):
+        return b""
+
+    def decode_frames(data):
+        return []
+
+    def decode_frames_meta(data):
+        return [], {}
+
+    def _encode_agg_frame(r):
+        return None
+    """
+    assert run_rule(wire_symmetry, src,
+                    path="pilosa_tpu/server/wire.py") == []
+
+
+def test_wire_symmetry_only_runs_on_wire_module():
+    assert run_rule(wire_symmetry, PAIR_KEY_BUG,
+                    path="pilosa_tpu/server/api.py") == []
+
+
+# -- jit-purity --------------------------------------------------------------
+
+JIT_IMPURE = """
+import functools
+import random
+import time
+
+import jax
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def kernel(x, n):
+    t0 = time.perf_counter()
+    return x + n
+
+def raw(x):
+    return x * random.random()
+
+vmapped = jax.jit(jax.vmap(raw))
+"""
+
+
+def test_jit_purity_catches_trace_time_side_effects():
+    fs = run_rule(jit_purity, JIT_IMPURE)
+    msgs = "\n".join(f.message for f in fs)
+    assert "kernel" in msgs and "time.perf_counter" in msgs
+    assert "raw" in msgs and "random.random" in msgs
+
+
+def test_jit_purity_pure_kernels_pass():
+    src = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def popcount(words):
+        return jnp.sum(words)
+    """
+    assert run_rule(jit_purity, src) == []
+
+
+def test_jit_purity_uncompiled_functions_unconstrained():
+    src = """
+    import time
+
+    def host_side():
+        return time.perf_counter()
+    """
+    assert run_rule(jit_purity, src) == []
+
+
+# -- contextvar-hygiene ------------------------------------------------------
+
+CONTEXTVAR_BUG = """
+import contextvars
+
+_current = contextvars.ContextVar("dl", default=None)
+
+def set_current_deadline(dl):
+    return _current.set(dl)
+
+def handle(req):
+    set_current_deadline(req.deadline)
+    return dispatch(req)
+"""
+
+
+def test_contextvar_hygiene_catches_unreset_token():
+    # The deadline-leak class: a served request's deadline bleeding into
+    # the next request on the same pool thread.
+    fs = run_rule(contextvar_hygiene, CONTEXTVAR_BUG)
+    assert len(fs) == 1 and "handle" in fs[0].message
+    assert fs[0].rule == "contextvar-hygiene"
+
+
+def test_contextvar_hygiene_finally_reset_passes():
+    src = CONTEXTVAR_BUG.replace(
+        """    set_current_deadline(req.deadline)
+    return dispatch(req)""",
+        """    token = set_current_deadline(req.deadline)
+    try:
+        return dispatch(req)
+    finally:
+        _current.reset(token)""")
+    assert run_rule(contextvar_hygiene, src) == []
+
+
+def test_contextvar_hygiene_tokens_list_pattern_passes():
+    src = """
+    import contextvars
+
+    _trace = contextvars.ContextVar("t", default=None)
+
+    def with_trace(fn):
+        tokens = [_trace.set("tid")]
+        try:
+            return fn()
+        finally:
+            for t in tokens:
+                _trace.reset(t)
+    """
+    assert run_rule(contextvar_hygiene, src) == []
+
+
+def test_contextvar_hygiene_token_returning_wrappers_exempt():
+    src = """
+    import contextvars
+
+    _prof = contextvars.ContextVar("p", default=None)
+
+    def activate(prof):
+        return _prof.set(prof)
+    """
+    assert run_rule(contextvar_hygiene, src) == []
+
+
+# -- executor-lifecycle ------------------------------------------------------
+
+UNJOINED_THREAD = """
+import threading
+
+class Flusher:
+    def start(self):
+        self._t = threading.Thread(target=self._run)
+        self._t.start()
+
+    def _run(self):
+        pass
+"""
+
+
+def test_executor_lifecycle_catches_unowned_worker():
+    fs = run_rule(executor_lifecycle, UNJOINED_THREAD)
+    assert len(fs) == 1 and "Thread" in fs[0].message
+    assert fs[0].rule == "executor-lifecycle"
+
+
+def test_executor_lifecycle_join_daemon_and_with_pass():
+    src = """
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    class Flusher:
+        def start(self):
+            self._t = threading.Thread(target=self._run)
+            self._t.start()
+
+        def close(self):
+            self._t.join()
+
+    def fire_and_forget():
+        threading.Thread(target=work, daemon=True).start()
+
+    def scoped(items):
+        with ThreadPoolExecutor(4) as pool:
+            return list(pool.map(work, items))
+    """
+    assert run_rule(executor_lifecycle, src) == []
+
+
+# -- engine: pragmas + the tree-is-clean contract ----------------------------
+
+def test_pragma_on_finding_line_suppresses():
+    src = UNJOINED_THREAD.replace(
+        "threading.Thread(target=self._run)",
+        "threading.Thread(target=self._run)"
+        "  # analysis: ignore[executor-lifecycle] -- test fixture")
+    assert run_rule(executor_lifecycle, src) == []
+
+
+def test_pragma_on_def_line_suppresses_whole_body():
+    src = UNJOINED_THREAD.replace(
+        "def start(self):",
+        "def start(self):  # analysis: ignore[executor-lifecycle] -- fixture")
+    assert run_rule(executor_lifecycle, src) == []
+
+
+def test_pragma_is_rule_scoped():
+    src = UNJOINED_THREAD.replace(
+        "def start(self):",
+        "def start(self):  # analysis: ignore[epoch-audit] -- wrong rule")
+    assert len(run_rule(executor_lifecycle, src)) == 1
+
+
+def test_tree_is_clean():
+    """The CI contract: zero unsuppressed findings on the real tree,
+    and every suppression is a deliberate, justified pragma."""
+    project = load_project()
+    findings, suppressed = run_analysis(project)
+    assert findings == [], "\n".join(str(f) for f in findings)
+    # pragma count only moves with conscious allowlisting decisions
+    assert suppressed <= 12, "pragma creep — justify or fix new findings"
+
+
+# -- witness lock-order checker ----------------------------------------------
+
+def test_witness_ordered_acquisition_clean():
+    w = witness_mod.LockWitness()
+    a = w.Lock()
+    b = w.RLock()
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert w.violations == []
+    w.check()
+
+
+def test_witness_detects_deliberate_inversion():
+    # The acceptance fixture: a test-only lock inversion must trip it.
+    w = witness_mod.LockWitness()
+    a = w.Lock()
+    b = w.Lock()
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    assert len(w.violations) == 1
+    assert "lock-order cycle" in w.violations[0]
+    with pytest.raises(witness_mod.WitnessViolation):
+        w.check()
+
+
+def test_witness_three_lock_cycle():
+    w = witness_mod.LockWitness()
+    # one allocation per line: the witness keys locks by call site
+    a = w.Lock()
+    b = w.Lock()
+    c = w.Lock()
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    assert w.violations == []
+    with c:
+        with a:
+            pass
+    assert len(w.violations) == 1
+
+
+def test_witness_rlock_reentrancy_not_an_edge():
+    w = witness_mod.LockWitness()
+    r = w.RLock()
+    lk = w.Lock()
+    with r:
+        with lk:
+            with r:  # re-entrant: must not record lk -> r
+                pass
+    with r:
+        pass
+    assert w.violations == []
+
+
+def test_witness_same_site_siblings_skipped():
+    w = witness_mod.LockWitness()
+    frags = [w.Lock() for _ in range(3)]  # one allocation site
+    with frags[0]:
+        with frags[1]:
+            with frags[2]:
+                pass
+    assert w.violations == []
+
+
+def test_witness_trylock_records_no_edges():
+    w = witness_mod.LockWitness()
+    a = w.Lock()
+    b = w.Lock()
+    with a:
+        assert b.acquire(False)
+        b.release()
+    with b:
+        assert a.acquire(False)
+        a.release()
+    assert w.violations == []
+
+
+def test_witness_condition_wait_notify():
+    w = witness_mod.LockWitness()
+    cv = threading.Condition(w.RLock())
+    got = []
+
+    def waiter():
+        with cv:
+            while not got:
+                cv.wait(timeout=5)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    with cv:
+        got.append(1)
+        cv.notify()
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert w.violations == []
+
+
+def test_witness_cross_thread_inversion_detected():
+    w = witness_mod.LockWitness()
+    a = w.Lock()
+    b = w.Lock()
+
+    def forward():
+        with a:
+            with b:
+                pass
+
+    t = threading.Thread(target=forward)
+    t.start()
+    t.join()
+    with b:
+        with a:
+            pass
+    assert len(w.violations) == 1
+
+
+def test_witness_install_uninstall_roundtrip():
+    if witness_mod.current() is not None:
+        pytest.skip("witness globally installed (PILOSA_TPU_WITNESS=1)")
+    real_lock, real_rlock = threading.Lock, threading.RLock
+    w = witness_mod.install()
+    try:
+        assert witness_mod.install() is w  # idempotent
+        lk = threading.Lock()
+        assert isinstance(lk, witness_mod._WitnessLock)
+        with lk:
+            pass
+    finally:
+        assert witness_mod.uninstall() is w
+    assert threading.Lock is real_lock and threading.RLock is real_rlock
+    assert witness_mod.current() is None

@@ -68,7 +68,7 @@ PREDICATES = [-128, -127, -101, -100, -99, -3, -2, -1, 0, 1, 2, 3, 99, 100, 101,
 
 @pytest.mark.parametrize("pred", PREDICATES)
 def test_range_lt_gt(bsi_frag, pred):
-    for op, allow_eq, oracle in [
+    for op, _allow_eq, oracle in [
         ("lt", False, lambda: ref_lt(VALUES, pred, False)),
         ("lte", True, lambda: ref_lt(VALUES, pred, True)),
         ("gt", False, lambda: ref_gt(VALUES, pred, False)),

@@ -344,7 +344,7 @@ class Soak:
         self.slowed.clear()
         for _ in range(3):  # act_restart fills at most one slot per call
             self.act_restart()
-        for i, p in list(self.procs.items()):
+        for i, _p in list(self.procs.items()):
             try:
                 _wait_up(self.addrs[i])
             except TimeoutError:
@@ -502,7 +502,7 @@ class Soak:
         raise AssertionError(f"never converged to oracle: {last!r}")
 
     def close(self):
-        for i, p in list(self.procs.items()):
+        for _i, p in list(self.procs.items()):
             try:
                 os.kill(p.pid, signal.SIGCONT)
             except Exception:

@@ -41,7 +41,7 @@ def test_poison_control_kills_plain_child(poisoned_env):
     # something.
     proc = subprocess.run([sys.executable, "-c", "print('alive')"],
                           env=dict(os.environ), capture_output=True,
-                          text=True, timeout=60)
+                          text=True, timeout=60, check=False)
     # CPython surfaces the sitecustomize SystemExit as a fatal
     # site-import error; any nonzero exit without our payload proves
     # the hook ran.
@@ -67,7 +67,8 @@ def test_hermetic_child_survives_poison(poisoned_env):
             "print('hermetic-ok')\n")
     proc = subprocess.run(cleanspawn.command(code),
                           env=cleanspawn.scrubbed_env(2),
-                          capture_output=True, text=True, timeout=300)
+                          capture_output=True, text=True, timeout=300,
+                          check=False)
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "hermetic-ok" in proc.stdout
 

@@ -89,7 +89,7 @@ def test_random_trees_match_model(seed):
     h, model, existing = _build(rng)
     fast = Executor(h, planner=MeshPlanner(h, make_mesh()))
     plain = Executor(h)
-    for i in range(40):
+    for _ in range(40):
         q, tree = _gen_tree(rng, depth=3)
         want = len(_eval_model(tree, model, existing))
         got_fast = fast.execute("g", f"Count({q})", cache=False)

@@ -28,6 +28,8 @@ from pilosa_tpu.qos import (
     AdaptiveLimit,
     AdmissionController,
     Deadline,
+    DeadlineExceededError,
+    QueryShedError,
     QuotaExceededError,
     TenantQuotas,
     reset_current_deadline,
@@ -92,7 +94,7 @@ def test_admission_gate_follows_adaptive_limit():
     assert ctl.snapshot()["limit"] == 1
     ctl.acquire(CLASS_INTERACTIVE)
     # second public request queues (would admit under the static gate)
-    with pytest.raises(Exception):
+    with pytest.raises((QueryShedError, DeadlineExceededError)):
         ctl.acquire(CLASS_INTERACTIVE, deadline=Deadline(timeout=0.05))
     # internal reserve is above the ceiling, not the adaptive value
     got = threading.Event()

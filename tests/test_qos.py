@@ -362,7 +362,7 @@ def test_http_overload_sheds_503_with_retry_after(qos_node):
     admitted = [r for r in results if r[0] == 200]
     shed = [r for r in results if r[0] == 503]
     assert len(shed) == n_requests - 3, results  # 1 active + 2 queued
-    for status, headers, _ in shed:
+    for _status, headers, _ in shed:
         assert int(headers["Retry-After"]) >= 1
     # admitted interactive latency stays bounded: worst case is 3
     # sequential 0.5s slots, nowhere near the unbounded-queue regime
@@ -449,8 +449,7 @@ def test_http_qos_class_param(qos_node):
 
 @pytest.fixture(scope="module")
 def jax_cpu():
-    jax = pytest.importorskip("jax")
-    return jax
+    return pytest.importorskip("jax")
 
 
 def test_warmup_precompiles_real_traffic_programs(jax_cpu):

@@ -158,7 +158,7 @@ def test_http_resize_remove_node():
                 f"http://{non_coord}/cluster/resize/remove-node",
                 data=json.dumps({"id": victim}).encode(), method="POST")
             urllib.request.urlopen(r, timeout=10)
-            assert False, "non-coordinator accepted a removal"
+            raise AssertionError("non-coordinator accepted a removal")
         except urllib.error.HTTPError as e:
             assert coord_id in e.read().decode()
         r = urllib.request.Request(
@@ -179,7 +179,7 @@ def test_http_resize_remove_node():
                 f"http://{victim}/index/i/query",
                 data=b"Count(Row(f=1))", method="POST")
             urllib.request.urlopen(r, timeout=10)
-            assert False, "removed node still serves queries"
+            raise AssertionError("removed node still serves queries")
         except urllib.error.HTTPError as e:
             assert e.code in (400, 405, 409, 503)
         nodes[[i for i, a in enumerate(addrs) if a == victim][0]].close()
@@ -837,7 +837,7 @@ def test_writes_racing_a_live_join_converge():
             col = i * SHARD_WIDTH // 4 + i  # spread over shards
             i += 1
             body = f"Set({col}, f=1)".encode()
-            for attempt in range(60):
+            for _attempt in range(60):
                 req = urllib.request.Request(base + "/index/i/query",
                                              data=body, method="POST")
                 try:

@@ -137,7 +137,7 @@ def _official_no_runs(containers):
     desc = b"".join(struct.pack("<HH", k, len(v) - 1)
                     for k, v in containers)
     payloads = []
-    for k, v in containers:
+    for _k, v in containers:
         if len(v) <= 4096:  # spec: arrays up to EXACTLY 4096 values
             payloads.append(np.asarray(v, dtype="<u2").tobytes())
         else:
@@ -255,7 +255,8 @@ def test_fuzz_loop_smoke():
         import pytest
         pytest.skip("no sanitizer toolchain")
     res = subprocess.run([os.path.join(root, "fuzz_roaring"), "5000"],
-                         capture_output=True, timeout=300, text=True)
+                         capture_output=True, timeout=300, text=True,
+                         check=False)
     assert res.returncode == 0, res.stderr[-2000:]
     assert "iterations clean" in res.stdout
 
