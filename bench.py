@@ -1434,6 +1434,88 @@ def bench_backup(extra):
 
 
 # ---------------------------------------------------------------------------
+# config: elastic resize — grow + shrink under a live query loop
+# ---------------------------------------------------------------------------
+
+
+def bench_elastic(extra):
+    """Serve-through resize measured end to end: a 3-node replica_n=2
+    in-process ring serving a continuous Count storm while a node is
+    added and then a member removed. Reports the fire-vs-steady p99
+    ratio (the whole cost of the routing window), client-visible
+    failures (must stay 0 — there is no resize gate), and the volume
+    the migration moved over the PTS1 stream."""
+    import threading
+
+    from pilosa_tpu.cluster.harness import LocalCluster
+    from pilosa_tpu.config import SHARD_WIDTH
+    from pilosa_tpu.obs.stats import MemoryStats
+
+    rng = np.random.default_rng(11)
+    n_shards = 6
+    lc = LocalCluster(3, replica_n=2)
+    lc.create_index("i")
+    lc.create_field("i", "f")
+    n_bits = 200_000
+    rows = rng.integers(0, 4, n_bits).astype(np.uint64)
+    cols = _rand_positions(rng, n_bits, n_shards * SHARD_WIDTH)
+    shard_of = (cols // np.uint64(SHARD_WIDTH)).astype(np.int64)
+    cl0 = lc.nodes[0].cluster
+    groups = cl0.shards_by_node(cl0.nodes, "i", list(range(n_shards)))
+    node_by_id = {cn.id: cn for cn in lc.nodes}
+    for node_id, shs in groups.items():
+        mask = np.isin(shard_of, shs)
+        node_by_id[node_id].handle_import_request(
+            "i", "f", rows=rows[mask], cols=cols[mask])
+
+    stats = MemoryStats()
+    for cn in lc.nodes:
+        cn.cluster.stats = stats
+    phase = ["steady"]
+    stop = threading.Event()
+    failures = []
+
+    def storm():
+        k = 0
+        while not stop.is_set():
+            k += 1
+            t0 = time.perf_counter()
+            try:
+                lc.query("i", f"Count(Row(f={k % 4}))", node=k % 2,
+                         cache=False)
+                stats.timing(f"elastic.q.{phase[0]}",
+                             time.perf_counter() - t0)
+            except Exception as e:  # pragma: no cover
+                failures.append(repr(e))
+
+    t = threading.Thread(target=storm)
+    t.start()
+    try:
+        time.sleep(1.0)
+        phase[0] = "fire"
+        t0 = time.perf_counter()
+        grown = lc.add_node()
+        extra["elastic_grow_s"] = round(time.perf_counter() - t0, 2)
+        grown.cluster.stats = stats
+        t0 = time.perf_counter()
+        lc.remove_node("node2")
+        extra["elastic_shrink_s"] = round(time.perf_counter() - t0, 2)
+    finally:
+        stop.set()
+        t.join()
+    steady = stats.timing_quantile("elastic.q.steady", 0.99)
+    fire = stats.timing_quantile("elastic.q.fire", 0.99)
+    extra["elastic_query_failures"] = len(failures)
+    extra["elastic_steady_p99_ms"] = round(steady * 1e3, 2)
+    extra["elastic_fire_p99_ms"] = round(fire * 1e3, 2)
+    extra["elastic_fire_vs_steady_p99"] = round(fire / max(steady, 1e-9), 2)
+    extra["elastic_bytes_streamed_mb"] = round(
+        stats.counter_value("cluster.resize.bytesStreamed") / 1e6, 2)
+    extra["elastic_shards_migrated"] = int(
+        stats.counter_value("cluster.resize.shardsMigrated"))
+
+
+# ---------------------------------------------------------------------------
 # config 8: overload resilience — 4x oversubscription with a slow peer
 # ---------------------------------------------------------------------------
 
@@ -1628,7 +1710,7 @@ def main() -> None:
             if CONFIGS != "all"
             else {"star", "topn", "bsi", "dispatch", "ingest", "time",
                   "cluster", "cache", "oversub", "backup", "overload",
-                  "obs"})
+                  "obs", "elastic"})
     extra: dict = {"backend": jax.default_backend(),
                    "devices": len(jax.devices())}
 
@@ -1667,7 +1749,8 @@ def main() -> None:
                      ("oversub", bench_oversubscribed),
                      ("backup", bench_backup),
                      ("overload", bench_overload),
-                     ("obs", bench_obs)):
+                     ("obs", bench_obs),
+                     ("elastic", bench_elastic)):
         if name in want:
             t0 = time.perf_counter()
             try:

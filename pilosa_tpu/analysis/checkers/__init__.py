@@ -6,6 +6,7 @@ from pilosa_tpu.analysis.checkers import (
     epoch_audit,
     executor_lifecycle,
     jit_purity,
+    resize_cutover,
     shared_return,
     wire_symmetry,
 )
@@ -17,6 +18,7 @@ ALL_CHECKERS = [
     jit_purity,
     contextvar_hygiene,
     executor_lifecycle,
+    resize_cutover,
 ]
 
 RULES = [c.RULE for c in ALL_CHECKERS]

@@ -1,0 +1,89 @@
+"""resize-cutover: a cutover mark must be preceded by a shard-epoch
+bump in the same function.
+
+Serve-through resize pairing invariant (PR 14): ``mark_cutover`` makes
+a shard's NEW owner an eligible read leg, so any result cached against
+the pre-cutover shard epoch must already be invalid by the time the
+mark lands — ``idx.epoch.bump(shard=...)`` has to run first. A mark
+without a preceding bump lets a reader hit the fresh leg while the
+result cache still vouches for pre-catch-up state; a bump AFTER the
+mark leaves a window where both are wrong at once.
+
+Receiver-side adopters are exempt by naming convention: functions
+named ``deliver_*`` / ``apply_*`` install a cutover decided on another
+node (the shard's new owner), where the paired bump already happened
+before the announce was sent. The deciding side — whoever calls
+``mark_cutover`` outside those receivers — carries the obligation.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections.abc import Mapping
+
+from pilosa_tpu.analysis.engine import Finding, ModuleInfo
+
+RULE = "resize-cutover"
+
+#: module path fragments this rule applies to (the resize/routing layer).
+SCOPE_DIRS = ("cluster/",)
+
+#: message-receiver prefixes: these adopt a remote decision whose bump
+#: already happened on the deciding node.
+RECEIVER_PREFIXES = ("deliver_", "apply_")
+
+
+def _in_scope(path: str) -> bool:
+    return any(f"/{d}" in path or path.startswith(d) for d in SCOPE_DIRS)
+
+
+def _attr_calls(fn: ast.AST, attr: str) -> list[ast.Call]:
+    return [node for node in ast.walk(fn)
+            if isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == attr]
+
+
+def _shard_bumps(fn: ast.AST) -> list[int]:
+    """Line numbers of ``<expr>.bump(shard=...)`` calls."""
+    return [c.lineno for c in _attr_calls(fn, "bump")
+            if any(kw.arg == "shard" for kw in c.keywords)]
+
+
+def _check_fn(mod: ModuleInfo, qualname: str,
+              fn: ast.FunctionDef | ast.AsyncFunctionDef) -> list[Finding]:
+    if fn.name.startswith(RECEIVER_PREFIXES):
+        return []
+    marks = _attr_calls(fn, "mark_cutover")
+    # The definition of mark_cutover itself carries no obligation, and
+    # neither does a function that never marks.
+    if not marks or fn.name == "mark_cutover":
+        return []
+    bumps = _shard_bumps(fn)
+    findings = []
+    for mark in marks:
+        if not any(b < mark.lineno for b in bumps):
+            what = ("a shard-epoch bump exists but only AFTER the mark"
+                    if bumps else "no shard-epoch bump in this function")
+            findings.append(Finding(
+                RULE, mod.path, mark.lineno,
+                f"{qualname} calls mark_cutover without a preceding "
+                f"epoch.bump(shard=...) ({what}) — the new owner "
+                f"becomes a read leg while cached results still vouch "
+                f"for the pre-catch-up epoch"))
+    return findings
+
+
+def check(mod: ModuleInfo, project: Mapping[str, ModuleInfo]) -> list[Finding]:
+    if not _in_scope(mod.path):
+        return []
+    findings: list[Finding] = []
+    for node in mod.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            findings.extend(_check_fn(mod, node.name, node))
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    findings.extend(
+                        _check_fn(mod, f"{node.name}.{sub.name}", sub))
+    return findings

@@ -25,6 +25,13 @@ def clean_holder(holder, cluster, store=None) -> int:
     """
     if cluster is None or len(cluster.nodes) <= 1:
         return 0
+    # Serve-through resize keeps cluster.state NORMAL, so the state
+    # check below no longer fences an in-flight migration: the
+    # migration table IS the in-flight signal. apply_cluster_status
+    # drops the table before adopting the new topology, so the
+    # commit-time clean still runs.
+    if getattr(cluster, "migration", None) is not None:
+        return 0
     # NEVER GC mid-resize (or while membership is unsettled): ownership
     # computed under the OLD ring would delete fragments a resize
     # target just streamed in for its NEW-ring shards — permanent data

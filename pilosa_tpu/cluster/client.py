@@ -211,21 +211,12 @@ class LocalClient:
         return self._peer(node).handle_import_roaring(index, field, shard,
                                                       data, clear)
 
-    def fetch_fragment(self, node, index, field, view, shard) -> bytes:
-        """Whole-fragment payload for resize streaming
-        (client.go:71 RetrieveShardFromURI)."""
-        return self._peer(node).handle_fragment_data(index, field, view, shard)
-
-    def fetch_fragment_chunks(self, node, index, field, view, shard):
-        """Streamed variant: bounded roaring blobs via the row cursor."""
-        after = 0
-        while True:
-            blob, next_row = self._peer(node).handle_fragment_data_range(
-                index, field, view, shard, after)
-            yield blob
-            if next_row is None:
-                return
-            after = next_row
+    def send_import_stream(self, node, reqs, chunked=False, qos_class=None):
+        """PTS1 bulk-import stream to a peer — the one wire for large
+        data movement (user bulk loads AND resize fragment migration,
+        which rides it with qos_class="internal"). Returns the number of
+        applied requests (the applied prefix, for resume)."""
+        return self._peer(node).handle_import_stream(list(reqs))
 
     def probe(self, node) -> None:
         """Liveness probe (the /version check of confirmNodeDown)."""

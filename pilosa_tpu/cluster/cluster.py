@@ -113,6 +113,18 @@ class Cluster:
         #: RemoteEpochTable.observe); the cross-node half of result
         #: cache stamps. None = nobody caches, skip the bookkeeping.
         self.epoch_sink = None
+        #: MigrationTable (cluster/migration.py) while a serve-through
+        #: resize is in flight, else None. The OLD ring (self.nodes)
+        #: stays authoritative for routing the whole time; this only
+        #: adds dual-apply write targets and (post-cutover) extra read
+        #: candidates. Installed by resize-begin, cleared by resize-end
+        #: / the commit / the stale-migration sweep.
+        self.migration = None
+        #: node id -> in-flight read legs dispatched BY this node, the
+        #: load signal the replica-aware read-spread post-pass balances
+        #: on. Observed load only — no coordination with peers.
+        self._inflight: dict[str, int] = {}
+        self._inflight_lock = threading.Lock()
 
     #: shared fan-out pool size — bounds total in-flight remote
     #: sub-queries, not per-query fan-out.
@@ -327,8 +339,12 @@ class Cluster:
         hit = memo.get(key)
         if hit is not None:
             # Copy-on-hit: callers may hold the lists across failover
-            # waves; never hand out aliased state.
-            return {nid: list(shs) for nid, shs in hit.items()}
+            # waves; never hand out aliased state. The read-spread
+            # post-pass runs on the copy — load shifts between hits,
+            # so the memo must stay the pure first-owner placement.
+            return self._spread_read_legs(
+                {nid: list(shs) for nid, shs in hit.items()},
+                nodes, index, blocked)
         out: dict[str, list[int]] = {}
         live = {n.id for n in nodes}
         for shard in shards:
@@ -350,7 +366,84 @@ class Cluster:
         if len(memo) >= 64:
             memo.clear()
         memo[key] = out
-        return {nid: list(shs) for nid, shs in out.items()}
+        return self._spread_read_legs(
+            {nid: list(shs) for nid, shs in out.items()},
+            nodes, index, blocked)
+
+    #: minimum observed in-flight-leg imbalance (max - min across live
+    #: nodes) before the read-spread post-pass moves anything; below it
+    #: the deterministic first-live-owner placement stands untouched.
+    SPREAD_THRESHOLD = 2
+
+    def _spread_read_legs(self, groups: dict[str, list[int]],
+                          nodes: list[Node], index: str,
+                          blocked: set) -> dict[str, list[int]]:
+        """Replica-aware read scaling: rebalance a fan-out's legs across
+        each shard's OTHER live replica owners by observed in-flight
+        load, instead of touching replicas only on failure or hedge
+        (the same owner knowledge _hedge_backup_groups uses). Shards of
+        a mid-resize migration that already CUT OVER also admit their
+        new owner as a candidate — dual-apply keeps that copy current.
+        At idle (no in-flight legs, or imbalance under the threshold)
+        this is the identity, so deterministic placement is preserved
+        exactly when nothing would be gained by deviating from it."""
+        with self._inflight_lock:
+            load = dict(self._inflight)
+        if not load:
+            return groups
+        live = {n.id for n in nodes}
+        vals = [load.get(nid, 0) for nid in live]
+        if not vals or max(vals) - min(vals) < self.SPREAD_THRESHOLD:
+            return groups
+        mig = self.migration
+        virt = {nid: float(load.get(nid, 0)) for nid in live}
+        out: dict[str, list[int]] = {}
+        moves = 0
+        for node_id, shs in groups.items():
+            # Fractional virtual load: one leg serves the whole group,
+            # so each moved/kept shard adds 1/len of a leg — moving a
+            # few shards off a hot node shouldn't instantly flip the
+            # imbalance the other way.
+            weight = 1.0 / max(1, len(shs))
+            for shard in shs:
+                cands = [node_id]
+                for owner in self.shard_nodes(index, shard):
+                    if owner.id == node_id or owner.id not in live:
+                        continue
+                    if owner.id == self.local_id and shard in blocked:
+                        continue
+                    cands.append(owner.id)
+                if mig is not None and mig.is_cutover(index, shard):
+                    for t in mig.dual_targets(self, index, shard):
+                        # Live-ring members only: a joiner outside
+                        # self.nodes can't be failover-remapped, so it
+                        # never serves ordinary reads pre-commit.
+                        if t.id in live and t.id not in cands:
+                            cands.append(t.id)
+                best = min(cands, key=lambda nid: virt.get(nid, 0.0))
+                tgt = node_id
+                if (best != node_id
+                        and virt.get(node_id, 0.0) - virt.get(best, 0.0)
+                        >= self.SPREAD_THRESHOLD):
+                    tgt = best
+                    moves += 1
+                virt[tgt] = virt.get(tgt, 0.0) + weight
+                out.setdefault(tgt, []).append(shard)
+        if moves:
+            self.stats.count("cluster.read_spread", moves)
+        return out
+
+    def _inflight_inc(self, node_id: str) -> None:
+        with self._inflight_lock:
+            self._inflight[node_id] = self._inflight.get(node_id, 0) + 1
+
+    def _inflight_dec(self, node_id: str) -> None:
+        with self._inflight_lock:
+            n = self._inflight.get(node_id, 0) - 1
+            if n <= 0:
+                self._inflight.pop(node_id, None)
+            else:
+                self._inflight[node_id] = n
 
     def _hedge_backup_groups(self, nodes: list[Node], index: str,
                              node_id: str,
@@ -448,7 +541,11 @@ class Cluster:
                 for shard in node_shards:
                     acc = reduce_fn(acc, map_fn(shard))
                 return acc
-            return _with_trace(go)
+            self._inflight_inc(self.local_id)
+            try:
+                return _with_trace(go)
+            finally:
+                self._inflight_dec(self.local_id)
 
         def _leg_wire() -> dict:
             """This thread's last wire accounting (the HTTP transport
@@ -460,6 +557,12 @@ class Cluster:
         def run_remote(node_id: str, node_shards: list[int],
                        hedged: bool = False):
             node = self.node_by_id(node_id)
+            if node is None:
+                # A resize commit can land between planning this leg and
+                # running it, dropping the node from the ring; fail over
+                # exactly like a dead peer so the retry wave remaps the
+                # shards onto the committed placement's owners.
+                raise ConnectionError(f"node {node_id} left the ring")
             t0 = time.perf_counter()
 
             def go():
@@ -488,7 +591,11 @@ class Cluster:
                     return results[0]
 
             try:
-                res = _with_trace(go)
+                self._inflight_inc(node_id)
+                try:
+                    res = _with_trace(go)
+                finally:
+                    self._inflight_dec(node_id)
             except Exception as e:
                 if prof is not None:
                     # Error legs are part of the timeline too (their
@@ -662,25 +769,83 @@ class Cluster:
     def write_fanout(self, idx_name: str, shard: int, c, opt,
                      local_apply: Callable[[], bool]) -> bool:
         """Apply a single-column write on every replica: locally when this
-        node owns it, forwarded otherwise. Returns changed-ness."""
+        node owns it, forwarded otherwise. Returns changed-ness.
+
+        While a resize is in flight the write ALSO dual-applies to the
+        shard's future owners (after the old-ring replicas: the resize
+        catch-up's epoch guard relies on source-before-target apply
+        order). Dual legs never drive the return value — the old ring
+        is what the caller's read-your-write lands on."""
         ret = False
-        for node in self.shard_nodes(idx_name, shard):
-            if node.id == self.local_id:
-                if local_apply():
-                    ret = True
-            elif not opt.remote:
-                if node.state == "DOWN":
-                    # Skip lost replicas; anti-entropy repairs them on
-                    # rejoin (holder.go:911 SyncHolder) — and the
-                    # scrubber gets first crack via the dirty mark.
-                    self.stats.count("cluster.replica_write_skipped")
-                    self.dirty_shards.mark(idx_name, shard)
-                    continue
-                res = self.client.query_node(node, idx_name, str(c), None,
-                                             remote=True)
-                if res and res[0]:
-                    ret = True
+        for _attempt in range(3):
+            # Snapshot the migration table BEFORE resolving owners, and
+            # re-check topology afterwards: a resize commit landing
+            # mid-fanout would otherwise let this write apply to the
+            # old owners yet skip the dual legs (migration cleared),
+            # silently missing the committed placement's new owner.
+            # Set/Clear are idempotent, so the retry pass just
+            # re-applies under the settled topology.
+            v0 = self.topology_version
+            mig = self.migration
+            for node in self.shard_nodes(idx_name, shard):
+                if node.id == self.local_id:
+                    if local_apply():
+                        ret = True
+                elif not opt.remote:
+                    if node.state == "DOWN":
+                        # Skip lost replicas; anti-entropy repairs them on
+                        # rejoin (holder.go:911 SyncHolder) — and the
+                        # scrubber gets first crack via the dirty mark.
+                        self.stats.count("cluster.replica_write_skipped")
+                        self.dirty_shards.mark(idx_name, shard)
+                        continue
+                    res = self.client.query_node(node, idx_name, str(c),
+                                                 None, remote=True)
+                    if res and res[0]:
+                        ret = True
+            if mig is not None and not opt.remote:
+                for node in mig.dual_targets(self, idx_name, shard):
+                    try:
+                        if node.id == self.local_id:
+                            local_apply()
+                        else:
+                            known = self.node_by_id(node.id)
+                            if known is not None and known.state == "DOWN":
+                                raise ConnectionError(
+                                    f"node {node.id} is down")
+                            self.client.query_node(node, idx_name, str(c),
+                                                   None, remote=True)
+                        self.stats.count("cluster.resize.dualWrites")
+                    except (ConnectionError, RuntimeError, LookupError) as e:
+                        # The new copy just missed a write: mark for scrub
+                        # and tell the coordinator to fail this target —
+                        # committing would route reads at a diverged copy.
+                        self.dirty_shards.mark(idx_name, shard)
+                        self.stats.count("cluster.resize.dualWriteFailed")
+                        self._report_dual_write_failure(mig, node.id, e)
+            if self.topology_version == v0 and self.migration is mig:
+                break
         return ret
+
+    def _report_dual_write_failure(self, mig, node_id: str, err) -> None:
+        msg = {"type": "resize-dual-write-failed", "job": mig.job_id,
+               "node": node_id, "error": f"{type(err).__name__}: {err}"}
+        coord_id = mig.coordinator.get("id", "")
+        if coord_id == self.local_id:
+            from pilosa_tpu.cluster.resize import deliver_dual_write_failed
+            deliver_dual_write_failed(msg)
+            return
+        coord = self.node_by_id(coord_id)
+        if coord is None and mig.coordinator.get("uri"):
+            coord = Node.from_json(mig.coordinator)
+        if coord is None:
+            return
+        try:
+            self.client.send_message(coord, msg)
+        except (ConnectionError, RuntimeError, LookupError):
+            pass  # coordinator unreachable: its own job will fail soon
+        # anyway (its ACK wait / begin broadcast shares the same link),
+        # and the dirty mark keeps the scrubber on this shard.
 
     def broadcast_call(self, idx_name: str, c, opt) -> None:
         """Forward an attr-write to every other node (executor.go:2237)."""

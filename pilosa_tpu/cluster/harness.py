@@ -134,6 +134,22 @@ class ClusterNode:
         elif t == "cluster-state":
             from pilosa_tpu.cluster.resize import apply_cluster_state
             apply_cluster_state(self.cluster, message["state"])
+        elif t == "resize-begin":
+            from pilosa_tpu.cluster.resize import apply_resize_begin
+            apply_resize_begin(self.cluster, message)
+        elif t == "resize-end":
+            from pilosa_tpu.cluster.resize import apply_resize_end
+            apply_resize_end(self.cluster, message)
+        elif t == "resize-push":
+            from pilosa_tpu.cluster.resize import handle_resize_push
+            return handle_resize_push(self.holder, self.cluster.client,
+                                      self.cluster, message)
+        elif t == "resize-shard-cutover":
+            from pilosa_tpu.cluster.resize import deliver_cutover
+            deliver_cutover(message, self.cluster)
+        elif t == "resize-dual-write-failed":
+            from pilosa_tpu.cluster.resize import deliver_dual_write_failed
+            deliver_dual_write_failed(message)
         else:
             handle_cluster_message(self.holder, message)
 
@@ -202,18 +218,28 @@ class ClusterNode:
             raise LookupError(f"field not found: {index}/{field}")
         f.import_roaring(shard, data, clear=clear)
 
-    def handle_fragment_data(self, index, field, view, shard) -> bytes:
-        frag = self.holder.fragment(index, field, view, shard)
-        if frag is None:
-            raise LookupError(f"fragment not found: {index}/{field}/{view}/{shard}")
-        return frag.to_roaring()
-
-    def handle_fragment_data_range(self, index, field, view, shard,
-                                   after: int):
-        frag = self.holder.fragment(index, field, view, shard)
-        if frag is None:
-            raise LookupError(f"fragment not found: {index}/{field}/{view}/{shard}")
-        return frag.to_roaring_range(after)
+    def handle_import_stream(self, reqs: list[dict]) -> int:
+        """In-process PTS1 stream: apply each bounded request in order,
+        returning the applied count (the HTTP wire's applied-prefix
+        contract, so a killed stream resumes where it stopped).
+        kind="fragment" requests target one specific fragment (resize
+        migration); field-kind requests take the same path as
+        send_import so stream and unary imports are equivalent."""
+        applied = 0
+        for r in reqs:
+            if r.get("kind") == "fragment" or "view" in r:
+                self.handle_import(r["index"], r["field"], r["view"],
+                                   r["shard"], r.get("rowIDs") or [],
+                                   r.get("columnIDs") or [],
+                                   clear=bool(r.get("clear")))
+            else:
+                self.handle_import_request(
+                    r["index"], r["field"], rows=r.get("rowIDs"),
+                    cols=r.get("columnIDs"), values=r.get("values"),
+                    timestamps=r.get("timestamps"),
+                    clear=bool(r.get("clear")))
+            applied += 1
+        return applied
 
     def handle_schema(self):
         return self.holder.schema()
@@ -321,6 +347,64 @@ class LocalCluster:
         from pilosa_tpu.cluster.translate_sync import sync_translation
         return sum(sync_translation(cn.holder, cn.cluster, self.client)
                    for cn in self.nodes)
+
+    def add_node(self, node_id: str | None = None,
+                 coordinator: int = 0) -> "ClusterNode":
+        """Grow the ring by one node through the serve-through resize:
+        boot a fresh in-process member (empty holder, STARTING joiner
+        view of the current ring + itself), register it on the shared
+        transport, and run a ResizeJob from ``coordinator``. Raises if
+        the job does not commit. The chaos soak's act_add_node and the
+        elasticity drills drive this."""
+        from pilosa_tpu.cluster.cluster import STATE_STARTING
+        from pilosa_tpu.cluster.resize import ResizeJob
+        coord = self.nodes[coordinator]
+        if node_id is None:
+            taken = {cn.id for cn in self.nodes}
+            i = len(self.nodes)
+            while f"node{i}" in taken:
+                i += 1
+            node_id = f"node{i}"
+        new_member = Node(id=node_id,
+                          uri=URI(host="localhost",
+                                  port=10101 + len(self.nodes) + 90))
+        member_list = [Node(id=n.id, uri=n.uri,
+                            is_coordinator=n.is_coordinator)
+                       for n in coord.cluster.nodes]
+        c = Cluster(node_id, member_list + [new_member],
+                    replica_n=coord.cluster.replica_n,
+                    client=self.client)
+        c.set_state(STATE_STARTING)
+        cn = ClusterNode(node_id, c)
+        cn.apply_schema(coord.holder.schema())
+        self.client.register(node_id, cn)
+        self.nodes.append(cn)
+        job = ResizeJob(coord.cluster, coord.holder, self.client)
+        state = job.run([Node(id=n.id, uri=n.uri,
+                              is_coordinator=n.is_coordinator)
+                         for n in coord.cluster.nodes] + [new_member])
+        if state != "DONE":
+            self.nodes.remove(cn)
+            self.client.peers.pop(node_id, None)
+            raise RuntimeError(f"add_node resize ended {state}")
+        return cn
+
+    def remove_node(self, node_id: str, coordinator: int = 0) -> None:
+        """Shrink the ring by one member via the serve-through resize
+        (operator remove-node flow); raises if the job does not
+        commit. The departed ClusterNode stays registered but is
+        dropped from self.nodes."""
+        from pilosa_tpu.cluster.resize import ResizeJob
+        coord = self.nodes[coordinator]
+        keep = [Node(id=n.id, uri=n.uri, is_coordinator=n.is_coordinator)
+                for n in coord.cluster.nodes if n.id != node_id]
+        if len(keep) == len(coord.cluster.nodes):
+            raise LookupError(f"{node_id} not in ring")
+        job = ResizeJob(coord.cluster, coord.holder, self.client)
+        state = job.run(keep)
+        if state != "DONE":
+            raise RuntimeError(f"remove_node resize ended {state}")
+        self.nodes = [cn for cn in self.nodes if cn.id != node_id]
 
     def down(self, node_id: str) -> None:
         """Fault injection: the pumba 'pause container' analog

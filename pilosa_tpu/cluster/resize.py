@@ -1,4 +1,4 @@
-"""Elastic resize: add/remove nodes with fragment redistribution.
+"""Elastic resize: add/remove nodes while the ring keeps serving.
 
 Reference: cluster.go resize machinery — `diff` (:745) computes
 added/removed nodes, `fragSources` (:784-868) computes which node streams
@@ -7,11 +7,29 @@ ResizeInstructions to nodes, `followResizeInstruction` (:1297-1411) makes
 each node fetch its missing fragments from source nodes; one job at a
 time; abortable (api.go:1250).
 
-Instructions travel as control-plane messages ("resize-instruction") so
-the same flow works over the in-process LocalClient and real HTTP.
-Fragments travel as serialized roaring bitmaps (Fragment.to_roaring /
-import_roaring — the reference's fragment stream, client.go:71,
-fragment.go:2436).
+Unlike the reference (which closes the cluster behind a ring-wide
+RESIZING state for the whole job), this resize SERVES THROUGHOUT:
+
+- The old ring stays authoritative — ``Cluster.nodes`` doesn't change
+  until the single commit broadcast at the end, so reads never route to
+  a partial copy and any failure/abort needs no rollback at all.
+- A ``resize-begin`` broadcast installs a MigrationTable
+  (cluster/migration.py) on every member, after which writes dual-apply
+  to each shard's future owners while fragments move.
+- Fragments travel over the PTS1 import-stream wire (the same path as
+  bulk ingest: chunked resume-from-applied-prefix, WAL group-commit,
+  IngestGate byte budget, QoS internal class) — the coordinator's
+  instruction still goes to the TARGET, which relays a synchronous
+  ``resize-push`` to each source; the source streams.
+- After the bulk copy, the target runs a per-shard directed catch-up
+  sync against the source (block-checksum diff applying both sets and
+  clears, guarded by the shard-epoch read-recheck loop), bumps the
+  shard epoch, and announces the shard cut over — from then on the new
+  owner is also an eligible READ leg (replica-aware read scaling).
+
+Instructions travel as control-plane messages ("resize-instruction",
+"resize-push", "resize-shard-cutover", …) so the same flow works over
+the in-process LocalClient and real HTTP.
 """
 
 from __future__ import annotations
@@ -19,11 +37,13 @@ from __future__ import annotations
 import itertools
 import os
 import threading
+import time
 from dataclasses import asdict, dataclass
 
 from pilosa_tpu.cluster.cluster import (
     STATE_NORMAL,
     STATE_RESIZING,
+    STATE_STARTING,
     Cluster,
 )
 from pilosa_tpu.cluster.event import EVENT_UPDATE
@@ -46,6 +66,54 @@ def deliver_completion(message: dict) -> None:
         job.complete(message.get("node", ""), message.get("error"))
 
 
+def deliver_dual_write_failed(message: dict) -> None:
+    """A member could not apply a write to a migration target: the new
+    copy just diverged, so the coordinator must fail that target — the
+    commit would otherwise route reads to a copy missing writes. The
+    old ring was never not-authoritative, so failing is free."""
+    with _JOBS_LOCK:
+        job = _JOBS.get(message.get("job", ""))
+    if job is not None:
+        job.fail_target(
+            message.get("node", ""),
+            f"dual write failed: {message.get('error', 'unknown')}")
+
+
+def deliver_cutover(message: dict, cluster: Cluster | None = None) -> None:
+    """A target finished catch-up for one shard: record it on the
+    coordinator's job (for /debug/resize) and on the local migration
+    table (the shard's new owner becomes an eligible read leg)."""
+    with _JOBS_LOCK:
+        job = _JOBS.get(message.get("job", ""))
+    if job is not None:
+        job.note_cutover(message.get("index", ""),
+                         int(message.get("shard", -1)),
+                         message.get("node", ""))
+    if cluster is not None:
+        mig = getattr(cluster, "migration", None)
+        if mig is not None and mig.job_id == message.get("job"):
+            mig.mark_cutover(message["index"], int(message["shard"]))
+
+
+def apply_resize_begin(cluster: Cluster, message: dict) -> None:
+    """Peer half of the serve-through handshake: install the migration
+    table so every write fanned out by THIS member also lands on the
+    shard's future owners. Replaces any stale table — one job at a time
+    is enforced at the coordinator's resize gate, so a new begin means
+    the previous job is dead."""
+    from pilosa_tpu.cluster.migration import MigrationTable
+    cluster.migration = MigrationTable.from_message(cluster, message)
+
+
+def apply_resize_end(cluster: Cluster, message: dict) -> None:
+    """Drop the migration table for an aborted/failed job. Always safe:
+    the old ring never stopped being authoritative, so partially
+    migrated shards simply keep routing to their old owners."""
+    mig = getattr(cluster, "migration", None)
+    if mig is not None and mig.job_id == message.get("job"):
+        cluster.migration = None
+
+
 def handle_resize_instruction(holder, client, cluster: Cluster,
                               message: dict, local_id: str) -> None:
     """Target-side entry point. When the instruction carries a job id,
@@ -60,7 +128,8 @@ def handle_resize_instruction(holder, client, cluster: Cluster,
     if job_id is None:
         apply_resize_instruction(holder, client, cluster,
                                  message["sources"],
-                                 schema=message.get("schema"))
+                                 schema=message.get("schema"),
+                                 local_id=local_id)
         return
     coord = message.get("coordinator") or {}
 
@@ -69,7 +138,9 @@ def handle_resize_instruction(holder, client, cluster: Cluster,
         try:
             apply_resize_instruction(holder, client, cluster,
                                      message["sources"],
-                                     schema=message.get("schema"))
+                                     schema=message.get("schema"),
+                                     local_id=local_id, job_id=job_id,
+                                     coordinator=coord)
         except Exception as e:  # noqa: BLE001 — every failure must ACK
             err = f"{type(e).__name__}: {e}"
         node = cluster.node_by_id(coord.get("id", ""))
@@ -140,36 +211,233 @@ def fragment_sources(old: Cluster, new: Cluster, schema_fragments) -> dict[str, 
     return out
 
 
+def _resolve_source(cluster: Cluster, src: "ResizeSource") -> Node:
+    node = cluster.node_by_id(src.source_node)
+    if node is None and src.source_host:
+        node = Node.from_json({
+            "id": src.source_node,
+            "uri": {"scheme": src.source_scheme or "http",
+                    "host": src.source_host, "port": src.source_port}})
+    if node is None:
+        raise ConnectionError(
+            f"resize source {src.source_node!r} unknown")
+    return node
+
+
+def _fragment_stream_reqs(frag, src: "ResizeSource") -> list[dict]:
+    """Chunk one fragment's bits into PTS1 import requests: kind=
+    "fragment" payloads (absolute column ids), each bounded by
+    Fragment.TRANSFER_CHUNK_BITS, so the target applies bounded batches
+    as they arrive and a killed stream resumes from the applied prefix
+    (sets are idempotent)."""
+    from pilosa_tpu.config import SHARD_WIDTH
+    from pilosa_tpu.core.fragment import Fragment
+    base = int(src.shard) * SHARD_WIDTH
+    limit = max(1, int(Fragment.TRANSFER_CHUNK_BITS))
+    reqs: list[dict] = []
+    rows_buf: list[int] = []
+    cols_buf: list[int] = []
+
+    def flush():
+        if rows_buf:
+            reqs.append({"kind": "fragment", "index": src.index,
+                         "field": src.field, "view": src.view,
+                         "shard": int(src.shard),
+                         "rowIDs": list(rows_buf),
+                         "columnIDs": list(cols_buf)})
+            rows_buf.clear()
+            cols_buf.clear()
+    for rid, positions in frag.rows_snapshot():
+        i, n = 0, len(positions)
+        while i < n:
+            take = min(n - i, limit - len(rows_buf))
+            cols_buf.extend(int(p) + base for p in positions[i:i + take])
+            rows_buf.extend([int(rid)] * take)
+            i += take
+            if len(rows_buf) >= limit:
+                flush()
+    flush()
+    return reqs
+
+
+def handle_resize_push(holder, client, cluster: Cluster,
+                       message: dict) -> int:
+    """SOURCE-side fragment export for serve-through resize: stream the
+    requested fragments to the target over the PTS1 import wire (QoS
+    internal class — migrations must never starve interactive traffic;
+    the target's IngestGate byte budget backpressures us through the
+    stream's 429/applied-prefix protocol). Handled synchronously: the
+    target's send_message blocks until the push finished (or raises),
+    so stream failures surface at the target and fail its ACK."""
+    target = Node.from_json(message["target"])
+    reqs: list[dict] = []
+    for s in message["sources"]:
+        src = ResizeSource(**s)
+        frag = holder.fragment(src.index, src.field, src.view, src.shard)
+        if frag is None:
+            continue  # nothing here to stream; catch-up verifies parity
+        reqs.extend(_fragment_stream_reqs(frag, src))
+    if not reqs:
+        return 0
+    applied = client.send_import_stream(target, reqs, qos_class="internal")
+    stats = getattr(cluster, "stats", None)
+    if stats is not None:
+        # Logical payload bytes (16 per bit pair on the PTI1 wire).
+        stats.count("cluster.resize.bytesStreamed",
+                    sum(16 * len(r["rowIDs"]) for r in reqs))
+    return applied
+
+
+#: read-diff-recheck passes a catch-up sync attempts before concluding
+#: sustained write pressure is outrunning it and failing the target
+#: (safe: the job fails, the old ring stays authoritative).
+CATCH_UP_ATTEMPTS = 16
+
+
+def _catch_up_fragment(holder, client, node: Node,
+                       src: "ResizeSource") -> None:
+    """Directed (source-authoritative) per-fragment sync: after the bulk
+    PTS1 copy, diff block checksums against the source and apply both
+    the missing SETS and the stale CLEARS, so a Clear that raced the
+    bulk copy is never resurrected. NOT the anti-entropy majority merge
+    — with one source and one target, "majority" degenerates to union,
+    which can't clear anything.
+
+    Epoch guard (the same read-merge-write discipline as
+    cluster/sync.py): snapshot the local shard epoch, read both sides,
+    and only apply if the epoch is unchanged — a dual-applied write
+    landing mid-read bumps it and forces a re-read. The guard is sound
+    against writes racing the APPLY too, because write_fanout applies
+    old owners (the source) before dual targets (this node): a write
+    whose source-side apply predates our source read is already in the
+    snapshot, and one that postdates it reaches this node only after
+    bumping our epoch — the next pass sees it. Convergence requires one
+    full pass with a stable epoch and ZERO diff."""
+    f = holder.field(src.index, src.field)
+    if f is None:
+        raise LookupError(
+            f"resize target field missing: {src.index}/{src.field}")
+    v = f.create_view_if_not_exists(src.view)
+    frag = v.create_fragment_if_not_exists(src.shard)
+    idx = holder.index(src.index)
+    epoch = idx.epoch if idx is not None else None
+
+    def shard_epoch():
+        if epoch is None:
+            return None
+        return epoch.shard_vector([src.shard])[int(src.shard)]
+    for _ in range(CATCH_UP_ATTEMPTS):
+        e0 = shard_epoch()
+        remote_sums = client.fragment_blocks(node, src.index, src.field,
+                                             src.view, src.shard)
+        local_sums = frag.checksum_blocks()
+        diff = sorted(b for b in set(remote_sums) | set(local_sums)
+                      if remote_sums.get(b) != local_sums.get(b))
+        ops: list[tuple[list[tuple[int, int]], bool]] = []
+        for block in diff:
+            try:
+                r_rows, r_cols = client.fragment_block_data(
+                    node, src.index, src.field, src.view, src.shard, block)
+                remote_pairs = set(zip((int(x) for x in r_rows),
+                                       (int(x) for x in r_cols)))
+            except LookupError:
+                remote_pairs = set()  # source block vanished: all clears
+            l_rows, l_cols = frag.block_data(block)
+            local_pairs = set(zip((int(x) for x in l_rows),
+                                  (int(x) for x in l_cols)))
+            sets = sorted(remote_pairs - local_pairs)
+            clears = sorted(local_pairs - remote_pairs)
+            if sets:
+                ops.append((sets, False))
+            if clears:
+                ops.append((clears, True))
+        if e0 is not None and shard_epoch() != e0:
+            continue  # a write raced the reads: stale snapshot, re-read
+        if not ops:
+            return  # converged: zero diff over a stable epoch window
+        for pairs, clear in ops:
+            frag.bulk_import([r for r, _ in pairs],
+                             [c for _, c in pairs], clear=clear)
+    raise RuntimeError(
+        f"resize catch-up did not converge for {src.index}/{src.field}/"
+        f"{src.view}/{src.shard} after {CATCH_UP_ATTEMPTS} passes "
+        f"(sustained write pressure); target fails, old ring stays "
+        f"authoritative")
+
+
 def apply_resize_instruction(holder, client, cluster: Cluster,
                              sources: list[dict],
-                             schema: list[dict] | None = None) -> None:
-    """followResizeInstruction (cluster.go:1297): adopt the sender's
-    schema (a joiner starts empty), then fetch each fragment from its
-    source node and merge it locally. Any fetch failure RAISES so the
-    coordinator's completion tracking sees this target as failed
-    (reference ResizeInstructionComplete, cluster.go:1315)."""
+                             schema: list[dict] | None = None,
+                             local_id: str | None = None,
+                             job_id: str | None = None,
+                             coordinator: dict | None = None) -> None:
+    """followResizeInstruction (cluster.go:1297), serve-through edition:
+    adopt the sender's schema (a joiner starts empty), then — grouped by
+    SOURCE node — relay a synchronous resize-push so each source streams
+    its fragments here over the PTS1 import wire, then run the per-shard
+    directed catch-up sync, bump the shard epoch, and announce the shard
+    cut over. Any failure RAISES so the coordinator's completion
+    tracking sees this target as failed (reference
+    ResizeInstructionComplete, cluster.go:1315)."""
     if schema:
         holder.apply_schema(schema)
-    for s in sources:
-        src = ResizeSource(**s)
-        node = cluster.node_by_id(src.source_node)
-        if node is None and src.source_host:
-            node = Node.from_json({
-                "id": src.source_node,
-                "uri": {"scheme": src.source_scheme or "http",
-                        "host": src.source_host, "port": src.source_port}})
-        if node is None:
-            raise ConnectionError(
-                f"resize source {src.source_node!r} unknown")
-        f = holder.field(src.index, src.field)
-        if f is None:
-            raise LookupError(
-                f"resize target field missing: {src.index}/{src.field}")
-        # Streamed: bounded chunks merge one by one, so a multi-GB
-        # fragment never lives whole in either process's memory.
-        for chunk in client.fetch_fragment_chunks(node, src.index, src.field,
-                                                  src.view, src.shard):
-            f.import_roaring(src.shard, chunk, view=src.view)
+    if not sources:
+        return
+    local_id = local_id or cluster.local_id
+    target = cluster.node_by_id(local_id)
+    if target is None:
+        raise ConnectionError(
+            f"resize target {local_id!r} has no membership entry")
+    srcs = [ResizeSource(**s) for s in sources]
+    by_source: dict[str, list[ResizeSource]] = {}
+    for src in srcs:
+        by_source.setdefault(src.source_node, []).append(src)
+    t_json = target.to_json()
+    for _, frags in sorted(by_source.items()):
+        node = _resolve_source(cluster, frags[0])
+        # Synchronous relay: LocalClient returns the handler's value;
+        # the HTTP POST blocks until the source's handler returned.
+        # Either way an error raises here and fails this target's ACK.
+        client.send_message(node, {"type": "resize-push", "job": job_id,
+                                   "target": t_json,
+                                   "sources": [asdict(f) for f in frags]})
+    by_shard: dict[tuple[str, int], list[ResizeSource]] = {}
+    for src in srcs:
+        by_shard.setdefault((src.index, int(src.shard)), []).append(src)
+    stats = getattr(cluster, "stats", None)
+    for (index, shard), frags in sorted(by_shard.items()):
+        t0 = time.monotonic()
+        for src in frags:
+            _catch_up_fragment(holder, client,
+                               _resolve_source(cluster, src), src)
+        idx = holder.index(index)
+        if idx is not None:
+            # Cutover pairing invariant (analysis checker
+            # resize_cutover): the shard-epoch bump must precede the
+            # cutover mark/announce, so any result cached against the
+            # pre-cutover epoch is invalid before the new owner can
+            # serve a read leg.
+            idx.epoch.bump(shard=shard)
+        mig = getattr(cluster, "migration", None)
+        if mig is not None and (job_id is None or mig.job_id == job_id):
+            mig.mark_cutover(index, shard)
+        if stats is not None:
+            stats.timing("cluster.resize.cutover", time.monotonic() - t0)
+            stats.count("cluster.resize.shardsMigrated")
+        if job_id and coordinator:
+            msg = {"type": "resize-shard-cutover", "job": job_id,
+                   "index": index, "shard": shard, "node": local_id}
+            if coordinator.get("id") == local_id:
+                deliver_cutover(msg, cluster)
+            else:
+                coord = cluster.node_by_id(coordinator.get("id", ""))
+                if coord is None and coordinator.get("uri"):
+                    coord = Node.from_json(coordinator)
+                if coord is not None:
+                    try:  # best-effort: /debug + read-spread signal only
+                        client.send_message(coord, msg)
+                    except (ConnectionError, RuntimeError, LookupError):
+                        pass
 
 
 def apply_cluster_status(cluster: Cluster, nodes_json: list[dict],
@@ -195,6 +463,13 @@ def apply_cluster_status(cluster: Cluster, nodes_json: list[dict],
         stale = (version is not None
                  and int(version) <= cluster.topology_version)
         if not stale:
+            # Adopting a committed topology ends any in-flight
+            # migration on this member: either this IS the resize's
+            # commit (the new ring now owns every moved shard) or a
+            # newer topology superseded the job. Clear before the
+            # holder cleaner runs so commit-time GC isn't suppressed
+            # by the mid-migration guard.
+            cluster.migration = None
             if replica_n:
                 cluster.replica_n = int(replica_n)
             if partition_n:
@@ -300,6 +575,10 @@ class ResizeJob:
         self._pending: set[str] = set()
         self.completed: list[str] = []
         self.failed: list[str] = []
+        self.started_at = time.monotonic()
+        self._last_cutover = self.started_at
+        #: (index, shard) -> "pending" | "migrated", for /debug/resize.
+        self.shard_status: dict[tuple[str, int], str] = {}
 
     def abort(self) -> None:
         with self._cond:
@@ -317,6 +596,42 @@ class ResizeJob:
             else:
                 self.completed.append(node_id)
             self._cond.notify_all()
+
+    def fail_target(self, node_id: str, error: str) -> None:
+        """Force-fail a target even after it ACKed: a dual-write failure
+        means its copy diverged, so a completed ACK no longer proves the
+        copy is current and the commit must not happen."""
+        with self._cond:
+            if self.state != "RUNNING":
+                return
+            self._pending.discard(node_id)
+            if node_id not in self.failed:
+                self.failed.append(node_id)
+            self._cond.notify_all()
+
+    def note_cutover(self, index: str, shard: int, node_id: str) -> None:
+        with self._cond:
+            self.shard_status[(index, int(shard))] = "migrated"
+            self._last_cutover = time.monotonic()
+
+    def snapshot(self) -> dict:
+        """Live job state for GET /debug/resize."""
+        with self._cond:
+            statuses = list(self.shard_status.values())
+            migrated = sum(1 for s in statuses if s == "migrated")
+            now = time.monotonic()
+            return {
+                "job": self.job_id,
+                "state": self.state,
+                "pending": sorted(self._pending),
+                "completed": list(self.completed),
+                "failed": list(self.failed),
+                "shards": {"total": len(statuses),
+                           "migrated": migrated,
+                           "inFlight": len(statuses) - migrated},
+                "runningSeconds": round(now - self.started_at, 3),
+                "lastCutoverLagSeconds": round(now - self._last_cutover, 3),
+            }
 
     def _schema_fragments(self):
         out = set()
@@ -339,16 +654,22 @@ class ResizeJob:
                                     for n in new_nodes],
                            replica_n=self.cluster.replica_n,
                            partition_n=self.cluster.partition_n)
-        self.cluster.set_state(STATE_RESIZING)
-        # The RESIZING state must reach EVERY node (old and new ring),
-        # not just the coordinator: each node's API gate refuses
-        # queries/imports/schema changes while fragments move, so a
-        # write can't land through a peer on a ring position the
-        # committed topology (and the holder GC) won't honor. Reference:
-        # setStateAndBroadcast(ClusterStateResizing), cluster.go:1470.
-        self._broadcast_state(STATE_RESIZING,
-                              {n.id: n for v in (old_view, new_view)
-                               for n in v.nodes}.values())
+        local = self.cluster.node_by_id(self.cluster.local_id)
+        coord_json = local.to_json() if local is not None else {
+            "id": self.cluster.local_id}
+        # Serve-through: NO ring-wide RESIZING gate. The ring keeps
+        # serving under the old (authoritative) topology; a resize-begin
+        # broadcast installs a MigrationTable on every member so writes
+        # dual-apply to each shard's future owners while fragments move,
+        # and the single cluster-status commit at the end flips
+        # placement atomically. (The reference instead broadcast
+        # ClusterStateResizing and closed every node's API for the whole
+        # job, cluster.go:1470.)
+        begin = {"type": "resize-begin", "job": self.job_id,
+                 "coordinator": coord_json,
+                 "nodes": [n.to_json() for n in new_nodes],
+                 "replicaN": self.cluster.replica_n,
+                 "partitionN": self.cluster.partition_n}
         # Per-target completion tracking (reference
         # ResizeInstructionComplete + per-node map, cluster.go:1315,
         # :1413-1438): the new topology is committed ONLY after every
@@ -370,6 +691,33 @@ class ResizeJob:
 
         self.cluster.subscribe(on_event)
         try:
+            if self.state == "ABORTED":
+                return self.state
+            apply_resize_begin(self.cluster, begin)
+            # Every LIVE old-ring member must install the table before
+            # any fragment moves: a member without it keeps single-
+            # applying writes, silently diverging the new copies. A
+            # member the failure detector already marked DOWN is skipped
+            # (it serves nothing; if it resurrects mid-job its writes
+            # are refused by peers' liveness view and it learns the
+            # outcome from the commit/sweeps). Joiners are mandatory
+            # too: without a table their API gate refuses the dual-write
+            # legs about to be aimed at them.
+            members = {n.id: n for v in (old_view, new_view)
+                       for n in v.nodes}
+            for node in members.values():
+                if node.id == self.cluster.local_id:
+                    continue
+                known = self.cluster.node_by_id(node.id)
+                if known is not None and known.state == "DOWN":
+                    continue
+                try:
+                    self.client.send_message(node, begin)
+                except (ConnectionError, RuntimeError, LookupError):
+                    self.failed.append(node.id)
+            if self.failed:
+                self.state = "FAILED"
+                return self.state
             schema = self.holder.schema()
             try:
                 instructions = fragment_sources(old_view, new_view,
@@ -384,17 +732,21 @@ class ResizeJob:
             for n in new_view.nodes:
                 if n.id not in old_ids:
                     instructions.setdefault(n.id, [])
-            local = self.cluster.node_by_id(self.cluster.local_id)
-            coord_json = local.to_json() if local is not None else {
-                "id": self.cluster.local_id}
+            with self._cond:
+                for sources in instructions.values():
+                    for s in sources:
+                        self.shard_status.setdefault(
+                            (s.index, int(s.shard)), "pending")
             for target_id, sources in sorted(instructions.items()):
                 if self.state == "ABORTED":
                     return self.state
                 payload = [asdict(s) for s in sources]
                 try:
                     if target_id == self.cluster.local_id:
-                        apply_resize_instruction(self.holder, self.client,
-                                                 old_view, payload)
+                        apply_resize_instruction(
+                            self.holder, self.client, self.cluster,
+                            payload, local_id=self.cluster.local_id,
+                            job_id=self.job_id, coordinator=coord_json)
                         self.completed.append(target_id)
                     else:
                         node = new_view.node_by_id(target_id)
@@ -465,6 +817,26 @@ class ResizeJob:
             self.cluster.unsubscribe(on_event)
             with _JOBS_LOCK:
                 _JOBS.pop(self.job_id, None)
+            if self.state != "DONE":
+                # Non-commit exit (FAILED/ABORTED/exception): drop the
+                # migration tables everywhere. The old ring never
+                # stopped being authoritative and no shard was ever
+                # routed away from its old owner, so this IS the whole
+                # rollback — partially migrated copies become garbage
+                # the holder cleaner GCs after the next committed
+                # topology. Best-effort: a peer that misses the end
+                # message drops its table via the stale-migration sweep
+                # (_recover_stale_migration) or the next begin/commit.
+                end = {"type": "resize-end", "job": self.job_id}
+                apply_resize_end(self.cluster, end)
+                for node in {n.id: n for v in (old_view, new_view)
+                             for n in v.nodes}.values():
+                    if node.id == self.cluster.local_id:
+                        continue
+                    try:
+                        self.client.send_message(node, end)
+                    except (ConnectionError, RuntimeError, LookupError):
+                        pass
             if self.cluster.state == STATE_RESIZING:
                 # Non-commit exit (FAILED/ABORTED/exception): reopen the
                 # gate everywhere. set_state first (clears RESIZING so
@@ -600,6 +972,42 @@ def check_nodes(cluster: Cluster, client, retries: int = 2,
 RESIZING_COORD_DOWN_SWEEPS = 3
 
 
+def _recover_stale_migration(cluster: Cluster) -> None:
+    """Drop a migration table whose coordinator died mid-job: the
+    coordinator's crash killed the only thread that would have sent
+    resize-end (or the commit), so without this sweep every member
+    dual-applies writes forever against a job that no longer exists.
+    Debounced over the same consecutive-DOWN-sweeps window as the
+    RESIZING recovery — a coordinator GC pause must not drop tables
+    while fragments still move. Dropping is always safe (the old ring
+    stayed authoritative); worst case a resurrected coordinator's job
+    fails its targets' catch-up and retries."""
+    mig = getattr(cluster, "migration", None)
+    if mig is None:
+        cluster._migration_coord_down_sweeps = 0
+        return
+    coord_id = mig.coordinator.get("id", "")
+    if coord_id == cluster.local_id:
+        return  # the local ResizeJob owns this table's lifecycle
+    coord = cluster.node_by_id(coord_id)
+    if coord is None:
+        if cluster.state == STATE_STARTING:
+            # A joiner doesn't know the ring yet — the coordinator being
+            # unresolvable is expected, not evidence of death.
+            return
+        down = True  # not in our committed ring: no authority exists
+    else:
+        down = coord.state == "DOWN"
+    if not down:
+        cluster._migration_coord_down_sweeps = 0
+        return
+    sweeps = getattr(cluster, "_migration_coord_down_sweeps", 0) + 1
+    cluster._migration_coord_down_sweeps = sweeps
+    if sweeps >= RESIZING_COORD_DOWN_SWEEPS:
+        cluster._migration_coord_down_sweeps = 0
+        cluster.migration = None
+
+
 def _recover_stuck_resizing(cluster: Cluster, client) -> None:
     """A non-coordinator stuck in RESIZING self-heals here: a removed
     node never receives the commit broadcast (it isn't in the new
@@ -607,6 +1015,7 @@ def _recover_stuck_resizing(cluster: Cluster, client) -> None:
     would have restored the state. The coordinator's own view is
     authoritative: if it reports any steady state — or is dead — the
     resize no longer exists and the gate must reopen."""
+    _recover_stale_migration(cluster)
     if cluster.state != STATE_RESIZING:
         # Not resizing: clear any debounce left by a PREVIOUS job so the
         # next resize starts its DOWN count from zero.

@@ -392,47 +392,23 @@ class Fragment:
         abs_cols = cols + np.uint64(self.shard * SHARD_WIDTH)
         return self.bulk_import(rows.tolist(), abs_cols.tolist(), clear=clear)
 
-    #: bit budget per streamed transfer chunk (~8 MB of positions);
-    #: always at least one whole row per chunk.
+    #: bit budget per streamed transfer chunk (~8 MB of positions):
+    #: the resize migration streamer slices rows_snapshot into PTS1
+    #: import requests of at most this many (row, col) pairs.
     TRANSFER_CHUNK_BITS = 1 << 20
 
     def to_roaring(self) -> bytes:
         """Serialize all bits in the reference's pos-encoded roaring
         format (the fragment-data transfer format, fragment.go:2436).
-        For transfer paths prefer to_roaring_range — this materializes
-        the WHOLE fragment."""
+        This materializes the WHOLE fragment — transfer paths (resize,
+        sync) instead chunk rows_snapshot through the PTS1 import
+        stream in TRANSFER_CHUNK_BITS batches."""
         from pilosa_tpu import native
         parts = [pos + np.uint64(rid * SHARD_WIDTH)
                  for rid, pos in self.rows_snapshot()]
         positions = (np.concatenate(parts) if parts
                      else np.empty(0, dtype=np.uint64))
         return native.encode_roaring(positions)
-
-    def to_roaring_range(self, start_row: int = 0,
-                         max_bits: int | None = None
-                         ) -> tuple[bytes, int | None]:
-        """One streaming chunk: rows from ``start_row`` until ~max_bits
-        accumulate. Returns (roaring_blob, next_row | None) — the cursor
-        protocol behind /internal/fragment/data, so resize/sync never
-        hold a whole multi-GB fragment in memory (reference analog: the
-        container-range tar stream, fragment.go:2436-2557)."""
-        from pilosa_tpu import native
-        max_bits = max_bits or self.TRANSFER_CHUNK_BITS
-        with self._lock:
-            row_ids = sorted(r for r in self.rows if r >= start_row)
-            parts: list[np.ndarray] = []
-            bits = 0
-            next_row: int | None = None
-            for i, rid in enumerate(row_ids):
-                pos = self.rows[rid].to_positions()
-                parts.append(pos + np.uint64(rid * SHARD_WIDTH))
-                bits += len(pos)
-                if bits >= max_bits and i + 1 < len(row_ids):
-                    next_row = row_ids[i + 1]
-                    break
-        positions = (np.concatenate(parts) if parts
-                     else np.empty(0, dtype=np.uint64))
-        return native.encode_roaring(positions), next_row
 
     # -- reads -------------------------------------------------------------
 

@@ -17,6 +17,7 @@ import numpy as np
 from pilosa_tpu.cluster.cluster import (
     STATE_DEGRADED,
     STATE_NORMAL,
+    STATE_REMOVED,
     STATE_RESIZING,
 )
 from pilosa_tpu.config import SHARD_WIDTH
@@ -67,11 +68,11 @@ class API:
     #: method-availability matrix per cluster state (reference
     #: api.go:99-105 validAPIMethods + :1379-1411 method sets): during
     #: STARTING only control-plane traffic flows; during RESIZING only
-    #: control plane + fragment streaming + abort. Everything else —
-    #: queries, imports, schema changes — is refused so a write can't
-    #: land on a ring position the committed topology (and the holder
-    #: GC) won't honor.
-    _METHODS_RESIZING = frozenset({"fragment-data", "resize-abort"})
+    #: control plane + abort. (Serve-through resize never enters
+    #: RESIZING; the state survives for manual/legacy transitions. The
+    #: old fragment-data pull path is gone — fragments move over the
+    #: PTS1 import stream now.)
+    _METHODS_RESIZING = frozenset({"resize-abort"})
 
     def _validate(self, method: str) -> None:
         if self.cluster is None:
@@ -80,6 +81,15 @@ class API:
         if state in (STATE_NORMAL, STATE_DEGRADED):
             return
         if state == STATE_RESIZING and method in self._METHODS_RESIZING:
+            return
+        if (method in ("import", "import-value", "import-roaring")
+                and getattr(self.cluster, "migration", None) is not None
+                and state != STATE_REMOVED):
+            # Mid-migration dual-apply legs (and the resize-push bulk
+            # stream itself) must land on a STARTING joiner: it has a
+            # migration table from resize-begin, which is the
+            # coordinator's explicit grant to receive data for shards
+            # it will own after the commit.
             return
         raise ApiMethodNotAllowedError(
             f"api method {method} not allowed in state {state}")
@@ -107,7 +117,17 @@ class API:
         ({"results": [...]} shape, handler.go:60-75) — or, for remote
         calls whose peer accepts them, binary frames (bytes) carrying
         Row results as roaring blobs (wire.encode_frames)."""
-        self._validate("query")
+        if (remote
+                and self.cluster is not None
+                and getattr(self.cluster, "migration", None) is not None
+                and self.cluster.state != STATE_REMOVED):
+            # Dual-apply write legs arrive as remote PQL (Set/Clear)
+            # and must land on a STARTING joiner mid-migration. Reads
+            # are never routed here pre-commit — the coordinator's
+            # old-ring placement doesn't know joiners exist.
+            pass
+        else:
+            self._validate("query")
         opt = ExecOptions(remote=remote, column_attrs=column_attrs,
                           exclude_row_attrs=exclude_row_attrs,
                           exclude_columns=exclude_columns)
@@ -339,7 +359,27 @@ class API:
                      int(t.replace(tzinfo=timezone.utc).timestamp())
                      for t in ts]
         f = self.holder.field(index, field)
+        for _attempt in range(3):
+            if self._route_import_pass(index, field, f, ts, clear, values,
+                                       order, sorted_shards, starts, ends,
+                                       cols_arr, rows_arr, vals_arr, epoch):
+                return
+        # Topology kept moving across every retry; the last idempotent
+        # pass still applied under SOME complete placement and marked
+        # dirty shards for the scrubber.
+
+    def _route_import_pass(self, index, field, f, ts, clear, values,
+                           order, sorted_shards, starts, ends,
+                           cols_arr, rows_arr, vals_arr, epoch) -> bool:
+        """One routing pass; returns True when the topology held still
+        for its whole duration. A resize commit landing mid-pass could
+        strand a shard batch on the old owners with no dual leg (the
+        migration table is cleared at commit), so the caller re-applies
+        — imports are idempotent — until owners and table were stable."""
+        v0 = self.cluster.topology_version
+        mig = getattr(self.cluster, "migration", None)
         remote: dict[str, tuple[Any, list[dict]]] = {}
+        dual: dict[str, tuple[Any, list[dict]]] = {}
         for s, e in zip(starts.tolist(), ends.tolist()):
             shard = int(sorted_shards[s])
             sel = order[s:e]
@@ -365,9 +405,40 @@ class API:
                     if ts_b is not None:
                         req["timestamps"] = ts_b
                     remote.setdefault(node.id, (node, []))[1].append(req)
-        for node, reqs in remote.values():
-            send_stream = getattr(self.cluster.client,
-                                  "send_import_stream", None)
+            if mig is not None:
+                # Serve-through resize: mirror each shard batch to the
+                # shard's future owners (AFTER old owners above, per
+                # the catch-up epoch guard's apply-order contract).
+                for node in mig.dual_targets(self.cluster, index, shard):
+                    if node.id == self.cluster.local_id:
+                        try:  # shrink: this node gains the shard
+                            if values is None:
+                                f.import_bits(
+                                    rows_b, cols,
+                                    [ts[i] for i in sel.tolist()]
+                                    if ts else None, clear=clear)
+                            else:
+                                f.import_values(cols, vals_b, clear=clear)
+                            self.cluster.stats.count(
+                                "cluster.resize.dualWrites")
+                        except (RuntimeError, LookupError, ValueError) as ex:
+                            self.cluster.dirty_shards.mark(index, shard)
+                            self.cluster.stats.count(
+                                "cluster.resize.dualWriteFailed")
+                            self.cluster._report_dual_write_failure(
+                                mig, node.id, ex)
+                        continue
+                    req = {"kind": "field", "index": index, "field": field,
+                           "shard": shard, "rowIDs": rows_b,
+                           "columnIDs": cols, "values": vals_b,
+                           "clear": clear}
+                    if ts_b is not None:
+                        req["timestamps"] = ts_b
+                    dual.setdefault(node.id, (node, []))[1].append(req)
+        send_stream = getattr(self.cluster.client,
+                              "send_import_stream", None)
+
+        def ship(node, reqs):
             if send_stream is not None and len(reqs) > 1:
                 send_stream(node, reqs)
             else:
@@ -376,6 +447,24 @@ class API:
                         node, index, field, r["shard"], rows=r["rowIDs"],
                         cols=r["columnIDs"], values=r["values"],
                         timestamps=r.get("timestamps"), clear=clear)
+        for node, reqs in remote.values():
+            ship(node, reqs)
+        for node, reqs in dual.values():
+            # Dual legs must not fail the user's import: the old-ring
+            # writes above already landed, so a target failure is the
+            # TARGET's problem — dirty-mark for scrub and tell the
+            # coordinator to fail it out of the job.
+            try:
+                ship(node, reqs)
+                self.cluster.stats.count("cluster.resize.dualWrites",
+                                         len(reqs))
+            except (ConnectionError, RuntimeError, LookupError) as ex:
+                for r in reqs:
+                    self.cluster.dirty_shards.mark(index, r["shard"])
+                self.cluster.stats.count("cluster.resize.dualWriteFailed")
+                self.cluster._report_dual_write_failure(mig, node.id, ex)
+        return (self.cluster.topology_version == v0
+                and getattr(self.cluster, "migration", None) is mig)
 
     def import_roaring(self, index: str, field: str, shard: int,
                        data: bytes, clear: bool = False) -> None:
@@ -386,14 +475,44 @@ class API:
         if f is None:
             raise FieldNotFoundError()
         if self.cluster is not None:
+            self._import_roaring_fanout(index, field, shard, data, clear, f)
+        else:
+            f.import_roaring(shard, data, clear=clear)
+
+    def _import_roaring_fanout(self, index, field, shard, data, clear, f):
+        for _attempt in range(3):
+            # Same mid-commit guard as _route_import: snapshot the
+            # migration table BEFORE resolving owners, re-apply (the
+            # roaring import is idempotent) if a resize moved the
+            # topology under this fan-out.
+            v0 = self.cluster.topology_version
+            mig = getattr(self.cluster, "migration", None)
             for node in self.cluster.shard_nodes(index, shard):
                 if node.id == self.cluster.local_id:
                     f.import_roaring(shard, data, clear=clear)
                 else:
                     self.cluster.client.send_import_roaring(
                         node, index, field, shard, data, clear)
-        else:
-            f.import_roaring(shard, data, clear=clear)
+            if mig is not None:
+                for node in mig.dual_targets(self.cluster, index, shard):
+                    try:
+                        if node.id == self.cluster.local_id:
+                            f.import_roaring(shard, data, clear=clear)
+                        else:
+                            self.cluster.client.send_import_roaring(
+                                node, index, field, shard, data, clear)
+                        self.cluster.stats.count(
+                            "cluster.resize.dualWrites")
+                    except (ConnectionError, RuntimeError,
+                            LookupError, ValueError) as ex:
+                        self.cluster.dirty_shards.mark(index, shard)
+                        self.cluster.stats.count(
+                            "cluster.resize.dualWriteFailed")
+                        self.cluster._report_dual_write_failure(
+                            mig, node.id, ex)
+            if (self.cluster.topology_version == v0
+                    and getattr(self.cluster, "migration", None) is mig):
+                return
 
     # -- export (api.go:500) -----------------------------------------------
 

@@ -829,7 +829,8 @@ class HTTPInternalClient:
             req["timestamps"] = timestamps
         self._post_import(node, req)
 
-    def send_import_stream(self, node, reqs, chunked: bool = False) -> int:
+    def send_import_stream(self, node, reqs, chunked: bool = False,
+                           qos_class: str | None = None) -> int:
         """POST many shard-batch import requests as ONE pipelined PTS1
         stream (/internal/import-stream): the peer decodes, WAL-appends,
         and device-uploads chunk k while chunk k+1 is still on the wire,
@@ -866,10 +867,13 @@ class HTTPInternalClient:
             body = _RewindableChunks(chunks) if chunked else b"".join(chunks)
             if self.breakers is not None:
                 self.breakers.check(node.id)
+            hdrs = {"Content-Type": wire.STREAM_CONTENT_TYPE}
+            if qos_class:
+                hdrs["X-Qos-Class"] = qos_class
             try:
                 status, msg, data = self._http(
                     self._url(node, "/internal/import-stream"), "POST",
-                    body, {"Content-Type": wire.STREAM_CONTENT_TYPE})
+                    body, hdrs)
             except OSError as e:
                 if self.breakers is not None:
                     self.breakers.record_failure(node.id)
@@ -935,44 +939,10 @@ class HTTPInternalClient:
                 f"?remote=true" + ("&clear=true" if clear else ""))
         self._request(node, "POST", path, data)
 
-    def fetch_fragment(self, node, index, field, view, shard) -> bytes:
-        url = self._url(
-            node, f"/internal/fragment/data?index={index}&field={field}"
-                  f"&view={view}&shard={shard}")
-        try:
-            status, _, data = self._http(url)
-        except OSError as e:
-            raise ConnectionError(f"node {node.id} unreachable: {e}") from e
-        if status >= 400:
-            raise LookupError(f"{node.id}: {data.decode(errors='replace')}")
-        return data
-
-    def fetch_fragment_chunks(self, node, index, field, view, shard):
-        """Streamed fragment transfer: yields bounded roaring blobs via
-        the after-row cursor, so neither side ever materializes a whole
-        multi-GB fragment (reference WriteTo/ReadFrom tar stream,
-        fragment.go:2436-2557). Every chunk rides the same pooled
-        connection — the per-chunk handshake used to dominate small
-        tail chunks."""
-        after = 0
-        while True:
-            url = self._url(
-                node, f"/internal/fragment/data?index={index}"
-                      f"&field={field}&view={view}&shard={shard}"
-                      f"&after={after}")
-            try:
-                status, msg, data = self._http(url)
-            except OSError as e:
-                raise ConnectionError(
-                    f"node {node.id} unreachable: {e}") from e
-            if status >= 400:
-                raise LookupError(
-                    f"{node.id}: {data.decode(errors='replace')}")
-            next_row = msg.get("X-Pilosa-Next-Row") or ""
-            yield data
-            if not next_row:
-                return
-            after = int(next_row)
+    # Fragment movement now rides the PTS1 import stream
+    # (send_import_stream with qos_class="internal") — the old
+    # /internal/fragment/data pull path (fetch_fragment /
+    # fetch_fragment_chunks) is gone.
 
     #: liveness probes use their own short timeout — the general 30s
     #: request timeout would make a blackholed peer stall every

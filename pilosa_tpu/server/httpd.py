@@ -240,6 +240,12 @@ def _make_handler(api: API):
                 return self._reply(400, {"error": "no import handler"})
             qos_ctl = getattr(api, "qos", None)
             gate = getattr(api, "ingest_gate", None)
+            # Raw route (bypasses _dispatch's params): read the QoS
+            # class header directly. Resize fragment migration streams
+            # as "internal" so it never starves interactive traffic;
+            # user bulk loads stay BATCH.
+            hdr = self.headers.get("X-Qos-Class") or ""
+            cls = normalize_class(hdr) if hdr else CLASS_BATCH
             applied = 0
             pressure = None
             fatal = None
@@ -252,10 +258,11 @@ def _make_handler(api: API):
                             with gate.admit(len(frame)):
                                 self._apply_import_chunk(
                                     wire.decode_import(frame), server,
-                                    qos_ctl)
+                                    qos_ctl, cls)
                         else:
                             self._apply_import_chunk(
-                                wire.decode_import(frame), server, qos_ctl)
+                                wire.decode_import(frame), server, qos_ctl,
+                                cls)
                         applied += 1
                     except (IngestBackpressureError, QueryShedError,
                             QuotaExceededError) as e:
@@ -281,9 +288,10 @@ def _make_handler(api: API):
                      str(max(1, int(pressure.retry_after + 0.5)))})
             return self._reply(200, {"applied": applied})
 
-        def _apply_import_chunk(self, req, server, qos_ctl):
+        def _apply_import_chunk(self, req, server, qos_ctl,
+                                cls=CLASS_BATCH):
             if qos_ctl is not None:
-                with qos_ctl.admit(CLASS_BATCH):
+                with qos_ctl.admit(cls):
                     server(req)
             else:
                 server(req)
@@ -926,19 +934,22 @@ def _build_routes(api: API):
             server(msg)
         return 200, {}
 
-    def get_fragment_data(pv, params, body):
-        # Allowed during RESIZING (the resize streams fragments through
-        # this route, reference methodsResizing api.go:1384).
-        api.validate_method("fragment-data")
-        frag = api.holder.fragment(params["index"], params["field"],
-                                   params["view"], int(params["shard"]))
-        if frag is None:
-            raise FragmentNotFoundError()
-        if "after" in params:  # streaming cursor (one bounded chunk)
-            blob, next_row = frag.to_roaring_range(int(params["after"]))
-            return 200, blob, {"X-Pilosa-Next-Row": ""
-                               if next_row is None else next_row}
-        return 200, frag.to_roaring()
+    # (The old GET /internal/fragment/data pull route is gone: resize
+    # fragment movement rides the PTS1 import stream — resumable,
+    # IngestGate-budgeted, QoS-classed — instead of a bespoke puller.)
+
+    def get_debug_resize(pv, params, body):
+        """Live serve-through resize state: the coordinator's job (per-
+        shard migrated/in-flight counts, cutover lag) and/or this
+        member's migration table. {"job": null, "migration": null} at
+        rest — the probe a drill/operator polls while the ring moves."""
+        job = getattr(api, "resize_job", None)
+        mig = (getattr(api.cluster, "migration", None)
+               if api.cluster is not None else None)
+        return 200, {
+            "job": job.snapshot() if job is not None else None,
+            "migration": mig.snapshot() if mig is not None else None,
+        }
 
     def post_resize_abort(pv, params, body):
         job = getattr(api, "resize_job", None)
@@ -1169,8 +1180,8 @@ def _build_routes(api: API):
         (r"/internal/translate/entries", {"GET": get_translate_entries}),
         (r"/internal/cluster/message", {"POST": post_cluster_message}),
         (r"/internal/fragment/blocks", {"GET": get_fragment_blocks}),
-        (r"/internal/fragment/data", {"GET": get_fragment_data}),
         (r"/internal/fragment/nodes", {"GET": get_fragment_nodes}),
+        (r"/debug/resize", {"GET": get_debug_resize}),
         (r"/internal/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)"
          r"/remote-available-shards/(?P<shard>[0-9]+)",
          {"DELETE": delete_remote_available_shard}),

@@ -800,6 +800,22 @@ class ServerNode:
         elif t == "cluster-state" and self.cluster is not None:
             from pilosa_tpu.cluster.resize import apply_cluster_state
             apply_cluster_state(self.cluster, message["state"])
+        elif t == "resize-begin" and self.cluster is not None:
+            from pilosa_tpu.cluster.resize import apply_resize_begin
+            apply_resize_begin(self.cluster, message)
+        elif t == "resize-end" and self.cluster is not None:
+            from pilosa_tpu.cluster.resize import apply_resize_end
+            apply_resize_end(self.cluster, message)
+        elif t == "resize-push" and self.cluster is not None:
+            from pilosa_tpu.cluster.resize import handle_resize_push
+            return handle_resize_push(self.holder, self.cluster.client,
+                                      self.cluster, message)
+        elif t == "resize-shard-cutover":
+            from pilosa_tpu.cluster.resize import deliver_cutover
+            deliver_cutover(message, self.cluster)
+        elif t == "resize-dual-write-failed":
+            from pilosa_tpu.cluster.resize import deliver_dual_write_failed
+            deliver_dual_write_failed(message)
         elif t in ("delete-index", "delete-field", "delete-view"):
             # Apply to the holder (shared handler), then unlink the
             # on-disk tree: a peer that kept the stale files would

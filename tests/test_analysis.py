@@ -19,6 +19,7 @@ from pilosa_tpu.analysis.checkers import (
     epoch_audit,
     executor_lifecycle,
     jit_purity,
+    resize_cutover,
     shared_return,
     wire_symmetry,
 )
@@ -374,6 +375,67 @@ def test_executor_lifecycle_join_daemon_and_with_pass():
             return list(pool.map(work, items))
     """
     assert run_rule(executor_lifecycle, src) == []
+
+
+# -- resize-cutover ----------------------------------------------------------
+
+CUTOVER_BUG = """
+def finish_shard(cluster, holder, index, shard):
+    mig = cluster.migration
+    mig.mark_cutover(index, shard)
+    idx = holder.index(index)
+    idx.epoch.bump(shard=shard)
+"""
+
+
+def test_resize_cutover_catches_mark_before_bump():
+    # The pairing invariant this PR introduces: the shard-epoch bump
+    # must precede the cutover mark, or a reader can hit the new leg
+    # while cached results still vouch for the pre-catch-up epoch.
+    fs = run_rule(resize_cutover, CUTOVER_BUG,
+                  path="pilosa_tpu/cluster/resize.py")
+    assert len(fs) == 1 and "only AFTER" in fs[0].message
+    assert fs[0].rule == "resize-cutover"
+
+
+def test_resize_cutover_catches_missing_bump():
+    src = CUTOVER_BUG.replace("    idx.epoch.bump(shard=shard)\n", "")
+    fs = run_rule(resize_cutover, src,
+                  path="pilosa_tpu/cluster/resize.py")
+    assert len(fs) == 1 and "no shard-epoch bump" in fs[0].message
+
+
+def test_resize_cutover_bump_first_passes():
+    src = """
+    def finish_shard(cluster, holder, index, shard):
+        idx = holder.index(index)
+        if idx is not None:
+            idx.epoch.bump(shard=shard)
+        cluster.migration.mark_cutover(index, shard)
+    """
+    assert run_rule(resize_cutover, src,
+                    path="pilosa_tpu/cluster/resize.py") == []
+
+
+def test_resize_cutover_receivers_and_definition_exempt():
+    # deliver_*/apply_* adopt a cutover decided on the shard's new
+    # owner (whose bump preceded the announce); the method definition
+    # itself carries no obligation either.
+    src = """
+    class MigrationTable:
+        def mark_cutover(self, index, shard):
+            self._cutover.add((index, shard))
+
+    def deliver_cutover(message, cluster):
+        cluster.migration.mark_cutover(message["index"], message["shard"])
+    """
+    assert run_rule(resize_cutover, src,
+                    path="pilosa_tpu/cluster/resize.py") == []
+
+
+def test_resize_cutover_out_of_scope_module_ignored():
+    assert run_rule(resize_cutover, CUTOVER_BUG,
+                    path="pilosa_tpu/server/api.py") == []
 
 
 # -- engine: pragmas + the tree-is-clean contract ----------------------------

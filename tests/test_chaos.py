@@ -1,9 +1,10 @@
 """Randomized chaos soak (VERDICT r4 #7): a 3-node cluster of REAL
 server processes under a seeded random schedule of faults — SIGKILL,
-SIGSTOP/SIGCONT, remove-node, node (re)join, resize-abort — interleaved
-with concurrent writes, clears, batch imports, and queries.  At the end
-the cluster must converge to NORMAL and every live node must answer the
-full query surface exactly as a host-side oracle predicts.
+SIGSTOP/SIGCONT, remove-node, node (re)join, brand-new node admission
+(grow to 4), resize-abort — interleaved with concurrent writes, clears,
+batch imports, and queries.  At the end the cluster must converge to
+NORMAL and every live node must answer the full query surface exactly
+as a host-side oracle predicts.
 
 The reference's closest shape is the pumba scenario suite
 (internal/clustertests/cluster_test.go:28-95: dockerized pause +
@@ -106,7 +107,10 @@ class Soak:
     def __init__(self, tmp_path, seed: int):
         self.rng = random.Random(seed)
         self.tmp = tmp_path
-        self.ports = _free_ports(3)
+        # Slot 3 is reserved for act_add_node (grow under fire): a port
+        # and log name exist from the start, but the process and data
+        # dir only appear once the soak decides to admit a 4th member.
+        self.ports = _free_ports(4)
         self.addrs = [f"127.0.0.1:{p}" for p in self.ports]
         self.procs = {}
         self.paused: set[int] = set()
@@ -116,10 +120,10 @@ class Soak:
         for i in range(3):
             self.procs[i] = _spawn(
                 self.addrs[i],
-                [a for j, a in enumerate(self.addrs) if j != i],
+                [a for j, a in enumerate(self.addrs[:3]) if j != i],
                 self.dirs[i],
                 log_path=str(tmp_path / f"n{i}.log"))
-        for a in self.addrs:
+        for a in self.addrs[:3]:
             _wait_up(a)
         #: nodes currently under a slow-peer fault (best effort: a
         #: kill/restart clears the fault server-side on its own).
@@ -133,10 +137,11 @@ class Soak:
         #: final state fully determined.
         self.uncertain: set[tuple[int, int]] = set()
 
-    # -- fault actions (1 and 2 only; node0 is the stable coordinator) --
+    # -- fault actions (node0 is the stable coordinator; 1, 2 and the
+    # -- grown slot 3 are all fair game once admitted) ------------------
 
     def victims(self):
-        return [i for i in (1, 2) if i in self.procs]
+        return [i for i in (1, 2, 3) if i in self.procs]
 
     def act_kill(self):
         alive = [i for i in self.victims() if i not in self.paused]
@@ -156,7 +161,10 @@ class Soak:
                                log_path=str(self.tmp / f"n{i}.log"))
 
     def act_restart(self):
-        deadn = [i for i in (1, 2) if i not in self.procs]
+        # Slot 3 only counts as restartable once act_add_node admitted
+        # it at least once (it has a data dir from that admission).
+        deadn = [i for i in (1, 2, 3)
+                 if i not in self.procs and i in self.dirs]
         if not deadn:
             return
         i = self.rng.choice(deadn)
@@ -167,9 +175,21 @@ class Soak:
         else:
             self.procs[i] = _spawn(
                 self.addrs[i],
-                [a for j, a in enumerate(self.addrs) if j != i],
+                [a for j, a in enumerate(self.addrs[:3]) if j != i],
                 self.dirs[i],
                 log_path=str(self.tmp / f"n{i}.log"))
+
+    def act_add_node(self):
+        """Grow under fire: admit a brand-new 4th member through the
+        operator join flow while chaos is still running. Once admitted,
+        slot 3 is a full citizen — kill/pause/remove/corrupt/slow all
+        apply to it — and the heal phase settles the ring at four."""
+        if 3 in self.procs or 3 in self.dirs:
+            return
+        self.dirs[3] = str(self.tmp / "n3")
+        self.procs[3] = _spawn(self.addrs[3], [], self.dirs[3],
+                               join=self.addrs[0],
+                               log_path=str(self.tmp / "n3.log"))
 
     def act_pause(self):
         alive = [i for i in self.victims() if i not in self.paused]
@@ -318,6 +338,7 @@ class Soak:
         (3, "act_write_batch"), (2, "act_import_batch"), (2, "act_clear"),
         (4, "act_query"), (1, "act_kill"), (2, "act_restart"),
         (1, "act_pause"), (2, "act_resume"), (1, "act_remove_node"),
+        (1, "act_add_node"),
         (1, "act_resize_abort"), (1, "act_corrupt_snapshot"),
         (1, "act_slow_peer"), (1, "act_fast_peer"),
     )
@@ -349,11 +370,12 @@ class Soak:
                 _wait_up(self.addrs[i])
             except TimeoutError:
                 pass  # the settle loop below reaps and refills dead slots
-        # Wait for the ring to settle: every node NORMAL and the
-        # coordinator seeing 3 members. A node that restarted with its
-        # old data dir after a membership removal correctly parks in
-        # terminal REMOVED — recycle it through the operator flow
-        # (kill + fresh join).
+        # Wait for the ring to settle: every node NORMAL and every ring
+        # holding the expected member count — 3, or 4 once act_add_node
+        # grew the cluster (slot 3 has a data dir iff it was admitted).
+        # A node that restarted with its old data dir after a
+        # membership removal correctly parks in terminal REMOVED —
+        # recycle it through the operator flow (kill + fresh join).
         deadline = time.time() + 360
         last_abort = time.time()
         #: node -> when the coordinator's committed ring was first seen
@@ -376,12 +398,13 @@ class Soak:
                     sts[i] = _status(self.addrs[i])
                 except Exception:
                     pass
-            # EVERY node must hold the full 3-member ring: a
-            # (re)joined node can report NORMAL while still solo,
-            # and a solo member serves neither schema nor writes.
-            if (len(sts) == 3
+            # EVERY node must hold the full ring: a (re)joined node can
+            # report NORMAL while still solo, and a solo member serves
+            # neither schema nor writes.
+            expected = 3 + (1 if 3 in self.dirs else 0)
+            if (len(sts) == expected
                     and all(s["state"] == "NORMAL" for s in sts.values())
-                    and all(len(s["nodes"]) == 3
+                    and all(len(s["nodes"]) == expected
                             for s in sts.values())):
                 return
             for i, s in sts.items():

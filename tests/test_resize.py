@@ -380,17 +380,20 @@ def test_streaming_fragment_transfer_constant_memory(monkeypatch, rng):
     total_bits = frag.bit_count()
     assert total_bits > 8 * 2048
 
-    # Spy on the chunk sizes the cursor yields.
+    # Spy on the PTS1 stream the source pushes: per-request pair counts
+    # and the QoS class the migration rides under.
     sizes = []
-    orig = Fragment.to_roaring_range
+    qos_seen = []
+    orig = type(lc.client).send_import_stream
 
-    def spy(self, start_row=0, max_bits=None):
-        blob, nxt = orig(self, start_row, max_bits)
-        from pilosa_tpu import native
-        sizes.append(len(native.decode_roaring(blob)))
-        return blob, nxt
+    def spy(self, node, reqs, chunked=False, qos_class=None):
+        reqs = list(reqs)
+        qos_seen.append(qos_class)
+        sizes.extend(len(r.get("columnIDs") or []) for r in reqs
+                     if r.get("kind") == "fragment")
+        return orig(self, node, reqs, chunked=chunked, qos_class=qos_class)
 
-    monkeypatch.setattr(Fragment, "to_roaring_range", spy)
+    monkeypatch.setattr(type(lc.client), "send_import_stream", spy)
 
     other = [cn for cn in lc.nodes if cn.id != owner.id][0]
     from pilosa_tpu.cluster.resize import ResizeSource, apply_resize_instruction
@@ -404,9 +407,9 @@ def test_streaming_fragment_transfer_constant_memory(monkeypatch, rng):
     for r in range(40):
         np.testing.assert_array_equal(got.row_words(r), frag.row_words(r))
     assert len(sizes) > 4                      # really chunked
-    # Each chunk bounded: budget + at most one whole row's overshoot.
-    assert max(sizes) <= 2048 + SHARD_WIDTH
+    assert max(sizes) <= 2048                  # each request bounded
     assert sum(sizes) == total_bits            # no loss, no duplication
+    assert qos_seen and all(q == "internal" for q in qos_seen)
 
 
 def test_fragment_sources_skips_removed_node():
@@ -1062,3 +1065,444 @@ def test_stateless_ex_coordinator_rejoin_hands_over_flag(tmp_path):
                 n.close()
             except Exception:
                 pass
+
+
+# ---------------------------------------------------------------------------
+# Serve-through resize (zero-downtime elasticity): the ring answers reads
+# and writes for the whole job; writes on in-flight shards dual-apply to
+# old and future owners; per-shard cutover happens only after the target
+# holds a complete epoch-current copy; aborted/killed streams leave the
+# old ring authoritative and a re-run resumes from the applied prefix.
+# ---------------------------------------------------------------------------
+
+
+def _boot_joiner(lc: LocalCluster, node_id=None, port=None) -> Node:
+    """Register a fresh empty member on the shared transport (operator
+    booted a process with --join); returns its ring Node."""
+    from pilosa_tpu.cluster.cluster import STATE_STARTING
+    from pilosa_tpu.cluster.harness import ClusterNode
+    if node_id is None:
+        node_id = f"node{len(lc.nodes)}"
+    if port is None:
+        port = 10130 + len(lc.nodes)
+    member = Node(id=node_id, uri=URI(port=port))
+    ring = [Node(id=n.id, uri=n.uri) for n in lc[0].cluster.nodes]
+    c = Cluster(node_id, ring + [member],
+                replica_n=lc[0].cluster.replica_n, client=lc.client)
+    c.set_state(STATE_STARTING)
+    cn = ClusterNode(node_id, c)
+    cn.apply_schema(lc[0].holder.schema())
+    lc.client.register(node_id, cn)
+    lc.nodes.append(cn)
+    return member
+
+
+def _old_ring(lc: LocalCluster) -> list[Node]:
+    return [Node(id=n.id, uri=n.uri) for n in lc[0].cluster.nodes]
+
+
+def test_serve_through_resize_reads_and_writes(monkeypatch):
+    """Mid-migration (first PTS1 push in flight) the ring still answers
+    queries under the old placement and dual-applies writes; the
+    mid-stream write survives the cutover onto the new ring."""
+    from pilosa_tpu.cluster.client import LocalClient
+    from pilosa_tpu.obs.stats import MemoryStats
+    lc = LocalCluster(2)
+    cols = seed(lc)
+    stats = MemoryStats()
+    member = _boot_joiner(lc)
+    # One shared sink: bytesStreamed counts on the source, cutover and
+    # shardsMigrated on the target, dualWrites on the write coordinator.
+    for cn in lc.nodes:
+        cn.cluster.stats = stats
+    orig = LocalClient.send_import_stream
+    mid = []
+
+    def spy(self, node, reqs, chunked=False, qos_class=None):
+        reqs = list(reqs)
+        if not mid:
+            sh = reqs[0]["shard"]
+            # Read served under the OLD placement while the copy is
+            # mid-flight, with no resize gate in the way.
+            mid.append(lc.query("i", "Count(Row(f=1))", cache=False))
+            # Write into the shard being streamed RIGHT NOW: it must
+            # dual-apply (old owner + future owner) and survive cutover.
+            lc.query("i", f"Set({sh * SHARD_WIDTH + 123}, f=1)")
+            mig = lc[0].cluster.migration
+            assert mig is not None
+            # /debug/resize halves, live mid-stream: the job is RUNNING
+            # with this shard in flight and the table names the new ring.
+            snap = job.snapshot()
+            assert snap["state"] == "RUNNING"
+            assert snap["shards"]["inFlight"] >= 1
+            msnap = mig.snapshot()
+            assert member.id in msnap["newNodes"]
+            assert msnap["job"] == snap["job"]
+        return orig(self, node, reqs, chunked=chunked, qos_class=qos_class)
+
+    monkeypatch.setattr(LocalClient, "send_import_stream", spy)
+    job = ResizeJob(lc[0].cluster, lc[0].holder, lc.client)
+    assert job.run(_old_ring(lc) + [member]) == "DONE"
+    assert mid == [[len(cols)]]                     # served mid-stream
+    for node in range(3):
+        assert lc.query("i", "Count(Row(f=1))", node=node,
+                        cache=False) == [len(cols) + 1]
+    # Telemetry: the job surfaced its progress counters.
+    assert stats.counter_value("cluster.resize.shardsMigrated") >= 1
+    assert stats.counter_value("cluster.resize.bytesStreamed") > 0
+    assert stats.timing_count("cluster.resize.cutover") >= 1
+
+
+def _fatten_shard(lc: LocalCluster, shard: int, n_bits: int, seed_: int,
+                  row: int = 0):
+    rng_ = np.random.default_rng(seed_)
+    rows = np.full(n_bits, row, dtype=np.uint64)
+    cols = (rng_.integers(0, SHARD_WIDTH, n_bits).astype(np.uint64)
+            + np.uint64(shard * SHARD_WIDTH))
+    owner = lc[0].cluster.shard_nodes("i", shard)[0]
+    lc.client.peers[owner.id].handle_import_request("i", "f",
+                                                    rows=rows, cols=cols)
+    return owner
+
+
+def _moved_shard(lc: LocalCluster, member: Node, n_shards: int = 6) -> int:
+    """A shard whose primary owner under the grown ring is the joiner."""
+    new_view = Cluster("x", _old_ring(lc) + [member],
+                       replica_n=lc[0].cluster.replica_n)
+    for s in range(n_shards):
+        if new_view.shard_nodes("i", s)[0].id == member.id:
+            return s
+    raise AssertionError("no shard moves to the joiner")
+
+
+def test_abort_mid_stream_leaves_ring_routable_then_resume(monkeypatch):
+    """ResizeJob.abort mid-PTS1-stream: the partially-migrated shard
+    stays routable (old owner authoritative), every member drops its
+    migration table, and a later re-run converges to DONE."""
+    from pilosa_tpu.core.fragment import Fragment
+    from pilosa_tpu.cluster.client import LocalClient
+    monkeypatch.setattr(Fragment, "TRANSFER_CHUNK_BITS", 2048)
+    lc = LocalCluster(2)
+    cols = seed(lc)
+    member = _boot_joiner(lc)
+    big = _moved_shard(lc, member)
+    _fatten_shard(lc, big, 12_000, seed_=1)
+    expect = lc.query("i", "Count(Row(f=0))", cache=False)
+    job = ResizeJob(lc[0].cluster, lc[0].holder, lc.client)
+    orig = LocalClient.send_import_stream
+    torn = []
+
+    def spy(self, node, reqs, chunked=False, qos_class=None):
+        reqs = list(reqs)
+        if not torn:
+            torn.append(node.id)
+            n = max(1, len(reqs) // 2)
+            orig(self, node, reqs[:n], chunked=chunked, qos_class=qos_class)
+            job.abort()
+            raise ConnectionError("stream torn down by abort")
+        return orig(self, node, reqs, chunked=chunked, qos_class=qos_class)
+
+    monkeypatch.setattr(LocalClient, "send_import_stream", spy)
+    assert job.run(_old_ring(lc) + [member]) == "ABORTED"
+    # Old ring authoritative and fully routable; tables dropped ring-wide.
+    assert len(lc[0].cluster.nodes) == 2
+    assert all(cn.cluster.migration is None for cn in lc.nodes)
+    for node in range(2):
+        assert lc.query("i", "Count(Row(f=1))", node=node,
+                        cache=False) == [len(cols)]
+        assert lc.query("i", "Count(Row(f=0))", node=node,
+                        cache=False) == expect
+    # Resume: a fresh job re-streams (sets are idempotent — the applied
+    # prefix on the target is simply re-covered) and commits.
+    job2 = ResizeJob(lc[0].cluster, lc[0].holder, lc.client)
+    assert job2.run(_old_ring(lc) + [member]) == "DONE"
+    assert len(lc[0].cluster.nodes) == 3
+    for node in range(3):
+        assert lc.query("i", "Count(Row(f=1))", node=node,
+                        cache=False) == [len(cols)]
+        assert lc.query("i", "Count(Row(f=0))", node=node,
+                        cache=False) == expect
+
+
+def test_kill_target_mid_shard_then_resume(monkeypatch):
+    """Target dies mid-shard: the job FAILS (old topology intact, ring
+    keeps serving), the target retains the applied prefix, and a re-run
+    resumes over PTS1 to a bit-identical copy."""
+    from pilosa_tpu.core.fragment import Fragment
+    from pilosa_tpu.cluster.client import LocalClient
+    monkeypatch.setattr(Fragment, "TRANSFER_CHUNK_BITS", 2048)
+    lc = LocalCluster(2)
+    cols = seed(lc)
+    member = _boot_joiner(lc)
+    big = _moved_shard(lc, member)
+    owner = _fatten_shard(lc, big, 12_000, seed_=2)
+    src_frag = lc.client.peers[owner.id].holder.fragment(
+        "i", "f", "standard", big)
+    total = src_frag.bit_count()
+    job = ResizeJob(lc[0].cluster, lc[0].holder, lc.client)
+    orig = LocalClient.send_import_stream
+    killed = []
+
+    def spy(self, node, reqs, chunked=False, qos_class=None):
+        reqs = list(reqs)
+        if not killed and any(r["shard"] == big and r["field"] == "f"
+                              for r in reqs):
+            killed.append(node.id)
+            keep = [r for r in reqs
+                    if r["shard"] == big and r["field"] == "f"]
+            n = max(1, len(keep) // 2)
+            orig(self, node, keep[:n], chunked=chunked, qos_class=qos_class)
+            raise ConnectionError("target killed mid-shard")
+        return orig(self, node, reqs, chunked=chunked, qos_class=qos_class)
+
+    monkeypatch.setattr(LocalClient, "send_import_stream", spy)
+    assert job.run(_old_ring(lc) + [member]) == "FAILED"
+    assert killed == [member.id]
+    # Applied prefix survives on the target: strictly partial copy.
+    part = lc.client.peers[member.id].holder.fragment(
+        "i", "f", "standard", big)
+    assert part is not None and 0 < part.bit_count() < total
+    # Ring serves throughout, from the old placement.
+    assert len(lc[0].cluster.nodes) == 2
+    for node in range(2):
+        assert lc.query("i", "Count(Row(f=1))", node=node,
+                        cache=False) == [len(cols)]
+    # Resume: the re-run streams the remainder (idempotent sets over the
+    # prefix) and the final copy is bit-identical to the source.
+    job2 = ResizeJob(lc[0].cluster, lc[0].holder, lc.client)
+    assert job2.run(_old_ring(lc) + [member]) == "DONE"
+    got = lc.client.peers[member.id].holder.fragment(
+        "i", "f", "standard", big)
+    assert got is not None and got.bit_count() == total
+    assert got.checksum_blocks() == src_frag.checksum_blocks()
+
+
+@pytest.mark.parametrize("gen_seed", [7, 77, 777])
+def test_generative_dual_ownership_equivalence(monkeypatch, gen_seed):
+    """Random Set/Clear/import interleaved with every stage of a grow
+    resize must leave the elastic ring bit-identical to a no-resize
+    control ring fed the same operations (no lost writes, no
+    resurrected bits across the dual-ownership window)."""
+    from pilosa_tpu.cluster.client import LocalClient
+    rng_ = np.random.default_rng(gen_seed)
+    lc = LocalCluster(2)
+    ctl = LocalCluster(2)
+    for ring in (lc, ctl):
+        ring.create_index("i")
+        ring.create_field("i", "f")
+    n_rows, n_shards = 3, 4
+    col_space = n_shards * SHARD_WIDTH
+
+    def routed_import(ring, rows, cols):
+        by: dict[int, tuple[list, list]] = {}
+        for r, c in zip(rows, cols):
+            rs, cs = by.setdefault(int(c) // SHARD_WIDTH, ([], []))
+            rs.append(int(r))
+            cs.append(int(c))
+        cl = ring[0].cluster
+        for sh, (rs, cs) in by.items():
+            # Owner legs FIRST, dual legs after — the same ordering the
+            # server's import router uses (the catch-up epoch guard
+            # depends on it).
+            old_ids = [n.id for n in cl.shard_nodes("i", sh)]
+            mig = cl.migration
+            dual = ([n.id for n in mig.dual_targets(cl, "i", sh)
+                     if n.id not in old_ids] if mig is not None else [])
+            for nid in old_ids + dual:
+                ring.client.peers[nid].handle_import_request(
+                    "i", "f", rows=rs, cols=cs)
+
+    def batch(k=10):
+        for _ in range(k):
+            kind = int(rng_.integers(0, 3))
+            if kind == 2:
+                n = int(rng_.integers(1, 30))
+                rs = rng_.integers(0, n_rows, n)
+                cs = rng_.integers(0, col_space, n)
+                for ring in (lc, ctl):
+                    routed_import(ring, rs, cs)
+                continue
+            r = int(rng_.integers(0, n_rows))
+            c = int(rng_.integers(0, col_space))
+            op = "Set" if kind == 0 else "Clear"
+            for ring in (lc, ctl):
+                ring.query("i", f"{op}({c}, f={r})")
+
+    batch(30)
+    member = _boot_joiner(lc)
+    orig = LocalClient.send_import_stream
+
+    def spy(self, node, reqs, chunked=False, qos_class=None):
+        reqs = list(reqs)
+        batch(4)   # races the bulk copy's snapshot
+        out = orig(self, node, reqs, chunked=chunked, qos_class=qos_class)
+        batch(4)   # lands in the catch-up window, pre-cutover
+        return out
+
+    monkeypatch.setattr(LocalClient, "send_import_stream", spy)
+    job = ResizeJob(lc[0].cluster, lc[0].holder, lc.client)
+    assert job.run(_old_ring(lc) + [member]) == "DONE"
+    monkeypatch.setattr(LocalClient, "send_import_stream", orig)
+    batch(15)      # post-commit traffic on the grown ring
+    for r in range(n_rows):
+        want = ctl.query("i", f"Row(f={r})",
+                         cache=False)[0].columns().tolist()
+        for node in range(len(lc.nodes)):
+            got = lc.query("i", f"Row(f={r})", node=node,
+                           cache=False)[0].columns().tolist()
+            assert got == want, (gen_seed, r, node)
+
+
+@pytest.mark.slow
+def test_elastic_soak_grow_shrink_under_fire():
+    """Soak drill: a node is ADDED and then a different node REMOVED
+    while a query storm and a background PTS1 ingest keep running.
+    Asserts zero failed queries, zero lost or resurrected bits
+    (oracle scrub + cross-replica checksum agreement), and a
+    resize-window p99 bounded against the steady-state p99."""
+    import threading
+    import time as _time
+    from pilosa_tpu.obs.stats import MemoryStats
+
+    lc = LocalCluster(3, replica_n=2)
+    lc.create_index("i")
+    lc.create_field("i", "f")
+    stats = MemoryStats()
+    for cn in lc.nodes:
+        cn.cluster.stats = stats
+    n_rows, n_shards = 2, 4
+    col_space = n_shards * SHARD_WIDTH
+
+    # Seed enough bulk that the migration streams take real time (the
+    # fire window the storm must survive).
+    seed_rng = np.random.default_rng(3)
+    seed_rows = seed_rng.integers(0, n_rows, 30_000).astype(np.uint64)
+    seed_cols = seed_rng.integers(0, col_space, 30_000).astype(np.uint64)
+    oracle: set[tuple[int, int]] = set()
+
+    def pts1_send(rows_b, cols_b):
+        """Route one import batch the way the server's import router
+        does: current owners first, then the migration table's dual
+        targets; re-send (idempotent) if the topology committed under
+        us mid-batch."""
+        cl = lc[0].cluster
+        for _attempt in range(4):
+            v0 = cl.topology_version
+            by: dict[int, tuple[list, list]] = {}
+            for r, c in zip(rows_b, cols_b):
+                rs, cs = by.setdefault(int(c) // SHARD_WIDTH, ([], []))
+                rs.append(int(r))
+                cs.append(int(c))
+            for sh, (rs, cs) in by.items():
+                mig = cl.migration
+                owners = list(cl.shard_nodes("i", sh))
+                seen = {o.id for o in owners}
+                dual = ([n for n in mig.dual_targets(cl, "i", sh)
+                         if n.id not in seen] if mig is not None else [])
+                reqs = [{"index": "i", "field": "f",
+                         "rowIDs": rs, "columnIDs": cs}]
+                for n in owners + dual:
+                    lc.client.send_import_stream(n, reqs,
+                                                 qos_class="batch")
+            if cl.topology_version == v0:
+                return
+        raise AssertionError("topology kept moving across 4 resends")
+
+    pts1_send(seed_rows, seed_cols)
+    oracle.update(zip(seed_rows.tolist(), seed_cols.tolist()))
+
+    stop = threading.Event()
+    failures: list[str] = []
+    phase = ["steady"]
+
+    def storm():
+        qrng = np.random.default_rng(5)
+        # node0 and node1 are members for the whole drill (node3 joins,
+        # node2 leaves) — query both so reads cross the wire.
+        while not stop.is_set():
+            r = int(qrng.integers(0, n_rows))
+            node = int(qrng.integers(0, 2))
+            t0 = _time.monotonic()
+            try:
+                out = lc.query("i", f"Count(Row(f={r}))", node=node,
+                               cache=False)
+                assert isinstance(out[0], int)
+            except Exception as e:  # noqa: BLE001 - any failure = drill fail
+                failures.append(repr(e))
+            stats.timing(f"elastic.query.{phase[0]}",
+                         _time.monotonic() - t0)
+
+    def ingest():
+        irng = np.random.default_rng(9)
+        while not stop.is_set():
+            kind = int(irng.integers(0, 4))
+            try:
+                if kind == 3 and oracle:
+                    # Clear a bit this thread set earlier: exercises the
+                    # no-resurrection half of the dual-apply contract.
+                    r, c = sorted(oracle)[int(irng.integers(0, len(oracle)))]
+                    lc.query("i", f"Clear({c}, f={r})")
+                    oracle.discard((r, c))
+                elif kind == 2:
+                    r = int(irng.integers(0, n_rows))
+                    c = int(irng.integers(0, col_space))
+                    lc.query("i", f"Set({c}, f={r})")
+                    oracle.add((r, c))
+                else:
+                    n = int(irng.integers(20, 200))
+                    rs = irng.integers(0, n_rows, n)
+                    cs = irng.integers(0, col_space, n)
+                    pts1_send(rs, cs)
+                    oracle.update(zip(rs.tolist(), cs.tolist()))
+            except Exception as e:  # noqa: BLE001
+                failures.append("ingest: " + repr(e))
+            _time.sleep(0.005)
+
+    threads = [threading.Thread(target=storm, daemon=True),
+               threading.Thread(target=ingest, daemon=True)]
+    for t in threads:
+        t.start()
+    try:
+        _time.sleep(1.2)                 # steady-state timing baseline
+        phase[0] = "fire"
+        grown = lc.add_node()            # grow under fire
+        for cn in lc.nodes:
+            cn.cluster.stats = stats
+        _time.sleep(0.5)
+        lc.remove_node("node2")          # shrink under fire
+        _time.sleep(0.5)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+    assert not any(t.is_alive() for t in threads)
+    assert failures == [], failures[:5]
+    assert {cn.id for cn in lc.nodes} == {"node0", "node1", grown.id}
+
+    # p99 during the resize window bounded vs steady state (floor
+    # absorbs scheduler noise on tiny absolute latencies).
+    assert stats.timing_count("elastic.query.steady") > 0
+    assert stats.timing_count("elastic.query.fire") > 0
+    steady = stats.timing_quantile("elastic.query.steady", 0.99)
+    fire = stats.timing_quantile("elastic.query.fire", 0.99)
+    assert fire <= 3 * max(steady, 0.05), (steady, fire)
+
+    # Scrub-verify: exact oracle state on every member, from every
+    # coordinator (no lost writes, no resurrected bits)...
+    for r in range(n_rows):
+        want = sorted(c for rr, c in oracle if rr == r)
+        for node in range(len(lc.nodes)):
+            got = lc.query("i", f"Row(f={r})", node=node,
+                           cache=False)[0].columns().tolist()
+            assert got == want, (r, lc.nodes[node].id,
+                                 len(got), len(want))
+    # ...and bit-identical replicas (checksum agreement shard by shard).
+    cl = lc[0].cluster
+    for sh in range(n_shards):
+        sums = {}
+        for n in cl.shard_nodes("i", sh):
+            frag = lc.client.peers[n.id].holder.fragment(
+                "i", "f", "standard", sh)
+            sums[n.id] = frag.checksum_blocks() if frag is not None else {}
+        assert len({tuple(sorted(s.items())) for s in sums.values()}) == 1, \
+            (sh, sorted(sums))

@@ -265,31 +265,30 @@ def test_two_node_http_cluster():
         b.close()
 
 
-def test_fragment_data_streaming_cursor(node):
-    """/internal/fragment/data with after= returns bounded chunks plus
-    the X-Pilosa-Next-Row cursor header."""
-    from pilosa_tpu.core.fragment import Fragment
-    from pilosa_tpu import native
+def test_fragment_stream_over_pts1(node):
+    """Fragment movement rides the PTS1 import stream: kind="fragment"
+    requests round-trip the wire under the internal QoS class, and the
+    old /internal/fragment/data pull route is gone."""
     b = node.address
     req(b, "POST", "/index/i", "{}")
     req(b, "POST", "/index/i/field/f", "{}")
-    body = json.dumps({"rowIDs": [0] * 600 + [1] * 600 + [2] * 600,
-                       "columnIDs": (list(range(600)) * 3)})
-    req(b, "POST", "/index/i/field/f/import", body)
-    old = Fragment.TRANSFER_CHUNK_BITS
-    Fragment.TRANSFER_CHUNK_BITS = 512
-    try:
-        from pilosa_tpu.server.httpclient import HTTPInternalClient
-        from pilosa_tpu.cluster.node import Node as CNode, URI
-        client = HTTPInternalClient()
-        peer = CNode(id=node.id, uri=URI(host=node.host, port=node.port))
-        chunks = list(client.fetch_fragment_chunks(peer, "i", "f",
-                                                   "standard", 0))
-        assert len(chunks) == 3            # one row per 512-bit chunk
-        total = sum(len(native.decode_roaring(c)) for c in chunks)
-        assert total == 1800
-    finally:
-        Fragment.TRANSFER_CHUNK_BITS = old
+    from pilosa_tpu.server.httpclient import HTTPInternalClient
+    from pilosa_tpu.cluster.node import Node as CNode, URI
+    client = HTTPInternalClient()
+    peer = CNode(id=node.id, uri=URI(host=node.host, port=node.port))
+    reqs = [{"kind": "fragment", "index": "i", "field": "f",
+             "view": "standard", "shard": 0,
+             "rowIDs": [5] * 300, "columnIDs": list(range(300))},
+            {"kind": "fragment", "index": "i", "field": "f",
+             "view": "standard", "shard": 0,
+             "rowIDs": [5] * 300, "columnIDs": list(range(300, 600))}]
+    applied = client.send_import_stream(peer, reqs, qos_class="internal")
+    assert applied == 2
+    status, resp = req(b, "POST", "/index/i/query", "Count(Row(f=5))")
+    assert resp == {"results": [600]}
+    status, _ = req(b, "GET", "/internal/fragment/data?index=i&field=f"
+                              "&view=standard&shard=0")
+    assert status == 404
 
 
 def test_debug_routes(node):
@@ -301,6 +300,16 @@ def test_debug_routes(node):
     r = urllib.request.urlopen(b + "/debug/threads", timeout=10)
     body = r.read().decode()
     assert "---" in body and ("Thread" in body or "MainThread" in body)
+
+
+def test_debug_resize_at_rest(node):
+    """GET /debug/resize answers even with no job running: both the
+    coordinator-job and migration-table halves read null at rest, so a
+    drill can poll the same probe before, during, and after a resize."""
+    status, v = req(node.address, "GET", "/debug/resize")
+    assert status == 200
+    assert set(v) == {"job", "migration"}
+    assert v["job"] is None and v["migration"] is None
 
 
 def test_tls_server(tmp_path):
