@@ -6,6 +6,7 @@ from pilosa_tpu.analysis.checkers import (
     epoch_audit,
     executor_lifecycle,
     jit_purity,
+    residency_pairing,
     resize_cutover,
     shared_return,
     wire_symmetry,
@@ -19,6 +20,7 @@ ALL_CHECKERS = [
     contextvar_hygiene,
     executor_lifecycle,
     resize_cutover,
+    residency_pairing,
 ]
 
 RULES = [c.RULE for c in ALL_CHECKERS]

@@ -137,6 +137,12 @@ _DEFAULTS = {
     "dispatch_coalesce": "auto",
     "dispatch_coalesce_us": 150.0,
     "inline_transfer": "auto",
+    # Device residency: packed [S, K] index stacks for low-cardinality
+    # rows ("auto" packs only rows at least 8x smaller than the dense
+    # plane; bit-identical) and the pipelined async upload path for
+    # non-resident leaf stacks.
+    "residency_packed": "auto",
+    "prefetch": "on",
     # Per-query cost profiles: retain the slowest N at /debug/queries
     # (0 disables the ring). profile_queries=False limits profiling to
     # explicit ?profile=true requests.
@@ -251,6 +257,10 @@ def cmd_server(args) -> int:
         cfg["dispatch_coalesce_us"] = args.dispatch_coalesce_us
     if args.inline_transfer is not None:
         cfg["inline_transfer"] = args.inline_transfer
+    if args.residency_packed is not None:
+        cfg["residency_packed"] = args.residency_packed
+    if args.prefetch is not None:
+        cfg["prefetch"] = args.prefetch
     if args.profile_ring is not None:
         cfg["profile_ring_n"] = args.profile_ring
     if args.profile_queries is not None:
@@ -307,6 +317,8 @@ def cmd_server(args) -> int:
         dispatch_coalesce=str(cfg["dispatch_coalesce"]) or "auto",
         dispatch_coalesce_us=float(cfg["dispatch_coalesce_us"]),
         inline_transfer=str(cfg["inline_transfer"]) or "auto",
+        residency_packed=str(cfg["residency_packed"]) or "auto",
+        prefetch=str(cfg["prefetch"]) or "on",
         profile_ring_n=int(cfg["profile_ring_n"]),
         profile_queries=(str(cfg["profile_queries"]).lower()
                          in ("1", "true", "yes", "on")),
@@ -747,6 +759,11 @@ def cmd_generate_config(args) -> int:
           'dispatch-coalesce = "auto"\n'
           'dispatch-coalesce-us = 150.0\n'
           'inline-transfer = "auto"\n'
+          '# device residency: packed index stacks for low-cardinality\n'
+          '# rows (auto|on|off, bit-identical) and pipelined async\n'
+          '# uploads for non-resident leaf stacks (on|off)\n'
+          'residency-packed = "auto"\n'
+          'prefetch = "on"\n'
           '# per-query cost profiles: slowest-N retention ring served\n'
           '# at /debug/queries (0 disables); profile-queries = false\n'
           '# limits profiling to explicit ?profile=true requests\n'
@@ -868,6 +885,15 @@ def main(argv: list[str] | None = None) -> int:
                    help="resolve a device->host wave on its waiter's "
                         "thread when it is the only waiter (default "
                         "auto)")
+    s.add_argument("--residency-packed", choices=("on", "off", "auto"),
+                   default=None,
+                   help="pack low-cardinality rows as sorted-index "
+                        "stacks on device instead of dense bit planes "
+                        "(default auto = pack rows at least 8x smaller "
+                        "packed; bit-identical)")
+    s.add_argument("--prefetch", choices=("on", "off"), default=None,
+                   help="upload non-resident leaf stacks asynchronously "
+                        "ahead of query execution (default on)")
     s.add_argument("--profile-ring", type=int, default=None,
                    help="retain the slowest N query cost profiles at "
                         "/debug/queries (default 64; 0 disables)")

@@ -430,6 +430,16 @@ class Fragment:
                 return bitops.np_zero_row()
             return hr.to_words()
 
+    def row_cardinality(self, row_id: int) -> int:
+        """Set-bit count of one row, O(1) (HostRow maintains it
+        incrementally); 0 for absent rows. Lockless like `contains`:
+        the planner's residency class policy reads this per shard at
+        plan time, and an off-by-a-few count under a concurrent write
+        only shifts WHICH representation class is chosen, never
+        correctness."""
+        hr = self.rows.get(row_id)
+        return 0 if hr is None else hr.count()
+
     def row_upload(self, row_id: int):
         """Cheapest faithful host form for a device upload:
         ``("dense", uint32[W])`` or ``("sparse", uint64[positions])``

@@ -142,6 +142,12 @@ class DistributedMeshPlanner(MeshPlanner):
         self.coalesce_vmap_supported = False
         self.fuse_aggregates_supported = False
         self.fuse_const_supported = False
+        # Packed residency would need a packed variant of the global
+        # per-process assembly below; prefetch would run stack builds on
+        # ONE process's worker thread, desyncing the collective launch
+        # order every other process expects. Both stay off here.
+        self.residency_packed_supported = False
+        self.prefetch_supported = False
         self._pid = jax.process_index()
         flat = list(self.mesh.devices.reshape(-1))
         #: (device, global mesh position) for this process's devices.

@@ -41,11 +41,13 @@ from pilosa_tpu.errors import (
     QueryError,
 )
 from pilosa_tpu.exec import fuse as _fuse
+from pilosa_tpu.exec import residency as _residency
 from pilosa_tpu.obs import profile as _profile
 from pilosa_tpu.obs.histogram import WIDTH_BOUNDS, LogHistogram
 from pilosa_tpu.ops import bitops, bsi as bsi_ops
 from pilosa_tpu.parallel.batcher import TransferBatcher
 from pilosa_tpu.parallel.coalesce import DispatchCoalescer
+from pilosa_tpu.parallel.prefetch import ResidencyPrefetcher
 from pilosa_tpu.parallel.mesh import (
     SHARD_AXIS,
     make_mesh,
@@ -86,6 +88,10 @@ class MeshPlanner:
         self._stack_cache: "OrderedDict[tuple, tuple[int, tuple, jax.Array]]" = \
             OrderedDict()
         self._cache_bytes = 0
+        #: resident bytes per representation class (the key's last
+        #: element) — the compression win is invisible in the single
+        #: total; /debug/device renders the split.
+        self._class_bytes = {k: 0 for k in _residency.REPR_CLASSES}
         #: lifetime stack-cache evictions (budget pressure), for the
         #: runtime monitor / /debug/heap — churn in the oversubscribed
         #: regime is invisible without it.
@@ -171,6 +177,19 @@ class MeshPlanner:
         #: trees); off for the distributed planner, whose const upload
         #: would need cross-process placement.
         self.fuse_const_supported = True
+        #: packed [S, K] index stacks for low-cardinality rows
+        #: (exec/residency); off for the distributed planner — its
+        #: _build_stack assembles per-process dense fragments and has
+        #: no packed assembly path yet.
+        self.residency_packed_supported = True
+        #: async upload pipeline for non-resident leaf stacks; off for
+        #: the distributed planner (its stack builds must run on every
+        #: process of the mesh in lockstep, not on one node's worker).
+        self.prefetch_supported = True
+        #: pipelined miss path: prepare peeks the plan's leaf set and
+        #: schedules async uploads here; _stack_rows rendezvouses with
+        #: inflight uploads instead of re-building (parallel.prefetch).
+        self.prefetcher = ResidencyPrefetcher(self, stats=stats)
 
     # ------------------------------------------------------------------
     # public API
@@ -236,16 +255,19 @@ class MeshPlanner:
                 hit = self._plan_cache.get(plan_key)
                 if hit is not None:
                     self._plan_cache.move_to_end(plan_key)
+            if hit is not None:
+                hit = self._revalidate_plan(idx, plan_key, hit, tuple(shards))
         if hit is not None:
-            leaves, fn = hit
+            leaves, fn = hit[0], hit[1]
         else:
             leaves = []
-            sig = self._signature(idx, c, leaves)
-            fn = self._compiled(("count",) + sig, c, idx,
+            sig = self._signature(idx, c, leaves, tuple(shards))
+            fn = self._compiled(("count",) + sig, sig,
                                 reduce="per_shard")
             if const_rows is None:
                 with self._cache_lock:
-                    self._plan_cache[plan_key] = (leaves, fn)
+                    self._plan_cache[plan_key] = (leaves, fn,
+                                                  idx.epoch.value)
                     while len(self._plan_cache) > self.PLAN_CACHE_SIZE:
                         self._plan_cache.popitem(last=False)
                     # Record the executable form (with the Count
@@ -257,10 +279,41 @@ class MeshPlanner:
                     self._observed.move_to_end(okey)
                     while len(self._observed) > self.OBSERVED_SIZE:
                         self._observed.popitem(last=False)
+        self._prefetch_leaves(idx, leaves, tuple(shards))
         arrays = [self._fetch_leaf(idx, leaf, tuple(shards),
                                    const_rows=const_rows)
                   for leaf in leaves]
         return fn, arrays
+
+    def _revalidate_plan(self, idx: Index, plan_key: tuple, hit: tuple,
+                         shards: tuple):
+        """Representation-class staleness check for prepared plans. The
+        class is baked into the compiled program (a ``pleaf`` node runs
+        packed kernels), and the plan cache deliberately survives data
+        mutations — so a packed leaf whose row has since grown past the
+        packing ceiling would keep uploading ever-larger index stacks.
+        O(1) on the hot path: only an index-epoch move triggers the
+        per-leaf cardinality walk, and only packed leaves are checked
+        (a dense plan is always correct; rows rarely shrink). A changed
+        class drops the plan entry and the caller replans."""
+        leaves, fn, seen_epoch = hit
+        epoch = idx.epoch.value
+        if seen_epoch == epoch:
+            return hit
+        for leaf in leaves:
+            if leaf[0] != "prow":
+                continue
+            _, field_name, view, row_id = leaf
+            if self._leaf_class(idx, field_name, view, row_id,
+                                shards) != _residency.PACKED:
+                with self._cache_lock:
+                    self._plan_cache.pop(plan_key, None)
+                return None
+        hit = (leaves, fn, epoch)
+        with self._cache_lock:
+            if plan_key in self._plan_cache:
+                self._plan_cache[plan_key] = hit
+        return hit
 
     @staticmethod
     def _sum_host(host) -> int:
@@ -340,11 +393,12 @@ class MeshPlanner:
                     const_rows: list | None = None) -> jax.Array:
         """Evaluate a bitmap tree to its stacked [S_pad, W] device array."""
         leaves: list[tuple] = []
-        sig = self._signature(idx, c, leaves)
+        sig = self._signature(idx, c, leaves, tuple(shards))
+        self._prefetch_leaves(idx, leaves, tuple(shards))
         arrays = [self._fetch_leaf(idx, leaf, tuple(shards),
                                    const_rows=const_rows)
                   for leaf in leaves]
-        fn = self._compiled(("row",) + sig, c, idx, reduce=None)
+        fn = self._compiled(("row",) + sig, sig, reduce=None)
         out = fn(*arrays)
         self._record_dispatch(1)
         _fuse.add_fused_steps(_fuse.call_steps(c))
@@ -415,18 +469,22 @@ class MeshPlanner:
             if hit is not None:
                 self._plan_cache.move_to_end(plan_key)
         if hit is not None:
-            leaves, fn = hit
+            hit = self._revalidate_plan(idx, plan_key, hit, tuple(shards))
+        if hit is not None:
+            leaves, fn = hit[0], hit[1]
         else:
             leaves = [("bsiagg", field_name, depth)]
-            filt_sig = (self._signature(idx, c.children[0], leaves)
+            filt_sig = (self._signature(idx, c.children[0], leaves,
+                                        tuple(shards))
                         if c.children else None)
             full_sig = (kind, is_min, depth, filt_sig)
             fn = self._compiled_agg(full_sig, kind, depth, filt_sig,
                                     is_min)
             with self._cache_lock:
-                self._plan_cache[plan_key] = (leaves, fn)
+                self._plan_cache[plan_key] = (leaves, fn, idx.epoch.value)
                 while len(self._plan_cache) > self.PLAN_CACHE_SIZE:
                     self._plan_cache.popitem(last=False)
+        self._prefetch_leaves(idx, leaves, tuple(shards))
         arrays = [self._fetch_leaf(idx, leaf, tuple(shards))
                   for leaf in leaves]
         return fn, arrays, depth
@@ -695,11 +753,20 @@ class MeshPlanner:
         # can't save us). Row-heavy GroupBys keep the per-shard
         # streaming path, which is O(tile) in device memory.
         n_stacks = sum(len(rows) for rows in cands)
-        stack_bytes = n_stacks * self._pad(len(shards)) * WORDS_PER_SHARD * 4
+        stack_bytes = n_stacks * _residency.dense_nbytes(
+            self._pad(len(shards)))
         if stack_bytes > min(self.max_cache_bytes, 2 << 30):
             return None
         filt = (self._tree_stack(idx, filter_call, shards)
                 if filter_call is not None else None)
+        # The GroupBy lattice stays on dense stacks (intersections
+        # accumulate across levels), but its row uploads still ride the
+        # async pipeline: prefetch the union of candidate rows.
+        self._prefetch_leaves(
+            idx,
+            [("row", fields[i], VIEW_STANDARD, r)
+             for i, rows in enumerate(cands) for r in rows],
+            tuple(shards))
         stacks = [
             {r: self._stack_rows(idx, fields[i], VIEW_STANDARD, r,
                                  tuple(shards))
@@ -736,6 +803,7 @@ class MeshPlanner:
             self._filter_host_cache.clear()
             self._plan_cache.clear()
             self._cache_bytes = 0
+            self._class_bytes = {k: 0 for k in _residency.REPR_CLASSES}
 
     def drop_index(self, index_name: str) -> None:
         """Evict one index's entries from the stack/filter/plan caches.
@@ -744,7 +812,9 @@ class MeshPlanner:
         discard its scratch index without losing the warmed kernels."""
         with self._cache_lock:
             for key in [k for k in self._stack_cache if k[0] == index_name]:
-                self._cache_bytes -= self._stack_cache.pop(key)[2].nbytes
+                nb = _residency.stack_nbytes(self._stack_cache.pop(key)[2])
+                self._cache_bytes -= nb
+                self._class_bytes[key[6]] -= nb
             for key in [k for k in self._filter_host_cache
                         if k[0] == index_name]:
                 del self._filter_host_cache[key]
@@ -760,7 +830,9 @@ class MeshPlanner:
                     for (i, q, s), n in self._observed.items()]
 
     def close(self) -> None:
-        """Release caches and stop the coalescer + batcher threads."""
+        """Release caches and stop the prefetcher + coalescer + batcher
+        threads."""
+        self.prefetcher.close()
         self.coalescer.close()
         self.invalidate()
         self.batcher.close()
@@ -775,6 +847,8 @@ class MeshPlanner:
                    "uploads": self._uploads,
                    "upload_bytes": self._upload_bytes,
                    "bucket_policy": self.bucket_policy,
+                   "class_bytes": dict(self._class_bytes),
+                   "residency_mode": _residency.mode(),
                    "programs": len(self._fn_cache)}
         with self._dispatch_lock:
             out["dispatches"] = self.dispatches
@@ -782,24 +856,50 @@ class MeshPlanner:
         return out
 
     def device_debug(self) -> dict:
-        """The /debug/device payload's planner half: residency, churn,
-        compiled-program population, and the lifetime coalesce
-        batch-width histogram."""
+        """The /debug/device payload's planner half: residency (per
+        representation class), churn, the prefetch pipeline, compiled-
+        program population, and the lifetime coalesce batch-width
+        histogram."""
         out = self.cache_stats()
         with self._dispatch_lock:
             out["batch_width_hist"] = self._width_hist.snapshot()
         out["queue_depth"] = self.coalescer.queue_depth()
         out["transfer"] = self.batcher.debug()
+        out["prefetch"] = self.prefetcher.debug()
         return out
 
     # ------------------------------------------------------------------
     # tree → structural signature + leaf list
     # ------------------------------------------------------------------
 
-    def _signature(self, idx: Index, c: Call, leaves: list[tuple]) -> tuple:
+    def _leaf_class(self, idx: Index, field_name: str, view: str,
+                    row_id: int, shards: tuple) -> str:
+        """Representation class for one row stack: measure the largest
+        per-shard cardinality (O(1) per fragment — HostRow maintains
+        the count incrementally) and apply the residency policy
+        (exec/residency.choose_class). Dense whenever the planner can't
+        carry packed stacks (distributed mesh) or the knob is off."""
+        if not (shards and self.residency_packed_supported
+                and _residency.mode() != "off"):
+            return _residency.DENSE
+        max_bits = 0
+        for shard in shards:
+            frag = self.holder.fragment(idx.name, field_name, view, shard)
+            if frag is not None:
+                n = frag.row_cardinality(row_id)
+                if n > max_bits:
+                    max_bits = n
+        return _residency.choose_class(max_bits)
+
+    def _signature(self, idx: Index, c: Call, leaves: list[tuple],
+                   shards: tuple = ()) -> tuple:
         """DFS the call tree, appending leaf specs and returning a
         hashable structure key. Leaf position in `leaves` is its input
-        slot in the compiled function."""
+        slot in the compiled function. ``shards`` lets standard row
+        leaves choose their representation class by measured
+        cardinality — a packed leaf appends a ``prow`` descriptor and
+        signs as ``pleaf``, so the class is part of the structural
+        signature and compiled programs specialize per class."""
         name = c.name
         if name in ("Row", "Range"):
             if c.has_condition_arg():
@@ -818,6 +918,11 @@ class MeshPlanner:
             from_time = tq.parse_time(c.args["from"]) if "from" in c.args else None
             to_time = tq.parse_time(c.args["to"]) if "to" in c.args else None
             if name == "Row" and from_time is None and to_time is None:
+                if self._leaf_class(idx, field_name, VIEW_STANDARD, row_id,
+                                    shards) == _residency.PACKED:
+                    leaves.append(("prow", field_name, VIEW_STANDARD,
+                                   row_id))
+                    return ("pleaf", len(leaves) - 1)
                 leaves.append(("row", field_name, VIEW_STANDARD, row_id))
             else:
                 q = f.time_quantum()
@@ -836,16 +941,17 @@ class MeshPlanner:
                     f"index does not support existence tracking: {idx.name}")
             leaves.append(("row", ef.name, VIEW_STANDARD, 0))
             slot = len(leaves) - 1
-            child = self._signature(idx, c.children[0], leaves)
+            child = self._signature(idx, c.children[0], leaves, shards)
             return ("not", slot, child)
         if name == "Shift":
             n = c.args.get("n", 0)  # IntArg default, executor.go:1770
-            child = self._signature(idx, c.children[0], leaves)
+            child = self._signature(idx, c.children[0], leaves, shards)
             return ("shift", n, child)
         if name in ("Intersect", "Union", "Xor", "Difference"):
             if not c.children:
                 raise QueryError(f"empty {name} query is currently not supported")
-            kids = tuple(self._signature(idx, ch, leaves) for ch in c.children)
+            kids = tuple(self._signature(idx, ch, leaves, shards)
+                         for ch in c.children)
             return (name.lower(), kids)
         if name == "__const__":
             # Partial-fusion leaf: a host-computed Row injected as a
@@ -948,9 +1054,14 @@ class MeshPlanner:
         return tuple(out)
 
     def _stack_rows(self, idx: Index, field_name: str, view: str, row_id: int,
-                    shards: tuple) -> jax.Array:
-        """[S_pad, W] stack of one row across shards, device-put with the
-        shard sharding; cached until any involved fragment mutates.
+                    shards: tuple,
+                    klass: str = _residency.DENSE) -> jax.Array:
+        """Stack of one row across shards, device-put with the shard
+        sharding; cached until any involved fragment mutates. ``klass``
+        picks the representation: dense [S_pad, W] uint32 planes or a
+        packed [S_pad, K] int32 index stack (exec/residency) — each
+        class is its own cache entry (the key's last element), with the
+        same validation and the shared budget.
 
         Validation is two-tier: an O(1) index-epoch compare on the hot
         path, falling back to the per-fragment generation walk only when
@@ -959,7 +1070,8 @@ class MeshPlanner:
         instead of re-uploaded."""
         # instance_id: a deleted-and-recreated index restarts its epoch,
         # so name alone could serve the old index's stacks as fresh.
-        key = (idx.name, idx.instance_id, field_name, view, row_id, shards)
+        key = (idx.name, idx.instance_id, field_name, view, row_id, shards,
+               klass)
         epoch = idx.epoch.value
         with self._cache_lock:
             hit = self._stack_cache.get(key)
@@ -974,26 +1086,70 @@ class MeshPlanner:
                     return hit[2]
             else:
                 gens = None
+        # Pipelined miss path: if a prefetch worker is already uploading
+        # this stack, wait for it to land and re-read the cache — the
+        # wait is a prefetch HIT, not a synchronous upload. Workers skip
+        # the rendezvous (they ARE the inflight upload; waiting on their
+        # own key would deadlock) and their builds aren't misses.
+        if not self.prefetcher.is_worker():
+            # Re-check the cache even when no upload was in flight: it
+            # may have completed between our miss and the rendezvous.
+            self.prefetcher.wait(key)
+            with self._cache_lock:
+                hit = self._stack_cache.get(key)
+                if hit is not None and hit[0] == epoch:
+                    self._stack_cache.move_to_end(key)
+                    return hit[2]
+            self.prefetcher.note_sync_miss()
         # Build outside the lock: row materialization + device_put can be
         # slow, and fragments have their own locks. Two threads may race
         # to build the same stack; the second insert simply wins.
         if gens is None:
             gens = self._gens(idx.name, field_name, view, shards)
-        arr, nbytes = self._build_stack(idx, field_name, view, row_id, shards)
+        if klass == _residency.PACKED:
+            arr, nbytes = self._build_stack_packed(idx, field_name, view,
+                                                   row_id, shards)
+        else:
+            arr, nbytes = self._build_stack(idx, field_name, view, row_id,
+                                            shards)
+        self._insert_stack(key, epoch, gens, arr, nbytes)
+        return arr
+
+    def _insert_stack(self, key: tuple, epoch: int, gens: tuple, arr,
+                      nbytes: int, count_upload: bool = True) -> None:
+        """THE one cache-insertion/byte-accounting path for every
+        representation class (the hand-expanded nbytes loops this
+        replaces could drift the eviction budget independently).
+        Eviction is double-buffered: the new stack is inserted FIRST
+        and the LRU victims dropped after, so the upload that produced
+        ``arr`` overlapped the evictee's last use instead of
+        serializing behind the eviction (the transient overshoot is one
+        stack). The class is the key's last element; per-class bytes
+        feed /debug/device."""
+        klass = key[6]
         with self._cache_lock:
-            self._uploads += 1
-            self._upload_bytes += nbytes
+            if count_upload:
+                self._uploads += 1
+                self._upload_bytes += nbytes
             old = self._stack_cache.pop(key, None)
             if old is not None:
-                self._cache_bytes -= old[2].nbytes
-            while (self._stack_cache
-                   and self._cache_bytes + nbytes > self.max_cache_bytes):
-                _, (_, _, dropped) = self._stack_cache.popitem(last=False)
-                self._cache_bytes -= dropped.nbytes
-                self._cache_evictions += 1
+                old_nb = _residency.stack_nbytes(old[2])
+                self._cache_bytes -= old_nb
+                self._class_bytes[klass] -= old_nb
             self._stack_cache[key] = (epoch, gens, arr)
             self._cache_bytes += nbytes
-        return arr
+            self._class_bytes[klass] += nbytes
+            while (self._cache_bytes > self.max_cache_bytes
+                   and len(self._stack_cache) > 1):
+                k2, (_, _, dropped) = self._stack_cache.popitem(last=False)
+                nb = _residency.stack_nbytes(dropped)
+                self._cache_bytes -= nb
+                self._class_bytes[k2[6]] -= nb
+                self._cache_evictions += 1
+            class_bytes = dict(self._class_bytes)
+        if self.stats is not None:
+            for k, v in class_bytes.items():
+                self.stats.gauge(f"planner.residentBytes.{k}", v)
 
     #: rows with at most this many set bits upload as COO triplets
     #: (~12 B/word touched) instead of the 128 KiB dense block; on a
@@ -1017,7 +1173,7 @@ class MeshPlanner:
         planner to assemble a global array from each process's local
         fragment rows (jax.make_array_from_single_device_arrays)."""
         s_pad = self._pad(len(shards))
-        nbytes = s_pad * WORDS_PER_SHARD * 4  # HBM-resident size
+        nbytes = _residency.dense_nbytes(s_pad)  # HBM-resident size
         if not self._sparse_upload_enabled():
             mat = np.zeros((s_pad, WORDS_PER_SHARD), dtype=np.uint32)
             for i, shard in enumerate(shards):
@@ -1089,6 +1245,106 @@ class MeshPlanner:
         arr = self._assemble_jit(didx, dmat, ci, cw, cv, s_pad=s_pad)
         return arr, nbytes
 
+    def _build_stack_packed(self, idx: Index, field_name: str, view: str,
+                            row_id: int,
+                            shards: tuple) -> tuple[jax.Array, int]:
+        """Materialize one low-cardinality row as a packed [S_pad, K]
+        int32 stack of sorted in-shard column indices, sentinel-padded
+        (exec/residency): K is the pow2 bucket of the largest per-shard
+        cardinality, so both the upload and the HBM residency cost
+        ~4 B/set bit instead of the 128 KiB dense block. Rows that grew
+        past the packing ceiling since plan time still build correctly
+        (just bloated) — the plan revalidation drops the packed plan at
+        the next epoch move."""
+        s_pad = self._pad(len(shards))
+        rows: list[tuple[int, np.ndarray]] = []
+        max_bits = 0
+        for i, shard in enumerate(shards):
+            frag = self.holder.fragment(idx.name, field_name, view, shard)
+            if frag is None:
+                continue
+            kind, payload = frag.row_upload(row_id)
+            pos = (bitops.words_to_positions(payload) if kind == "dense"
+                   else payload)
+            if len(pos):
+                rows.append((i, pos))
+                if len(pos) > max_bits:
+                    max_bits = len(pos)
+        k = _residency.pack_width(max_bits)
+        mat = np.full((s_pad, k), _residency.SENTINEL, dtype=np.int32)
+        for i, pos in rows:
+            mat[i, :len(pos)] = pos.astype(np.int32)
+        arr = jax.device_put(mat, shard_spec(self.mesh))
+        return arr, _residency.packed_nbytes(s_pad, k)
+
+    def _leaf_stack_specs(self, idx: Index, leaves: list, shards: tuple):
+        """Expand leaf descriptors to the (field, view, row_id, class)
+        stacks execution will fetch — the plan-wide peek that lets the
+        miss path run ahead of the program. Mirrors _fetch_leaf's
+        resolution (BSI exists/sign/magnitude planes, time-range view
+        fan-out); zero/const/pred leaves have nothing to upload."""
+        from pilosa_tpu.core.fragment import (
+            BSI_EXISTS_BIT, BSI_OFFSET_BIT, BSI_SIGN_BIT,
+        )
+        for leaf in leaves:
+            kind = leaf[0]
+            if kind in ("row", "prow"):
+                _, field_name, view, row_id = leaf
+                klass = (_residency.PACKED if kind == "prow"
+                         else _residency.DENSE)
+                yield field_name, view, row_id, klass
+            elif kind in ("bsi", "bsiagg"):
+                _, field_name, depth = leaf
+                view = view_bsi_name(field_name)
+                yield field_name, view, BSI_EXISTS_BIT, _residency.DENSE
+                yield field_name, view, BSI_SIGN_BIT, _residency.DENSE
+                for i in range(depth):
+                    yield (field_name, view, BSI_OFFSET_BIT + i,
+                           _residency.DENSE)
+            elif kind == "row_time":
+                _, field_name, row_id, from_time, to_time, q = leaf
+                f = idx.field(field_name)
+                if f is None:
+                    continue
+                if to_time is None:
+                    import datetime as dt
+                    to_time = dt.datetime.now() + dt.timedelta(days=1)
+                if from_time is None:
+                    from_time, _ = f._time_view_bounds()
+                    if from_time is None:
+                        continue
+                for view_name in tq.views_by_time_range(
+                        VIEW_STANDARD, from_time, to_time, q):
+                    if f.view(view_name) is not None:
+                        yield field_name, view_name, row_id, _residency.DENSE
+
+    def _prefetch_leaves(self, idx: Index, leaves: list,
+                         shards: tuple) -> None:
+        """Pipelined miss path (tentpole front 2): peek the plan's FULL
+        leaf set before execution and issue async uploads for every
+        non-resident stack, so the query thread's later fetches only
+        ever wait on uploads already in flight (prefetch hits) instead
+        of starting their own (synchronous misses). The prefetcher's
+        inflight table dedupes by stack key, so coalesced waves of
+        same-plan queries prefetch the union of their leaves at the
+        cost of one upload each."""
+        if not (shards and self.prefetch_supported
+                and self.prefetcher.enabled()):
+            return
+        epoch = idx.epoch.value
+        for field_name, view, row_id, klass in self._leaf_stack_specs(
+                idx, leaves, shards):
+            key = (idx.name, idx.instance_id, field_name, view, row_id,
+                   shards, klass)
+            with self._cache_lock:
+                hit = self._stack_cache.get(key)
+                if hit is not None and hit[0] == epoch:
+                    continue  # resident and current
+            self.prefetcher.schedule(
+                key,
+                functools.partial(self._stack_rows, idx, field_name, view,
+                                  row_id, shards, klass))
+
     def _zeros_stack(self, n_shards: int) -> jax.Array:
         s_pad = self._pad(n_shards)
         return jax.device_put(
@@ -1133,6 +1389,13 @@ class MeshPlanner:
         if kind == "row":
             _, field_name, view, row_id = leaf
             return self._stack_rows(idx, field_name, view, row_id, shards)
+        if kind == "prow":
+            # Packed residency: [S_pad, K] sorted index stack; the
+            # compiled program's pleaf node expands or counts it with
+            # the class's kernel variants (exec/residency.KERNELS).
+            _, field_name, view, row_id = leaf
+            return self._stack_rows(idx, field_name, view, row_id, shards,
+                                    klass=_residency.PACKED)
         if kind == "row_time":
             _, field_name, row_id, from_time, to_time, q = leaf
             f = idx.field(field_name)
@@ -1195,7 +1458,7 @@ class MeshPlanner:
         per-fragment generation) validation as _stack_rows."""
         view = view_bsi_name(field_name)
         key = (idx.name, idx.instance_id, field_name, view,
-               ("planes", depth), shards)
+               ("planes", depth), shards, _residency.DENSE)
         epoch = idx.epoch.value
         with self._cache_lock:
             hit = self._stack_cache.get(key)
@@ -1221,31 +1484,26 @@ class MeshPlanner:
         else:
             zero = self._fetch_leaf(idx, ("zero",), shards)
             arr = jnp.zeros((0,) + zero.shape, zero.dtype)
-        nbytes = arr.nbytes
-        with self._cache_lock:
-            old = self._stack_cache.pop(key, None)
-            if old is not None:
-                self._cache_bytes -= old[2].nbytes
-            while (self._stack_cache
-                   and self._cache_bytes + nbytes > self.max_cache_bytes):
-                _, (_, _, dropped) = self._stack_cache.popitem(last=False)
-                self._cache_bytes -= dropped.nbytes
-                self._cache_evictions += 1
-            self._stack_cache[key] = (epoch, gens, arr)
-            self._cache_bytes += nbytes
+        # count_upload=False: the cube is stacked from already-uploaded
+        # (and upload-counted) per-plane rows — no new link traffic.
+        self._insert_stack(key, epoch, gens, arr,
+                           _residency.stack_nbytes(arr),
+                           count_upload=False)
         return arr
 
     # ------------------------------------------------------------------
     # compile: signature → jitted evaluator
     # ------------------------------------------------------------------
 
-    def _compiled(self, full_sig: tuple, c: Call, idx: Index,
+    def _compiled(self, full_sig: tuple, sig: tuple,
                   reduce: str | None) -> Callable:
+        """Compile a signature to its jitted program. ``sig`` is the
+        caller's already-walked signature — passing it (instead of
+        re-walking the tree) keeps the program and the leaf list from
+        ever disagreeing about a leaf's representation class."""
         fn = self._fn_cache.get(full_sig)
         if fn is not None:
             return fn
-        leaves: list[tuple] = []
-        sig = self._signature(idx, c, leaves)
 
         def evaluate(args):
             return _eval_node(sig, args)
@@ -1254,6 +1512,8 @@ class MeshPlanner:
         if reduce == "per_shard":
             program = self._pallas_count_program(sig)
             is_pallas = program is not None
+            if program is None:
+                program = _packed_count_program(sig)
             if program is None:
                 def program(*args):
                     return bitops.count(evaluate(args))
@@ -1344,12 +1604,43 @@ class MeshPlanner:
         return jax.jit(program)
 
 
+def _packed_count_program(sig: tuple):
+    """Count fast paths for packed leaves — the kernel variants the
+    representation classes were built for (exec/residency.KERNELS): a
+    bare packed leaf counts its indices without ever expanding
+    (popcount-over-indices); a 2-leaf Intersect picks sparse∧dense or
+    sparse∧sparse, so data motion tracks set bits, not shard width.
+    None for every other shape — the generic expand+popcount program
+    is still bit-identical, just dense-rate."""
+    if sig[0] == "pleaf":
+        count = _residency.kernel(_residency.PACKED, "count")
+        slot = sig[1]
+        return lambda *args: count(args[slot])
+    if sig[0] == "intersect" and len(sig) == 2 and len(sig[1]) == 2:
+        a, b = sig[1]
+        if a[0] == "pleaf" and b[0] == "pleaf":
+            pair = _residency.kernel(_residency.PACKED, "pair_count")
+            return lambda *args: pair(args[a[1]], args[b[1]])
+        if a[0] == "pleaf" and b[0] == "leaf":
+            and_count = _residency.kernel(_residency.PACKED, "and_count")
+            return lambda *args: and_count(args[a[1]], args[b[1]])
+        if a[0] == "leaf" and b[0] == "pleaf":
+            and_count = _residency.kernel(_residency.PACKED, "and_count")
+            return lambda *args: and_count(args[b[1]], args[a[1]])
+    return None
+
+
 def _eval_node(sig: tuple, args) -> jax.Array:
     """Recursively evaluate a signature node against leaf input arrays.
     Runs under jit: everything here is traced XLA ops on [S, W] blocks."""
     kind = sig[0]
     if kind == "leaf":
         return args[sig[1]]
+    if kind == "pleaf":
+        # Packed leaf in a general tree: expand the [S, K] index stack
+        # to dense planes INSIDE the program — HBM residency stays
+        # packed, the bitmap algebra stays dense and unchanged.
+        return _residency.kernel(_residency.PACKED, "expand")(args[sig[1]])
     if kind == "not":
         _, slot, child = sig
         existence = args[slot]

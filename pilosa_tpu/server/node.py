@@ -92,6 +92,8 @@ class ServerNode:
                  dispatch_coalesce: str = "auto",
                  dispatch_coalesce_us: float = 150.0,
                  inline_transfer: str = "auto",
+                 residency_packed: str = "auto",
+                 prefetch: str = "on",
                  profile_ring_n: int = 64,
                  profile_queries: bool = True):
         host, _, port = bind.partition(":")
@@ -326,6 +328,14 @@ class ServerNode:
         _dispatch_coalesce.set_mode(dispatch_coalesce)
         from pilosa_tpu.parallel import batcher as _transfer_batcher
         _transfer_batcher.set_inline_mode(inline_transfer)
+        # Device-residency knobs (README "Device residency & prefetch"):
+        # container-classed packed leaf stacks and the pipelined async
+        # miss path. Env vars PILOSA_TPU_RESIDENCY_PACKED /
+        # PILOSA_TPU_PREFETCH override per-run.
+        from pilosa_tpu.exec import residency as _residency
+        _residency.set_mode(residency_packed)
+        from pilosa_tpu.parallel import prefetch as _prefetch
+        _prefetch.set_mode(prefetch)
         # In-flight byte budget for the /internal/import-stream pipeline
         # (0 = unbounded); trips 429 + Retry-After, never queues.
         from pilosa_tpu.qos import IngestGate

@@ -19,6 +19,7 @@ from pilosa_tpu.analysis.checkers import (
     epoch_audit,
     executor_lifecycle,
     jit_purity,
+    residency_pairing,
     resize_cutover,
     shared_return,
     wire_symmetry,
@@ -436,6 +437,71 @@ def test_resize_cutover_receivers_and_definition_exempt():
 def test_resize_cutover_out_of_scope_module_ignored():
     assert run_rule(resize_cutover, CUTOVER_BUG,
                     path="pilosa_tpu/server/api.py") == []
+
+
+# -- residency-pairing -------------------------------------------------------
+
+PAIRING_BUG = """
+DENSE = "dense"
+PACKED = "packed"
+REPR_CLASSES = (DENSE, PACKED)
+
+KERNELS = {
+    (DENSE, "expand"): None,
+    (DENSE, "count"): None,
+    (DENSE, "and_count"): None,
+    (PACKED, "expand"): None,
+    (PACKED, "count"): None,
+}
+"""
+
+
+def test_residency_pairing_catches_missing_kernel_variant():
+    # The latent plan-time KeyError this rule encodes: a class in
+    # REPR_CLASSES whose kernel row is narrower than the dense
+    # contract only blows up when a query shape first routes the
+    # missing op at that class.
+    fs = run_rule(residency_pairing, PAIRING_BUG,
+                  path="pilosa_tpu/exec/residency.py")
+    assert len(fs) == 1 and "and_count" in fs[0].message
+    assert "'packed'" in fs[0].message
+    assert fs[0].rule == "residency-pairing"
+
+
+def test_residency_pairing_catches_undeclared_class():
+    src = PAIRING_BUG.replace(
+        '    (PACKED, "count"): None,',
+        '    (PACKED, "count"): None,\n'
+        '    (PACKED, "and_count"): None,\n'
+        '    ("packd", "expand"): None,')
+    fs = run_rule(residency_pairing, src,
+                  path="pilosa_tpu/exec/residency.py")
+    assert len(fs) == 1 and "'packd'" in fs[0].message
+    assert "REPR_CLASSES" in fs[0].message
+
+
+def test_residency_pairing_symmetric_tables_pass():
+    src = PAIRING_BUG.replace(
+        '    (PACKED, "count"): None,',
+        '    (PACKED, "count"): None,\n'
+        '    (PACKED, "and_count"): None,')
+    assert run_rule(residency_pairing, src,
+                    path="pilosa_tpu/exec/residency.py") == []
+
+
+def test_residency_pairing_out_of_scope_module_ignored():
+    assert run_rule(residency_pairing, PAIRING_BUG,
+                    path="pilosa_tpu/parallel/planner.py") == []
+
+
+def test_residency_pairing_non_table_module_ignored():
+    # exec/ modules without both tables carry no obligation.
+    src = """
+    DENSE = "dense"
+    REPR_CLASSES = (DENSE,)
+    """
+    assert run_rule(residency_pairing, src,
+                    path="pilosa_tpu/exec/fuse.py") == []
 
 
 # -- engine: pragmas + the tree-is-clean contract ----------------------------

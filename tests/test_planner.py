@@ -392,7 +392,10 @@ def _stack_key_rows(planner):
     return [k[4] for k in planner._stack_cache]
 
 
-def test_stack_cache_evicts_lru_and_accounts_bytes(mesh, rng):
+def test_stack_cache_evicts_lru_and_accounts_bytes(mesh, rng, monkeypatch):
+    # These rows are sparse enough to pack under residency auto mode;
+    # the exact byte arithmetic below is the dense class's contract.
+    monkeypatch.setenv("PILOSA_TPU_RESIDENCY_PACKED", "off")
     h = Holder()
     idx = h.create_index("ev")
     f = idx.create_field("f")
@@ -433,11 +436,13 @@ def test_stack_cache_evicts_lru_and_accounts_bytes(mesh, rng):
         assert c == counts[r]
 
 
-def test_stack_cache_eviction_does_not_break_inflight_refs(mesh, rng):
+def test_stack_cache_eviction_does_not_break_inflight_refs(mesh, rng,
+                                                           monkeypatch):
     """An evicted entry's device array may still be referenced by an
     in-flight prepared plan; eviction only drops the cache's ref, so
     the dispatch must keep returning correct results (planner.py notes
     strong refs pin entries mid-query)."""
+    monkeypatch.setenv("PILOSA_TPU_RESIDENCY_PACKED", "off")  # dense contract
     h = Holder()
     idx = h.create_index("ev2")
     f = idx.create_field("f")
