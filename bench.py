@@ -41,7 +41,6 @@ from __future__ import annotations
 
 import json
 import os
-import math
 import statistics
 import sys
 import threading
@@ -58,24 +57,51 @@ CONFIGS = os.environ.get("BENCH_CONFIGS", "all")
 DENSITY = float(os.environ.get("BENCH_DENSITY", 0.05))
 
 
+#: quarter-octave log buckets (1e-5 s .. ~20 s): fine enough that the
+#: interpolated quantile sits within a few percent of the nearest-rank
+#: value the old private lists produced, while staying O(buckets) no
+#: matter how many samples a bench takes.
+_BENCH_BOUNDS = tuple(1e-5 * (2 ** (i / 4)) for i in range(84))
+
+
+def _hist():
+    """A fresh latency histogram (seconds). Benches accumulate into
+    these instead of private lists — same bounded LogHistogram the
+    server's stats registry uses."""
+    from pilosa_tpu.obs.histogram import LogHistogram
+    return LogHistogram(_BENCH_BOUNDS)
+
+
 def _p99(lat_s):
-    """p99 in ms from a list of second-latencies (nearest-rank)."""
-    ranked = sorted(lat_s)
-    idx = max(0, math.ceil(0.99 * len(ranked)) - 1)
-    return ranked[idx] * 1e3
+    """p99 in ms from a LogHistogram of second-latencies (an iterable
+    of seconds is folded into one first)."""
+    h = lat_s if hasattr(lat_s, "quantile") else _observed(lat_s)
+    return h.quantile(0.99) * 1e3
+
+
+def _p50(h):
+    """p50 in ms from a LogHistogram of second-latencies."""
+    return h.quantile(0.50) * 1e3
+
+
+def _observed(lat_s):
+    h = _hist()
+    for v in lat_s:
+        h.observe(v)
+    return h
 
 
 def _timer(fn, n, threads=1):
     """(qps, p50_ms, p99_ms) over n calls; threads>1 = pipelined
     throughput. Tail latency comes from the sequential sample (the
     threaded phase measures occupancy, not per-call service time)."""
-    lat = []
+    h = _hist()
     for _ in range(min(n, N_LAT)):
         t0 = time.perf_counter()
         fn()
-        lat.append(time.perf_counter() - t0)
-    p50 = statistics.median(lat) * 1e3
-    p99 = _p99(lat)
+        h.observe(time.perf_counter() - t0)
+    p50 = _p50(h)
+    p99 = _p99(h)
     if threads <= 1:
         qps = 1e3 / p50 if p50 else float("inf")
         return qps, p50, p99
@@ -238,19 +264,18 @@ def bench_star_trace(extra):
     # Sequential latency: cold (one full device round-trip per query,
     # floor-bound by the link) and cached (the system behavior for any
     # repeated read until the next write).
-    lat = []
+    h = _hist()
     for _ in range(min(N_LAT, 15)):
         t0 = time.perf_counter()
         ex.execute("bench", q, shards=shards, cache=False)
-        lat.append(time.perf_counter() - t0)
-    extra["executor_count_intersect_cold_p50_ms"] = round(
-        statistics.median(lat) * 1e3, 2)
-    lat = []
+        h.observe(time.perf_counter() - t0)
+    extra["executor_count_intersect_cold_p50_ms"] = round(_p50(h), 2)
+    h = _hist()
     for _ in range(N_LAT):
         t0 = time.perf_counter()
         ex.execute("bench", q, shards=shards)
-        lat.append(time.perf_counter() - t0)
-    p50 = statistics.median(lat) * 1e3
+        h.observe(time.perf_counter() - t0)
+    p50 = _p50(h)
     extra["executor_count_intersect_p50_ms"] = round(p50, 3)
     extra["cols"] = n_shards * SHARD_WIDTH
 
@@ -405,12 +430,12 @@ def bench_star_trace(extra):
     extra["executor_count_intersect_second_boot_first_ms"] = round(
         (time.perf_counter() - t0) * 1e3, 2)
     assert got2 == expected, (got2, expected)
-    lat = []
+    h = _hist()
     for _ in range(min(N_LAT, 15)):
         t0 = time.perf_counter()
         ex2.execute("bench", q, shards=shards, cache=False)
-        lat.append(time.perf_counter() - t0)
-    p50_2boot = statistics.median(lat) * 1e3
+        h.observe(time.perf_counter() - t0)
+    p50_2boot = _p50(h)
     extra["executor_count_intersect_second_boot_cold_p50_ms"] = round(
         p50_2boot, 2)
     cc_after = compile_cache.stats()
@@ -756,7 +781,7 @@ def bench_oversubscribed(extra):
         ex.execute("over", f"Count(Row(f={r}))", shards=shards)
     ctl = AdmissionController(max_concurrent=2, max_queue=4)
     sheds = misses = 0
-    lat = []
+    lat = _hist()
 
     def one_query(r, qos_class, deadline_s):
         nonlocal sheds, misses
@@ -785,13 +810,12 @@ def bench_oversubscribed(extra):
         for i, fut in enumerate(futs):
             dt = fut.result()
             if dt is not None and i % 2 == 0:
-                lat.append(dt)
+                lat.observe(dt)
     planner.close()
     extra["oversub_qos_sheds"] = sheds
     extra["oversub_qos_deadline_misses"] = misses
-    if lat:
-        extra["oversub_admitted_p50_ms"] = round(
-            statistics.median(lat) * 1e3, 3)
+    if lat.count:
+        extra["oversub_admitted_p50_ms"] = round(_p50(lat), 3)
         extra["oversub_admitted_p99_ms"] = round(_p99(lat), 3)
     snap = ctl.snapshot()
     assert snap["shed"] == sheds and snap["deadlineMiss"] == misses
@@ -1014,21 +1038,18 @@ def bench_dispatch(extra):
     # the repeated-dashboard-query shape coalescing targets.
     storm_threads = min(THREADS, 16)
     storm_q = max(min(N_QUERIES, 256), 128)
-    lat_lock = threading.Lock()
 
     def storm():
-        lats: list[float] = []
+        lats = _hist()   # thread-safe: LogHistogram locks its observes
 
         def one(_):
             t0 = time.perf_counter()
             ex.execute("d", q, cache=False)
-            dt = time.perf_counter() - t0
-            with lat_lock:
-                lats.append(dt)
+            lats.observe(time.perf_counter() - t0)
 
         with ThreadPoolExecutor(max_workers=storm_threads) as pool:
             list(pool.map(one, range(storm_q)))
-        return statistics.median(lats) * 1e3
+        return _p50(lats)
 
     os.environ["PILOSA_TPU_DISPATCH_COALESCE"] = "on"
     try:
@@ -1119,12 +1140,12 @@ def bench_ingest(extra):
         post("/index/ing/field/f/import", body)
 
         def q99(k):
-            lat = []
+            h = _hist()
             for i in range(k):
                 t0 = time.perf_counter()
                 post("/index/ing/query", f"Count(Row(f={i % 8}))")
-                lat.append(time.perf_counter() - t0)
-            return _p99(lat)
+                h.observe(time.perf_counter() - t0)
+            return _p99(h)
 
         q99(10)  # warm
         stop = threading.Event()
@@ -1386,37 +1407,31 @@ def bench_cache(extra):
     extra["cache_dashboard_cold_qps"] = round(qps_c * len(panel), 1)
     extra["cache_dashboard_qps_gain"] = round(qps / max(qps_c, 1e-9), 1)
 
-    # churn series: one shard takes a write every 4th refresh. Full-span
-    # panel entries invalidate on the coordinator (their stamp covers
-    # the churned shard), but the UNAFFECTED node's leg cache stays
-    # valid, so the refresh is cheaper than fully cold — the per-shard
-    # selectivity payoff in cluster form.
-    ex0 = lc[0].executor
-    h0, m0 = ex0.result_cache.hits, ex0.result_cache.misses
-    churn_shard = 63
-    churn_owner = node_by_id[cl0.shard_nodes("d", churn_shard)[0].id]
-    tick = [0]
-
-    def dashboard_churn():
-        for qq in panel:
-            lc.query("d", qq)
-        tick[0] += 1
-        if tick[0] % 4 == 0:
-            churn_owner.holder.field("d", "a").set_bit(
-                1, churn_shard * SHARD_WIDTH + tick[0])
-            churn_owner.dirty.flush_now()
-
-    qps_w, _, _ = _timer(dashboard_churn, N_LAT, threads=4)
-    extra["cache_dashboard_churn_qps"] = round(qps_w * len(panel), 1)
-    hits = ex0.result_cache.hits - h0
-    misses = ex0.result_cache.misses - m0
-    extra["cache_dashboard_churn_hit_ratio"] = round(
-        hits / max(1, hits + misses), 3)
-    extra["cache_bytes"] = ex0.result_cache.total_bytes
+    extra["cache_bytes"] = lc[0].executor.result_cache.total_bytes
 
     assert extra["cache_hit_speedup"] >= 10, \
         f"hit p50 must be >=10x faster than miss: {extra['cache_hit_speedup']}"
     assert qps > qps_c, "cached dashboard qps must beat the cold path"
+
+    # churn-under-storm half, re-expressed as the ``dashboard_storm``
+    # loadgen scenario: a bursty repeated dashboard panel with a churn
+    # ingest trickle invalidating shards underneath it. Selective
+    # (per-shard) invalidation is what keeps the report's hit ratio
+    # high despite the writes.
+    from pilosa_tpu.loadgen import get_scenario, run_scenario
+
+    sc = get_scenario("dashboard_storm")
+    sc.duration_s = float(os.environ.get("BENCH_SCENARIO_SECONDS", "12"))
+    rep = run_scenario(sc)
+    extra["cache_storm_scenario"] = sc.name
+    extra["cache_storm_qps"] = rep["arrivals"]["rateAchieved"]
+    extra["cache_storm_p50_ms"] = \
+        rep["perClass"]["interactive"]["client"]["p50Ms"]
+    extra["cache_storm_p99_ms"] = \
+        rep["perClass"]["interactive"]["client"]["p99Ms"]
+    extra["cache_storm_hit_ratio"] = rep["cache"]["hitRatio"]
+    assert rep["cache"]["hitRatio"] >= 0.5, \
+        f"churned dashboard hit ratio collapsed: {rep['cache']['hitRatio']}"
 
 
 # ---------------------------------------------------------------------------
@@ -1488,80 +1503,49 @@ def bench_backup(extra):
 
 
 def bench_elastic(extra):
-    """Serve-through resize measured end to end: a 3-node replica_n=2
-    in-process ring serving a continuous Count storm while a node is
-    added and then a member removed. Reports the fire-vs-steady p99
-    ratio (the whole cost of the routing window), client-visible
-    failures (must stay 0 — there is no resize gate), and the volume
-    the migration moved over the PTS1 stream."""
-    import threading
+    """Serve-through resize re-expressed as a thin loadgen scenario: a
+    replica_n=2 cluster serving an open-loop mixed read stream while a
+    node joins mid-run and a member is removed later (the ``elastic``
+    scenario's chaos timeline). Queries must serve through both
+    cutovers with zero client-visible failures, and the report's
+    resize counters show the volume migrated over the PTS1 stream."""
+    from pilosa_tpu.loadgen import ManagedTarget, get_scenario, run_scenario
 
-    from pilosa_tpu.cluster.harness import LocalCluster
-    from pilosa_tpu.config import SHARD_WIDTH
-    from pilosa_tpu.obs.stats import MemoryStats
-
-    rng = np.random.default_rng(11)
-    n_shards = 6
-    lc = LocalCluster(3, replica_n=2)
-    lc.create_index("i")
-    lc.create_field("i", "f")
-    n_bits = 200_000
-    rows = rng.integers(0, 4, n_bits).astype(np.uint64)
-    cols = _rand_positions(rng, n_bits, n_shards * SHARD_WIDTH)
-    shard_of = (cols // np.uint64(SHARD_WIDTH)).astype(np.int64)
-    cl0 = lc.nodes[0].cluster
-    groups = cl0.shards_by_node(cl0.nodes, "i", list(range(n_shards)))
-    node_by_id = {cn.id: cn for cn in lc.nodes}
-    for node_id, shs in groups.items():
-        mask = np.isin(shard_of, shs)
-        node_by_id[node_id].handle_import_request(
-            "i", "f", rows=rows[mask], cols=cols[mask])
-
-    stats = MemoryStats()
-    for cn in lc.nodes:
-        cn.cluster.stats = stats
-    phase = ["steady"]
-    stop = threading.Event()
-    failures = []
-
-    def storm():
-        k = 0
-        while not stop.is_set():
-            k += 1
-            t0 = time.perf_counter()
-            try:
-                lc.query("i", f"Count(Row(f={k % 4}))", node=k % 2,
-                         cache=False)
-                stats.timing(f"elastic.q.{phase[0]}",
-                             time.perf_counter() - t0)
-            except Exception as e:  # pragma: no cover
-                failures.append(repr(e))
-
-    t = threading.Thread(target=storm)
-    t.start()
+    sc = get_scenario("elastic")
+    # Never truncate past the chaos timeline — both resizes must fire.
+    sc.duration_s = max(
+        float(os.environ.get("BENCH_SCENARIO_SECONDS", "20")),
+        max(c.at_s for c in sc.chaos) + 4.0)
+    # Own the target so the coordinator's /debug/vars (where the resize
+    # job counts its streamed volume) is still readable after the run.
+    target = ManagedTarget(n_nodes=sc.nodes, replica_n=sc.replica_n,
+                           node_opts=sc.node_opts)
     try:
-        time.sleep(1.0)
-        phase[0] = "fire"
-        t0 = time.perf_counter()
-        grown = lc.add_node()
-        extra["elastic_grow_s"] = round(time.perf_counter() - t0, 2)
-        grown.cluster.stats = stats
-        t0 = time.perf_counter()
-        lc.remove_node("node2")
-        extra["elastic_shrink_s"] = round(time.perf_counter() - t0, 2)
+        rep = run_scenario(sc, target=target)
+        # The resize job counts its volume on whichever node held the
+        # coordinator role — sum across the surviving members.
+        dvars = {}
+        for i in range(len(target.nodes)):
+            for k, v in target.debug_vars(i).get("counters", {}).items():
+                dvars[k] = dvars.get(k, 0) + v
     finally:
-        stop.set()
-        t.join()
-    steady = stats.timing_quantile("elastic.q.steady", 0.99)
-    fire = stats.timing_quantile("elastic.q.fire", 0.99)
-    extra["elastic_query_failures"] = len(failures)
-    extra["elastic_steady_p99_ms"] = round(steady * 1e3, 2)
-    extra["elastic_fire_p99_ms"] = round(fire * 1e3, 2)
-    extra["elastic_fire_vs_steady_p99"] = round(fire / max(steady, 1e-9), 2)
+        target.close()
+    inter = rep["perClass"]["interactive"]
+    failures = sum(v["counts"]["error"] for v in rep["perClass"].values())
+    chaos_ok = [c for c in rep["chaos"] if c["ok"]]
+    extra["elastic_scenario"] = sc.name
+    extra["elastic_ops"] = rep["arrivals"]["dispatched"]
+    extra["elastic_query_failures"] = failures
+    extra["elastic_p50_ms"] = inter["client"]["p50Ms"]
+    extra["elastic_p99_ms"] = inter["client"]["p99Ms"]
+    extra["elastic_chaos_applied"] = len(chaos_ok)
     extra["elastic_bytes_streamed_mb"] = round(
-        stats.counter_value("cluster.resize.bytesStreamed") / 1e6, 2)
+        dvars.get("cluster.resize.bytesStreamed", 0) / 1e6, 2)
     extra["elastic_shards_migrated"] = int(
-        stats.counter_value("cluster.resize.shardsMigrated"))
+        dvars.get("cluster.resize.shardsMigrated", 0))
+    assert failures == 0, f"{failures} queries failed across the resizes"
+    assert len(chaos_ok) == len(rep["chaos"]) == 2, \
+        f"resize chaos actions did not all apply: {rep['chaos']}"
 
 
 # ---------------------------------------------------------------------------
@@ -1570,100 +1554,45 @@ def bench_elastic(extra):
 
 
 def bench_overload(extra):
-    """The acceptance scenario for the overload-resilience layer: a
-    3-node replica_n=2 cluster where node1 serves every query leg
-    slower than the request deadline (a gray failure), driven by 4x
-    more client threads than the admission gate admits. Interactive
-    latency must stay bounded (excess load is SHED, not queued), hedged
-    reads must absorb the slow peer (zero client-visible failures), and
-    its circuit breaker must open."""
-    from pilosa_tpu.cluster.breaker import BreakerRegistry, HedgePolicy
-    from pilosa_tpu.cluster.harness import LocalCluster
-    from pilosa_tpu.config import SHARD_WIDTH
-    from pilosa_tpu.qos import (AdaptiveLimit, AdmissionController, Deadline,
-                                DeadlineExceededError, QueryShedError,
-                                reset_current_deadline, set_current_deadline)
+    """The overload-resilience drill, re-expressed as a thin loadgen
+    scenario config: an oversubscribed open-loop arrival stream into a
+    3-node replica_n=2 cluster whose node1 turns gray mid-run (slower
+    than the deadline) and later heals. Admission must shed the excess
+    (not queue it), the slow peer's breaker must open, hedged reads
+    must absorb it, and no query may surface a hard failure. The
+    measurement machinery (arrivals, mix, SLO report) all lives in
+    pilosa_tpu/loadgen — this function only maps report fields onto
+    the bench's historical keys."""
+    from pilosa_tpu.loadgen import get_scenario, run_scenario
 
-    n_shards = 8
-    lc = LocalCluster(3, replica_n=2)
-    reg = BreakerRegistry(threshold=3, cooldown=1.0)
-    lc.client.breakers = reg
-    for cn in lc.nodes:
-        cn.cluster.hedge = HedgePolicy(delay_s=0.05, burst=32)
-    lc.create_index("ov")
-    lc.create_field("ov", "f")
-    for s in range(n_shards):
-        lc.query("ov", f"Set({s * SHARD_WIDTH + 5}, f=1)")
-    (oracle,) = lc.query("ov", "Count(Row(f=1))", cache=False)
-    lc.query("ov", "Count(Row(f=1))", cache=False)  # warm compiles
-
-    # node1 is slower than the deadline on every query leg — the
-    # breaker (not the failure detector) must take it out of the path.
-    lc.slow("node1", 0.6)
-    adaptive = AdaptiveLimit(ceiling=4)
-    ctl = AdmissionController(max_concurrent=4, max_queue=8,
-                              adaptive=adaptive)
-    sheds = misses = failures = 0
-    lat = []
-    lock = threading.Lock()
-
-    def one_query():
-        nonlocal sheds, misses, failures
-        tok = set_current_deadline(Deadline(timeout=0.5))
-        t0 = time.perf_counter()
-        try:
-            with ctl.admit("interactive"):
-                (got,) = lc.query("ov", "Count(Row(f=1))", cache=False)
-            dt = time.perf_counter() - t0
-            with lock:
-                assert got == oracle, (got, oracle)
-                lat.append(dt)
-        except QueryShedError:
-            with lock:
-                sheds += 1
-        except DeadlineExceededError:
-            with lock:
-                misses += 1
-        except Exception:
-            with lock:
-                failures += 1
-        finally:
-            reset_current_deadline(tok)
-
-    n_ops = 128  # 16 threads = 4x the gate's max_concurrent
-    with ThreadPoolExecutor(max_workers=16) as pool:
-        list(pool.map(lambda _: one_query(), range(n_ops)))
-    # Abandoned slow legs surface their ConnectionError (and feed the
-    # breaker) only after burning their remaining deadline — let the
-    # in-flight ones settle before reading the counters.
-    time.sleep(0.8)
-    lc.fast("node1")
-
-    hs = lc.nodes[0].cluster.hedge.snapshot()
-    opens = sum(p["opens"] for p in reg.snapshot()["peers"].values())
-    extra["overload_ops"] = n_ops
-    extra["overload_admitted"] = len(lat)
-    extra["overload_shed"] = sheds
-    extra["overload_shed_rate"] = round(sheds / n_ops, 3)
-    extra["overload_deadline_misses"] = misses
+    sc = get_scenario("overload")
+    sc.duration_s = float(os.environ.get("BENCH_SCENARIO_SECONDS", "15"))
+    rep = run_scenario(sc)
+    inter = rep["perClass"]["interactive"]
+    failures = sum(v["counts"]["error"] for v in rep["perClass"].values())
+    extra["overload_scenario"] = sc.name
+    extra["overload_ops"] = rep["arrivals"]["dispatched"]
+    extra["overload_admitted"] = inter["counts"]["ok"]
+    extra["overload_shed"] = rep["rates"]["shed"]
+    extra["overload_shed_rate"] = inter["shedRate"]
+    extra["overload_deadline_misses"] = rep["rates"]["deadlineMiss"]
     extra["overload_failures"] = failures
-    if lat:
-        extra["overload_admitted_p50_ms"] = round(
-            statistics.median(lat) * 1e3, 3)
-        extra["overload_admitted_p99_ms"] = round(_p99(lat), 3)
-    extra["overload_hedge_fired"] = hs["fired"]
-    extra["overload_hedge_won"] = hs["won"]
-    if hs["fired"]:
-        extra["overload_hedge_win_rate"] = round(hs["won"] / hs["fired"], 3)
-    extra["overload_breaker_opens"] = opens
-    extra["overload_adaptive_limit_final"] = adaptive.limit
-    for cn in lc.nodes:
-        cn.cluster.close()
+    extra["overload_admitted_p50_ms"] = inter["client"]["p50Ms"]
+    extra["overload_admitted_p99_ms"] = inter["client"]["p99Ms"]
+    extra["overload_hedge_fired"] = rep["rates"]["hedgeFired"]
+    extra["overload_hedge_won"] = rep["rates"]["hedgeWon"]
+    if rep["rates"]["hedgeFired"]:
+        extra["overload_hedge_win_rate"] = round(
+            rep["rates"]["hedgeWon"] / rep["rates"]["hedgeFired"], 3)
+    extra["overload_breaker_opens"] = rep["rates"]["breakerOpens"]
+    extra["overload_cache_hit_ratio"] = rep["cache"]["hitRatio"]
     # The layer's contract, enforced: the slow peer never surfaces as a
     # client-visible failure, and its breaker actually opened.
     assert failures == 0, f"{failures} queries failed via the slow peer"
-    assert opens >= 1, "slow peer's breaker never opened"
-    assert hs["fired"] >= 1, "hedge never fired against the slow peer"
+    assert rep["rates"]["breakerOpens"] >= 1, \
+        "slow peer's breaker never opened"
+    assert rep["rates"]["hedgeFired"] >= 1, \
+        "hedge never fired against the slow peer"
 
 
 # ---------------------------------------------------------------------------
@@ -1705,10 +1634,9 @@ def bench_obs(extra):
 
     storm_threads = min(THREADS, 8)
     storm_q = max(min(N_QUERIES, 192), 96)
-    lock = threading.Lock()
 
     def storm(profiled):
-        lats: list[float] = []
+        lats = _hist()
 
         def one(i):
             tok = None
@@ -1724,12 +1652,11 @@ def bench_obs(extra):
                     prof = obs_profile.current()
                     obs_profile.deactivate(tok)
                     prof.finish()
-            with lock:
-                lats.append(dt)
+            lats.observe(dt)
 
         with ThreadPoolExecutor(max_workers=storm_threads) as pool:
             list(pool.map(one, range(storm_q)))
-        return statistics.median(lats) * 1e3
+        return _p50(lats)
 
     storm(False)
     storm(True)  # warm both code paths before measuring

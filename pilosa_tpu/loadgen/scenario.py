@@ -1,0 +1,125 @@
+"""Scenario model: everything a run needs, as plain data.
+
+A Scenario is JSON-serializable both ways (``to_dict``/``from_dict``)
+so scenario configs can live in files, CI args, and SLO reports. The
+engine never reads anything the Scenario doesn't carry — same dict +
+same seed → same op sequence.
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass, field
+
+#: query-leg kinds the engine knows how to drive
+LEG_KINDS = ("dashboard", "adhoc", "bsi", "topn", "keyed")
+
+
+@dataclass
+class QueryLeg:
+    """One slice of the query mix.
+
+    - ``dashboard``: a small fixed panel of Count queries per tenant —
+      repeat-heavy, the result cache's best case.
+    - ``adhoc``: randomized Intersect/Difference over a wide row
+      population — cache-miss exploratory traffic.
+    - ``bsi``: Range→Sum aggregates over an int field.
+    - ``topn``: TopN ranking, optionally filtered.
+    - ``keyed``: string-keyed Count/Row queries (exercises key
+      translation on the hot path).
+    """
+
+    name: str
+    weight: float = 1.0
+    kind: str = "dashboard"
+    qos_class: str = "interactive"
+    zipf_s: float = 1.1      # skew of the within-leg query population
+    population: int = 32     # distinct queries the leg draws from
+    no_cache: bool = False
+
+    def __post_init__(self):
+        if self.kind not in LEG_KINDS:
+            raise ValueError(f"unknown leg kind {self.kind!r} "
+                             f"(want one of {LEG_KINDS})")
+
+
+@dataclass
+class IngestLeg:
+    """Background PTS1 ingest at a duty cycle: stream a batch, then
+    sleep so streaming time ≈ ``duty`` of wall time. duty=1.0 hammers
+    continuously (the bench_ingest silo); 0.2 is a trickle."""
+
+    duty: float = 0.5
+    shards: int = 4
+    per_shard: int = 20_000
+    value_min: int = -100_000
+    value_max: int = 100_000
+
+
+@dataclass
+class ChaosAction:
+    """One timeline entry. Actions: ``slow_peer`` (value = delay ms,
+    via POST /internal/fault), ``heal_peer``, ``add_node`` (live
+    resize grow), ``remove_node`` (live resize shrink)."""
+
+    at_s: float
+    action: str
+    node: int = 1           # index into the target's node list
+    value: float = 0.0
+
+    def __post_init__(self):
+        if self.action not in ("slow_peer", "heal_peer",
+                               "add_node", "remove_node"):
+            raise ValueError(f"unknown chaos action {self.action!r}")
+
+
+@dataclass
+class Scenario:
+    """A full run description. ``rate`` is offered load (open-loop);
+    the report records both target and achieved rates so a saturated
+    driver is visible too."""
+
+    name: str
+    seed: int = 42
+    duration_s: float = 10.0
+    rate: float = 50.0
+    process: str = "poisson"
+    cv: float = 1.0
+
+    # target shape (managed mode; ignored when attaching to live urls)
+    nodes: int = 1
+    replica_n: int = 1
+    node_opts: dict = field(default_factory=dict)  # ServerNode kwargs
+
+    # dataset
+    shards: int = 4
+    rows: int = 64
+    density: float = 0.01    # fraction of each shard's columns set
+
+    # mix
+    tenants: int = 8
+    tenant_s: float = 1.1
+    legs: list[QueryLeg] = field(default_factory=list)
+    ingest: IngestLeg | None = None
+    chaos: list[ChaosAction] = field(default_factory=list)
+
+    # driver
+    max_workers: int = 64
+    warmup_queries: int = 8
+
+    def __post_init__(self):
+        if not self.legs:
+            raise ValueError(f"scenario {self.name!r} has no query legs")
+
+    def to_dict(self) -> dict:
+        d = asdict(self)
+        d["ingest"] = asdict(self.ingest) if self.ingest else None
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Scenario":
+        d = dict(d)
+        d["legs"] = [QueryLeg(**leg) for leg in d.get("legs", [])]
+        ing = d.get("ingest")
+        d["ingest"] = IngestLeg(**ing) if ing else None
+        d["chaos"] = [ChaosAction(**c) for c in d.get("chaos", [])]
+        return cls(**d)

@@ -1,0 +1,181 @@
+"""Built-in scenarios.
+
+Several of these re-express bench.py silos as legs of the one engine:
+``dashboard_storm`` is the dispatch-storm + cache-churn pair,
+``overload`` is the slow-peer breaker/hedge drill, ``ingest_under_query``
+is the interactive-p99-under-PTS1-stream drill, and ``elastic`` is the
+query-through-resize drill — each formerly its own hand-rolled
+bench loop, now a scenario config on shared machinery.
+
+``smoke``/``smoke3`` are the CI pair: short, seeded, deterministic
+op sequences (see ``engine.build_ops``) sized to finish in ~30 s
+total on a CPU-only runner.
+"""
+
+from __future__ import annotations
+
+from pilosa_tpu.loadgen.scenario import (ChaosAction, IngestLeg, QueryLeg,
+                                         Scenario)
+
+
+def _mixed_legs(keyed: bool = True) -> list[QueryLeg]:
+    legs = [
+        QueryLeg(name="dashboard", weight=5.0, kind="dashboard",
+                 qos_class="interactive", population=16, zipf_s=1.2),
+        QueryLeg(name="adhoc", weight=2.0, kind="adhoc",
+                 qos_class="batch", population=64, zipf_s=0.8,
+                 no_cache=True),
+        QueryLeg(name="bsi_agg", weight=2.0, kind="bsi",
+                 qos_class="batch", population=16, zipf_s=1.0),
+        QueryLeg(name="topn", weight=1.0, kind="topn",
+                 qos_class="interactive", population=8, zipf_s=1.0),
+    ]
+    if keyed:
+        legs.append(QueryLeg(name="keyed", weight=1.0, kind="keyed",
+                             qos_class="interactive", population=32,
+                             zipf_s=1.1))
+    return legs
+
+
+def smoke() -> Scenario:
+    """CI single-node leg: every query kind plus a trickle ingest."""
+    return Scenario(
+        name="smoke", seed=42, duration_s=8.0, rate=40.0,
+        nodes=1, shards=4, rows=48, density=0.005,
+        tenants=8, tenant_s=1.2,
+        legs=_mixed_legs(keyed=True),
+        ingest=IngestLeg(duty=0.3, shards=2, per_shard=10_000),
+        node_opts={"qos_max_concurrent": 8},
+    )
+
+
+def smoke3() -> Scenario:
+    """CI 3-node leg: mixed traffic over fan-out, one mid-run gray
+    failure (slow peer) that heals — breakers and hedging must show
+    up in the rates, and the p99 exemplar must resolve cross-node."""
+    return Scenario(
+        name="smoke3", seed=42, duration_s=8.0, rate=25.0,
+        nodes=3, replica_n=2, shards=6, rows=48, density=0.004,
+        tenants=8, tenant_s=1.2,
+        legs=_mixed_legs(keyed=False),
+        chaos=[ChaosAction(at_s=3.0, action="slow_peer", node=1, value=150.0),
+               ChaosAction(at_s=5.5, action="heal_peer", node=1)],
+        node_opts={"qos_max_concurrent": 8,
+                   "breaker_threshold": 3, "breaker_cooldown": 1.0,
+                   "hedge": True, "hedge_delay_ms": 60.0,
+                   "hedge_budget_pct": 20.0},
+    )
+
+
+def mixed() -> Scenario:
+    """The flagship: a minute of full mixed traffic on 3 nodes."""
+    return Scenario(
+        name="mixed", seed=7, duration_s=60.0, rate=120.0,
+        nodes=3, replica_n=2, shards=8, rows=64, density=0.01,
+        tenants=32, tenant_s=1.2,
+        legs=_mixed_legs(keyed=True),
+        ingest=IngestLeg(duty=0.5, shards=4, per_shard=50_000),
+        node_opts={"qos_max_concurrent": 16, "qos_tenant_rate": 64.0,
+                   "qos_tenant_burst": 128.0,
+                   "breaker_threshold": 5, "hedge": True,
+                   "hedge_delay_ms": 50.0},
+        max_workers=128,
+    )
+
+
+def dashboard_storm() -> Scenario:
+    """bench_dispatch + bench_cache re-expressed: a hot repeated
+    dashboard panel (dispatch coalescing, result-cache hits) with a
+    churn trickle invalidating shards underneath it."""
+    return Scenario(
+        name="dashboard_storm", seed=11, duration_s=20.0, rate=300.0,
+        process="gamma", cv=2.0,   # bursty, the coalescer's diet
+        nodes=1, shards=4, rows=32, density=0.01,
+        tenants=4, tenant_s=1.5,
+        legs=[QueryLeg(name="dashboard", weight=8.0, kind="dashboard",
+                       qos_class="interactive", population=5, zipf_s=1.0),
+              QueryLeg(name="topn", weight=1.0, kind="topn",
+                       qos_class="interactive", population=4)],
+        ingest=IngestLeg(duty=0.2, shards=1, per_shard=5_000),
+        max_workers=128,
+    )
+
+
+def overload() -> Scenario:
+    """bench_overload re-expressed: oversubscribed arrival rate into a
+    3-node cluster with one gray-failing peer; admission, breakers,
+    and hedging carry the run (shed is expected, errors are not)."""
+    return Scenario(
+        name="overload", seed=13, duration_s=20.0, rate=150.0,
+        nodes=3, replica_n=2, shards=6, rows=48, density=0.008,
+        tenants=16, tenant_s=1.1,
+        legs=[QueryLeg(name="dashboard", weight=3.0, kind="dashboard",
+                       qos_class="interactive", population=16),
+              QueryLeg(name="adhoc", weight=2.0, kind="adhoc",
+                       qos_class="batch", population=64, no_cache=True)],
+        # slow > deadline: legs via node1 breach, feed its breaker, and
+        # hedged replicas must win — mirrors the old bench's 0.6s slow
+        # peer against a 0.5s deadline.
+        chaos=[ChaosAction(at_s=5.0, action="slow_peer", node=1, value=600.0),
+               ChaosAction(at_s=14.0, action="heal_peer", node=1)],
+        node_opts={"qos_max_concurrent": 4, "qos_max_queue": 8,
+                   "qos_default_deadline": 0.5,
+                   "breaker_threshold": 3, "breaker_cooldown": 1.0,
+                   "hedge": True, "hedge_delay_ms": 50.0,
+                   "hedge_budget_pct": 20.0},
+        max_workers=96,
+    )
+
+
+def ingest_under_query() -> Scenario:
+    """bench_ingest's under-load half re-expressed: a near-saturating
+    PTS1 stream (duty 0.9) with an interactive dashboard leg whose p99
+    is the number that matters."""
+    return Scenario(
+        name="ingest_under_query", seed=23, duration_s=20.0, rate=50.0,
+        nodes=1, shards=8, rows=32, density=0.005,
+        tenants=8, tenant_s=1.1,
+        legs=[QueryLeg(name="dashboard", weight=4.0, kind="dashboard",
+                       qos_class="interactive", population=8),
+              QueryLeg(name="bsi_agg", weight=1.0, kind="bsi",
+                       qos_class="batch", population=8)],
+        ingest=IngestLeg(duty=0.9, shards=8, per_shard=100_000),
+        node_opts={"qos_max_concurrent": 8, "ingest_max_inflight_mb": 64},
+    )
+
+
+def elastic() -> Scenario:
+    """bench_elastic re-expressed: steady mixed traffic while a node
+    joins mid-run and another is removed later — queries must serve
+    through both cutovers."""
+    return Scenario(
+        name="elastic", seed=31, duration_s=24.0, rate=40.0,
+        nodes=2, replica_n=2, shards=6, rows=48, density=0.005,
+        tenants=8, tenant_s=1.1,
+        legs=[QueryLeg(name="dashboard", weight=3.0, kind="dashboard",
+                       qos_class="interactive", population=16),
+              QueryLeg(name="bsi_agg", weight=1.0, kind="bsi",
+                       qos_class="batch", population=8)],
+        chaos=[ChaosAction(at_s=6.0, action="add_node"),
+               ChaosAction(at_s=16.0, action="remove_node", node=1)],
+        node_opts={"qos_max_concurrent": 8},
+    )
+
+
+SCENARIOS = {
+    "smoke": smoke,
+    "smoke3": smoke3,
+    "mixed": mixed,
+    "dashboard_storm": dashboard_storm,
+    "overload": overload,
+    "ingest_under_query": ingest_under_query,
+    "elastic": elastic,
+}
+
+
+def get_scenario(name: str) -> "Scenario":
+    try:
+        return SCENARIOS[name]()
+    except KeyError:
+        raise KeyError(f"unknown scenario {name!r} "
+                       f"(have: {', '.join(sorted(SCENARIOS))})") from None

@@ -1,0 +1,261 @@
+"""Scenario targets: the live cluster a run drives, over real HTTP.
+
+Two flavors behind one surface:
+
+- ``ManagedTarget`` boots N in-process ServerNodes on loopback ports
+  (full stack: QoS gate, quotas, breakers, hedge, result cache,
+  profile ring, /metrics) and owns their lifecycle — the CI/bench
+  mode, and the only mode that can run the resize chaos actions.
+- ``AttachedTarget`` points at already-running nodes by URL — the
+  "drive a real deployment" mode. Chaos actions degrade gracefully:
+  slow_peer needs the node started with chaos faults enabled;
+  add/remove_node are refused.
+
+Either way the engine talks production HTTP — the same admission,
+cache, and profile paths a real client hits, not a bench backdoor.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import urllib.error
+import urllib.request
+
+
+def _free_ports(n: int) -> list[int]:
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+class QueryOutcome:
+    """One request's classification, from the HTTP status line."""
+
+    __slots__ = ("status", "code")
+
+    def __init__(self, status: str, code: int):
+        self.status = status   # ok | shed | quota | deadline | error
+        self.code = code
+
+
+_STATUS_BY_CODE = {503: "shed", 429: "quota", 504: "deadline"}
+
+
+class _HTTPTargetBase:
+    """Shared HTTP plumbing over a list of node base URLs."""
+
+    def __init__(self, base_urls: list[str], timeout: float = 30.0):
+        self.base_urls = list(base_urls)
+        self.timeout = timeout
+
+    # -- raw I/O ------------------------------------------------------
+
+    def _post(self, url: str, body: str = "",
+              headers: dict | None = None) -> bytes:
+        req = urllib.request.Request(url, data=body.encode(),
+                                     headers=headers or {}, method="POST")
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            return resp.read()
+
+    def _get(self, url: str) -> bytes:
+        with urllib.request.urlopen(url, timeout=self.timeout) as resp:
+            return resp.read()
+
+    # -- setup surface ------------------------------------------------
+
+    def create_index(self, index: str, opts: dict | None = None) -> None:
+        self._post(f"{self.base_urls[0]}/index/{index}",
+                   json.dumps({"options": opts or {}}))
+
+    def create_field(self, index: str, fld: str,
+                     opts: dict | None = None) -> None:
+        self._post(f"{self.base_urls[0]}/index/{index}/field/{fld}",
+                   json.dumps({"options": opts or {}}))
+
+    def import_bits(self, index: str, fld: str, rows, cols) -> None:
+        self._post(f"{self.base_urls[0]}/index/{index}/field/{fld}/import",
+                   json.dumps({"rowIDs": [int(r) for r in rows],
+                               "columnIDs": [int(c) for c in cols]}))
+
+    # -- query path ---------------------------------------------------
+
+    def query(self, index: str, pql: str, *, qos_class: str = "",
+              tenant: str = "", trace_id: str = "",
+              no_cache: bool = False, node: int = 0) -> QueryOutcome:
+        """One client query. Never retries — an open-loop driver
+        records the rejection instead of hiding it behind a retry."""
+        url = f"{self.base_urls[node % len(self.base_urls)]}" \
+              f"/index/{index}/query"
+        params = []
+        if qos_class:
+            params.append(f"qosClass={qos_class}")
+        if no_cache:
+            params.append("noCache=true")
+        if params:
+            url += "?" + "&".join(params)
+        headers = {}
+        if trace_id:
+            headers["X-Pilosa-Trace-Id"] = trace_id
+        if tenant:
+            headers["X-API-Key"] = tenant
+        try:
+            self._post(url, pql, headers)
+            return QueryOutcome("ok", 200)
+        except urllib.error.HTTPError as e:
+            e.read()
+            return QueryOutcome(_STATUS_BY_CODE.get(e.code, "error"), e.code)
+        except (urllib.error.URLError, ConnectionError, OSError, TimeoutError):
+            return QueryOutcome("error", 0)
+
+    # -- observability surface ---------------------------------------
+
+    def metrics_text(self, node: int = 0) -> str:
+        return self._get(f"{self.base_urls[node]}/metrics").decode()
+
+    def debug_vars(self, node: int = 0) -> dict:
+        return json.loads(self._get(f"{self.base_urls[node]}/debug/vars"))
+
+    def resolve_profile(self, trace_id: str, node: int = 0) -> dict | None:
+        """Full nested cost profile for a trace id, or None. Any node
+        answers — a local ring miss fans out to the coordinator that
+        retained the whole timeline."""
+        try:
+            return json.loads(self._get(
+                f"{self.base_urls[node]}/debug/queries/{trace_id}"))
+        except (urllib.error.URLError, OSError):
+            return None
+
+    # -- chaos surface ------------------------------------------------
+
+    def slow_peer(self, node: int, delay_ms: float) -> bool:
+        try:
+            self._post(f"{self.base_urls[node]}/internal/fault",
+                       json.dumps({"slowMs": delay_ms}))
+            return True
+        except (urllib.error.URLError, OSError):
+            return False   # node without chaos faults mounted
+
+    def heal_peer(self, node: int) -> bool:
+        return self.slow_peer(node, 0.0)
+
+    def add_node(self) -> bool:
+        return False
+
+    def remove_node(self, node: int) -> bool:
+        return False
+
+    def close(self) -> None:
+        pass
+
+
+class AttachedTarget(_HTTPTargetBase):
+    """Drive an already-running node/cluster by URL."""
+
+    def __init__(self, urls: list[str], timeout: float = 30.0):
+        super().__init__([u.rstrip("/") for u in urls], timeout)
+        self.mode = "attached"
+
+    def import_stream(self, reqs: list[dict]) -> int:
+        # Without a managed internal client, fall back to per-batch
+        # JSON imports — slower, same bits.
+        for r in reqs:
+            self._post(
+                f"{self.base_urls[0]}/index/{r['index']}"
+                f"/field/{r['field']}/import",
+                json.dumps({"columnIDs": [int(c) for c in r["columnIDs"]],
+                            "values": [int(v) for v in r["values"]]}))
+        return len(reqs)
+
+
+class ManagedTarget(_HTTPTargetBase):
+    """Boot and own N in-process ServerNodes for one run."""
+
+    def __init__(self, n_nodes: int = 1, replica_n: int = 1,
+                 node_opts: dict | None = None, timeout: float = 30.0):
+        from pilosa_tpu.server.node import ServerNode
+        from pilosa_tpu.server.httpclient import HTTPInternalClient
+        self.mode = "managed"
+        # slow-log threshold stays high: the harness reads quantiles
+        # and the profile ring, not a WARNING line per query.
+        opts = {"use_planner": False, "anti_entropy_interval": 0.0,
+                "check_nodes_interval": 0.0, "qos_slow_query_ms": 1000.0,
+                "chaos_faults": True}
+        opts.update(node_opts or {})
+        addrs = [f"127.0.0.1:{p}" for p in _free_ports(n_nodes)]
+        self.nodes = [ServerNode(bind=a, peers=addrs if n_nodes > 1 else None,
+                                 replica_n=replica_n, **opts)
+                      for a in addrs]
+        self._node_opts = opts
+        self._replica_n = replica_n
+        self._lock = threading.Lock()
+        for n in self.nodes:
+            n.open()
+        super().__init__([n.address for n in self.nodes], timeout)
+        self._client = HTTPInternalClient(timeout=timeout)
+
+    def _peer(self, node: int = 0):
+        from pilosa_tpu.cluster.node import URI, Node
+        n = self.nodes[node]
+        return Node(id=n.id, uri=URI(host=n.host, port=n.port))
+
+    def import_stream(self, reqs: list[dict]) -> int:
+        return self._client.send_import_stream(self._peer(0), reqs)
+
+    def add_node(self) -> bool:
+        from pilosa_tpu.server.node import ServerNode
+        with self._lock:
+            addr = f"127.0.0.1:{_free_ports(1)[0]}"
+            joiner = ServerNode(bind=addr, join=self.nodes[0].id,
+                                replica_n=self._replica_n,
+                                **self._node_opts)
+            joiner.open()
+            self.nodes.append(joiner)
+            self.base_urls.append(joiner.address)
+            return True
+
+    def remove_node(self, node: int) -> bool:
+        with self._lock:
+            if node <= 0 or node >= len(self.nodes):
+                return False   # never shoot node 0 (our setup anchor)
+            # Removal is a coordinator-only request, and the coordinator
+            # is elected by node-id order — not necessarily nodes[0]. If
+            # the named victim IS the coordinator, shoot another member
+            # instead: the scenario asks for "a member leaves", not for
+            # a coordinator handoff.
+            coord = next((n for n in self.nodes
+                          if n.cluster.coordinator() is not None
+                          and n.cluster.coordinator().id == n.id),
+                         self.nodes[0])
+            victim = self.nodes[node]
+            if victim is coord:
+                others = [i for i in range(1, len(self.nodes))
+                          if self.nodes[i] is not coord]
+                if not others:
+                    return False
+                node = others[-1]
+                victim = self.nodes[node]
+            try:
+                self._post(f"{coord.address}/cluster/resize/remove-node",
+                           json.dumps({"id": victim.id}))
+            except (urllib.error.URLError, OSError):
+                return False
+            self.nodes.pop(node)
+            self.base_urls.pop(node)
+            victim.close()
+            return True
+
+    def close(self) -> None:
+        self._client.close()
+        for n in self.nodes:
+            try:
+                n.close()
+            except Exception:
+                pass

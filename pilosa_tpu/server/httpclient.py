@@ -1025,6 +1025,16 @@ class HTTPInternalClient:
         — the additive NodeStatus half, server.go:640)."""
         return self._request(node, "GET", "/internal/availability")
 
+    def debug_query_profile(self, node, trace: str) -> dict | None:
+        """One peer's retained profile for ``trace``, or None when that
+        peer's ring doesn't have it. ``local=true`` stops the peer from
+        fanning out in turn (resolution is one hop, never a cycle)."""
+        try:
+            return self._request(
+                node, "GET", f"/debug/queries/{trace}?local=true")
+        except LookupError:
+            return None
+
     def post_schema(self, node, schema: list[dict]) -> None:
         """Push a schema to one peer (reference PostSchema fan-out from
         API.ApplySchema, api.go:747; remote=true stops re-fan-out)."""

@@ -610,6 +610,17 @@ def _build_routes(api: API):
                     prof_doc = prof.finish()
                     if ring is not None:
                         ring.record(prof_doc)
+            _stats = getattr(api.executor, "stats", None)
+            if (_stats is not None and not remote
+                    and status not in ("shed", "quota")):
+                # Per-QoS-class service latency (admission wait +
+                # execution), exemplar'd with the active trace id —
+                # the histogram SLO reports read per-class p50/p99/p999
+                # from. Shed/quota rejections never executed, so they
+                # don't belong in a service-time distribution; remote
+                # legs are the coordinator's cost, counted there.
+                _stats.with_tags(f"class:{cls}").timing(
+                    "qos.serviceSeconds", time.perf_counter() - t0)
             slow_log = getattr(qos_ctl, "slow_log", None)
             if slow_log is not None and status not in ("shed", "quota"):
                 slow_log.observe(pv["index"], body.decode(errors="replace"),
@@ -783,12 +794,47 @@ def _build_routes(api: API):
 
     def get_debug_query_profile(pv, params, body):
         """One retained profile by trace id — the target of /metrics
-        exemplars and slow-query-log ``profile`` pointers."""
+        exemplars and slow-query-log ``profile`` pointers.
+
+        Remote fan-out legs never record into the serving node's ring
+        (the coordinator retains the whole nested ledger), so a trace
+        id scraped off a *remote* node's exemplars would 404 there. On
+        a local miss, ask the peers — whichever node coordinated the
+        query answers with the full nested profile. ``local=true``
+        bounds the search to one hop.
+        """
         ring = getattr(api, "profile_ring", None)
         doc = ring.get(pv["trace"]) if ring is not None else None
+        if doc is None and params.get("local") != "true":
+            doc = _peer_query_profile(pv["trace"])
         if doc is None:
             return 404, {"error": f"no retained profile for {pv['trace']}"}
         return 200, doc
+
+    def _peer_query_profile(trace):
+        cluster = getattr(api, "cluster", None)
+        if cluster is None:
+            return None
+        fetch = getattr(getattr(cluster, "client", None),
+                        "debug_query_profile", None)
+        if fetch is None:
+            return None
+        me = cluster.local_node
+        best = None
+        for node in list(cluster.nodes):
+            if (me is not None and node.id == me.id) or node.state == "DOWN":
+                continue
+            try:
+                doc = fetch(node, trace)
+            except Exception:
+                continue
+            if not doc:
+                continue
+            # Prefer the coordinator's copy: it nests every remote leg.
+            if best is None or (doc.get("remoteLegs")
+                                and not best.get("remoteLegs")):
+                best = doc
+        return best
 
     def get_debug_device(pv, params, body):
         """Device telemetry in one view: plane-stack residency bytes and

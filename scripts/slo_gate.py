@@ -1,0 +1,116 @@
+#!/usr/bin/env python3
+"""slo_gate.py — hold an SLO report to a checked-in baseline.
+
+    python scripts/slo_gate.py REPORT.json BASELINE.json
+
+The baseline is a list of per-metric checks with tolerance bands —
+the harness-era successor to ad-hoc bench assertions. Exit 1 on any
+violation (or a schema-invalid report), listing every failure:
+
+    {
+      "scenario": "smoke",
+      "checks": [
+        {"path": "perClass.interactive.client.p99Ms", "max": 250},
+        {"path": "cache.hitRatio", "min": 0.15},
+        {"path": "arrivals.rateAchieved", "value": 40, "relTol": 0.25},
+        {"path": "exemplars", "minLen": 1}
+      ]
+    }
+
+Check fields (any combination):
+  min / max      absolute bounds on a number
+  value + relTol expected value with a relative band: |got - value|
+                 must be <= relTol * |value| (absTol adds a floor for
+                 near-zero expectations)
+  minLen         lower bound on a list's length
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+# run as `python scripts/slo_gate.py`, sys.path[0] is scripts/ — add the
+# repo root so the schema validator imports without an install step
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def lookup(doc, path: str):
+    cur = doc
+    for seg in path.split("."):
+        if not isinstance(cur, dict) or seg not in cur:
+            return None
+        cur = cur[seg]
+    return cur
+
+
+def run_check(report: dict, check: dict) -> str | None:
+    """None when the check passes, else a one-line violation."""
+    path = check["path"]
+    got = lookup(report, path)
+    if got is None:
+        return f"{path}: missing from report"
+    if "minLen" in check:
+        if not isinstance(got, list) or len(got) < check["minLen"]:
+            n = len(got) if isinstance(got, list) else "not-a-list"
+            return f"{path}: want >= {check['minLen']} entries, got {n}"
+        return None
+    if not isinstance(got, (int, float)) or isinstance(got, bool):
+        return f"{path}: want a number, got {type(got).__name__}"
+    if "min" in check and got < check["min"]:
+        return f"{path}: {got} < min {check['min']}"
+    if "max" in check and got > check["max"]:
+        return f"{path}: {got} > max {check['max']}"
+    if "value" in check:
+        want = check["value"]
+        band = (check.get("relTol", 0.0) * abs(want)
+                + check.get("absTol", 0.0))
+        if abs(got - want) > band:
+            return (f"{path}: {got} outside {want} ± {band:g} "
+                    f"(relTol={check.get('relTol', 0)}, "
+                    f"absTol={check.get('absTol', 0)})")
+    return None
+
+
+def main(argv: list[str]) -> int:
+    if len(argv) != 3:
+        print(__doc__.strip().splitlines()[2].strip(), file=sys.stderr)
+        return 2
+    with open(argv[1]) as f:
+        report = json.load(f)
+    with open(argv[2]) as f:
+        baseline = json.load(f)
+
+    failures = []
+    try:
+        from pilosa_tpu.loadgen.report import validate_report
+        failures += [f"schema: {e}" for e in validate_report(report)]
+    except ImportError:
+        print("slo_gate: pilosa_tpu not importable, skipping schema check",
+              file=sys.stderr)
+
+    want_name = baseline.get("scenario")
+    got_name = lookup(report, "scenario.name")
+    if want_name and got_name != want_name:
+        failures.append(f"scenario: baseline is for {want_name!r}, "
+                        f"report is {got_name!r}")
+
+    for check in baseline.get("checks", []):
+        v = run_check(report, check)
+        if v is not None:
+            failures.append(v)
+
+    if failures:
+        print(f"SLO GATE FAIL ({len(failures)} violation(s)) "
+              f"for scenario {got_name!r}:")
+        for f_ in failures:
+            print(f"  - {f_}")
+        return 1
+    print(f"SLO gate OK: {len(baseline.get('checks', []))} checks passed "
+          f"for scenario {got_name!r}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

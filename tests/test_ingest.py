@@ -21,6 +21,7 @@ import numpy as np
 import pytest
 
 from pilosa_tpu.config import SHARD_WIDTH
+from pilosa_tpu.obs.histogram import LogHistogram
 from pilosa_tpu.cluster.harness import LocalCluster
 from pilosa_tpu.cluster.node import URI, Node
 from pilosa_tpu.core.fragment import Fragment
@@ -613,15 +614,17 @@ def test_ingest_under_query_drill():
         assert req(b, "POST", "/index/drill/field/f/import", body)[0] == 200
 
         def run_queries(k):
-            lat, fails = [], 0
+            lat = LogHistogram(bounds=[1e-5 * (2 ** (i / 4))
+                                       for i in range(84)])
+            fails = 0
             for i in range(k):
                 t0 = time.perf_counter()
                 status, resp, _ = req(b, "POST", "/index/drill/query",
                                       f"Count(Row(f={i % 8}))")
-                lat.append(time.perf_counter() - t0)
+                lat.observe(time.perf_counter() - t0)
                 if status != 200 or "results" not in resp:
                     fails += 1
-            return np.percentile(lat, 99), fails
+            return lat.quantile(0.99), fails
 
         # warm the query path, then baseline
         run_queries(10)

@@ -1,0 +1,304 @@
+"""Traffic-harness tests: seeded determinism, the open-loop property,
+zipf mix skew, SLO report schema round-trip, and cross-node exemplar
+resolution (a p99 trace id resolves to a full profile from ANY node,
+not just the coordinator that retained it)."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.loadgen import (OpenLoopArrivals, Scenario, QueryLeg,
+                                IngestLeg, ZipfPicker, zipf_weights,
+                                run_scenario, validate_report)
+from pilosa_tpu.loadgen.engine import build_ops
+from pilosa_tpu.loadgen.target import ManagedTarget, QueryOutcome
+from pilosa_tpu.obs import tracing
+
+
+# -- arrival process ---------------------------------------------------------
+
+
+def test_arrival_schedule_deterministic():
+    a = OpenLoopArrivals(rate=200.0, duration_s=5.0, seed=9)
+    s1, s2 = a.schedule(), a.schedule()
+    np.testing.assert_array_equal(s1, s2)
+    s3 = OpenLoopArrivals(rate=200.0, duration_s=5.0, seed=10).schedule()
+    assert not np.array_equal(s1, s3)
+
+
+def test_arrival_schedule_sorted_bounded_and_on_rate():
+    a = OpenLoopArrivals(rate=500.0, duration_s=4.0, seed=3)
+    s = a.schedule()
+    assert np.all(np.diff(s) >= 0)
+    assert s[-1] < 4.0 and s[0] >= 0.0
+    # ~2000 expected arrivals; Poisson noise is ~sqrt(2000) ≈ 45
+    assert abs(len(s) - 2000) < 200
+
+
+def test_arrival_gamma_cv_controls_burstiness():
+    def cv_of(process, cv=1.0):
+        s = OpenLoopArrivals(rate=400.0, duration_s=10.0, process=process,
+                             cv=cv, seed=5).schedule()
+        gaps = np.diff(s)
+        return float(np.std(gaps) / np.mean(gaps))
+
+    assert abs(cv_of("poisson") - 1.0) < 0.1
+    assert abs(cv_of("gamma", cv=2.0) - 2.0) < 0.3
+    assert cv_of("uniform") < 1e-9
+
+
+def test_arrival_validation():
+    with pytest.raises(ValueError):
+        OpenLoopArrivals(rate=0.0, duration_s=1.0)
+    with pytest.raises(ValueError):
+        OpenLoopArrivals(rate=1.0, duration_s=-1.0)
+    with pytest.raises(ValueError):
+        OpenLoopArrivals(rate=1.0, duration_s=1.0, process="closed")
+    with pytest.raises(ValueError):
+        OpenLoopArrivals(rate=1.0, duration_s=1.0, process="gamma", cv=0.0)
+
+
+# -- zipf mix ----------------------------------------------------------------
+
+
+def test_zipf_weights_shape():
+    w = zipf_weights(16, 1.2)
+    assert len(w) == 16
+    assert abs(sum(w) - 1.0) < 1e-9
+    assert all(a >= b for a, b in zip(w, w[1:]))
+    # ratio between rank 1 and rank 4 is 4^s
+    assert abs(w[0] / w[3] - 4.0 ** 1.2) < 1e-9
+
+
+def test_zipf_picker_skew_matches_s():
+    s_cfg = 1.3
+    n = 32
+    picker = ZipfPicker(n, s_cfg)
+    rng = np.random.default_rng(17)
+    draws = np.array([picker.pick(rng) for _ in range(20_000)])
+    freq = np.bincount(draws, minlength=n) / len(draws)
+    want = np.array(zipf_weights(n, s_cfg))
+    # top ranks carry the mass; they must match the analytic weights
+    assert np.allclose(freq[:8], want[:8], rtol=0.15)
+    # recover s from the top-of-the-curve log-log slope
+    ranks = np.arange(1, 9)
+    slope = np.polyfit(np.log(ranks), np.log(freq[:8]), 1)[0]
+    assert abs(-slope - s_cfg) < 0.2
+
+
+# -- deterministic op sequence ----------------------------------------------
+
+
+def _tiny_scenario(**over):
+    kw = dict(
+        name="tiny", seed=5, duration_s=1.5, rate=40.0,
+        nodes=1, shards=2, rows=8, density=0.002,
+        tenants=4, tenant_s=1.1,
+        legs=[QueryLeg(name="dash", weight=3.0, kind="dashboard",
+                       qos_class="interactive", population=8),
+              QueryLeg(name="adhoc", weight=1.0, kind="adhoc",
+                       qos_class="batch", population=16, no_cache=True)],
+        max_workers=64, warmup_queries=0)
+    kw.update(over)
+    return Scenario(**kw)
+
+
+def test_build_ops_seed_deterministic():
+    sc = _tiny_scenario()
+    ops1, ops2 = build_ops(sc), build_ops(sc)
+    assert ops1 == ops2
+    assert len(ops1) > 20
+    assert all(a.offset <= b.offset for a, b in zip(ops1, ops1[1:]))
+    assert {op.leg for op in ops1} == {"dash", "adhoc"}
+    ops3 = build_ops(_tiny_scenario(seed=6))
+    assert [o.pql for o in ops3] != [o.pql for o in ops1]
+
+
+def test_scenario_dict_roundtrip():
+    sc = _tiny_scenario(ingest=IngestLeg(duty=0.4, shards=1, per_shard=100))
+    sc2 = Scenario.from_dict(json.loads(json.dumps(sc.to_dict())))
+    assert sc2 == sc
+    assert build_ops(sc2) == build_ops(sc)
+
+
+# -- the open-loop property --------------------------------------------------
+
+
+class _SlowFakeTarget:
+    """A target whose every query takes ``service_s`` — a saturated
+    server. An open-loop driver must keep dispatching on schedule
+    anyway; a closed-loop one would throttle to the service rate."""
+
+    def __init__(self, service_s: float):
+        self.service_s = service_s
+        self.mode = "fake"
+        # unroutable address: the report's ring-exemplar fallback must
+        # fail fast and quietly, proving the report needs no live node
+        self.base_urls = ["http://127.0.0.1:9"]
+        self._lock = threading.Lock()
+        self.started = 0
+        self.first_completion_at = None
+        self.started_before_first_completion = 0
+        self.t0 = time.perf_counter()
+
+    def create_index(self, *a, **k): pass
+    def create_field(self, *a, **k): pass
+    def import_bits(self, *a, **k): pass
+    def import_stream(self, reqs): return len(reqs)
+    def metrics_text(self, node=0): return ""
+    def debug_vars(self, node=0): return {}
+    def resolve_profile(self, tid, node=0): return None
+    def slow_peer(self, *a): return False
+    def heal_peer(self, *a): return False
+    def add_node(self): return False
+    def remove_node(self, *a): return False
+    def close(self): pass
+
+    def query(self, index, pql, **kw):
+        with self._lock:
+            self.started += 1
+            if self.first_completion_at is None:
+                self.started_before_first_completion += 1
+        time.sleep(self.service_s)
+        with self._lock:
+            if self.first_completion_at is None:
+                self.first_completion_at = time.perf_counter() - self.t0
+        return QueryOutcome("ok", 200)
+
+
+def test_open_loop_arrivals_independent_of_completions():
+    sc = _tiny_scenario(duration_s=1.5, rate=40.0, max_workers=96)
+    fake = _SlowFakeTarget(service_s=0.5)
+    rep = run_scenario(sc, target=fake)
+    n_sched = rep["arrivals"]["scheduled"]
+    assert rep["arrivals"]["dispatched"] == n_sched == fake.started
+    # The driver held the schedule even though NOTHING completed for
+    # the first 0.5 s: many arrivals were already in flight by then.
+    assert fake.started_before_first_completion >= 5
+    assert rep["arrivals"]["maxLagMs"] < 400
+    # Latency is measured from the scheduled arrival, so the 0.5 s
+    # service floor must show up in every class's p50.
+    for cls in rep["perClass"].values():
+        assert cls["client"]["p50Ms"] >= 450
+
+
+# -- SLO report schema -------------------------------------------------------
+
+
+def test_report_schema_roundtrip_and_validation():
+    sc = _tiny_scenario()
+    rep = run_scenario(sc, target=_SlowFakeTarget(service_s=0.001))
+    assert validate_report(rep) == []
+    rt = json.loads(json.dumps(rep))
+    assert validate_report(rt) == []
+    assert rt == rep
+
+    bad = json.loads(json.dumps(rep))
+    del bad["rates"]["shed"]
+    bad["perClass"]["interactive"]["client"]["p99Ms"] = "fast"
+    errs = validate_report(bad)
+    assert any("rates.shed" in e for e in errs)
+    assert any("p99Ms" in e for e in errs)
+    bad2 = json.loads(json.dumps(rep))
+    bad2["schemaVersion"] = 999
+    assert any("schemaVersion" in e for e in validate_report(bad2))
+
+
+def test_slo_gate_checks():
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "slo_gate", os.path.join(os.path.dirname(__file__), "..",
+                                 "scripts", "slo_gate.py"))
+    gate = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(gate)
+
+    rep = {"a": {"b": 10.0}, "xs": [1, 2]}
+    assert gate.run_check(rep, {"path": "a.b", "min": 5}) is None
+    assert gate.run_check(rep, {"path": "a.b", "max": 5}) is not None
+    assert gate.run_check(rep, {"path": "a.b", "value": 11,
+                                "relTol": 0.2}) is None
+    assert gate.run_check(rep, {"path": "a.b", "value": 20,
+                                "relTol": 0.2}) is not None
+    assert gate.run_check(rep, {"path": "a.b", "value": 0,
+                                "absTol": 15}) is None
+    assert gate.run_check(rep, {"path": "xs", "minLen": 2}) is None
+    assert gate.run_check(rep, {"path": "xs", "minLen": 3}) is not None
+    assert gate.run_check(rep, {"path": "a.missing", "min": 0}) is not None
+
+
+# -- end-to-end: one real managed run ----------------------------------------
+
+
+def test_scenario_end_to_end_single_node():
+    sc = _tiny_scenario(
+        duration_s=2.5, rate=30.0, shards=2, density=0.003,
+        warmup_queries=4,
+        ingest=IngestLeg(duty=0.3, shards=1, per_shard=2_000))
+    rep = run_scenario(sc)   # run_scenario enforces the schema itself
+    assert rep["target"]["mode"] == "managed"
+    inter = rep["perClass"]["interactive"]
+    assert inter["counts"]["ok"] > 10
+    assert inter["client"]["count"] > 10
+    assert rep["legs"]["dash"]["count"] > 0
+    assert rep["legs"]["adhoc"]["count"] > 0
+    assert rep["cache"]["hits"] + rep["cache"]["misses"] > 0
+    assert rep["ingest"]["batches"] >= 1
+    assert rep["ingest"]["errors"] == 0
+    # a report always links at least one resolved profile
+    assert len(rep["exemplars"]) >= 1
+    assert rep["exemplars"][0]["traceId"]
+    assert isinstance(rep["exemplars"][0]["profile"], dict)
+
+
+# -- cross-node exemplar resolution (the profile-ring fan-out) ---------------
+
+
+def test_exemplar_profile_resolves_from_any_node():
+    """A fanned-out query's profile is retained on the coordinator's
+    ring only. /debug/queries/<trace-id> on ANY node must resolve it
+    (one-hop peer fan-out), with the nested remote legs intact."""
+    t = ManagedTarget(n_nodes=3, replica_n=1)
+    try:
+        t.create_index("xn")
+        t.create_field("xn", "f")
+        from pilosa_tpu.config import SHARD_WIDTH
+        rng = np.random.default_rng(2)
+        for s in range(6):
+            cols = s * SHARD_WIDTH + rng.integers(
+                0, SHARD_WIDTH, 500).astype(np.uint64)
+            rows = rng.integers(0, 4, 500).astype(np.uint64)
+            t.import_bits("xn", "f", rows, cols)
+        tid = tracing.new_trace_id()
+        out = t.query("xn", "Count(Row(f=1))", trace_id=tid, no_cache=True)
+        assert out.status == "ok"
+
+        # the serving node retained it; every OTHER node must resolve
+        # it through the fan-out rather than 404ing
+        for node in range(3):
+            prof = t.resolve_profile(tid, node=node)
+            assert prof is not None, f"node {node} failed to resolve {tid}"
+            assert prof.get("traceId") == tid
+        # a 3-node fan-out leaves remote legs in the retained profile
+        prof = t.resolve_profile(tid, node=1)
+        assert prof.get("remoteLegs"), "nested remote legs missing"
+
+        # the loop guard: ?local=true never fans out, so at least one
+        # node (any ring that didn't serve the query) answers 404
+        local_misses = 0
+        for node in range(3):
+            try:
+                urllib.request.urlopen(
+                    f"{t.base_urls[node]}/debug/queries/{tid}?local=true",
+                    timeout=10).read()
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+                local_misses += 1
+        assert local_misses == 2, "exactly one ring should hold the trace"
+    finally:
+        t.close()
