@@ -74,6 +74,17 @@ class ArchiveStore:
     def list_backups(self) -> list[str]:
         raise NotImplementedError
 
+    def delete(self, backup_id: str, rel_path: str) -> None:
+        """Remove one object (missing is not an error)."""
+        raise NotImplementedError
+
+    def delete_backup(self, backup_id: str) -> None:
+        """Remove a whole backup, manifest first — the backup must drop
+        out of ``list_backups`` before any payload byte goes, so a
+        crash mid-delete leaves only complete, restorable listings.
+        Retention pruning is the only caller."""
+        raise NotImplementedError
+
     # -- manifest helpers (shared across backends) -------------------------
 
     def write_manifest(self, backup_id: str, manifest: dict) -> None:
@@ -143,6 +154,18 @@ class LocalDirArchive(ArchiveStore):
         return sorted(d for d in os.listdir(self.root)
                       if os.path.isfile(
                           os.path.join(self.root, d, MANIFEST_NAME)))
+
+    def delete(self, backup_id: str, rel_path: str) -> None:
+        try:
+            os.remove(self._path(backup_id, rel_path))
+        except FileNotFoundError:
+            pass
+
+    def delete_backup(self, backup_id: str) -> None:
+        import shutil
+        base = self._path(backup_id, MANIFEST_NAME)
+        self.delete(backup_id, MANIFEST_NAME)  # unlist before payloads go
+        shutil.rmtree(os.path.dirname(base), ignore_errors=True)
 
 
 def fragment_rel_path(index: str, field: str, view: str, shard: int,

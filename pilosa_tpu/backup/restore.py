@@ -102,6 +102,46 @@ def rebuild_fragment(snap_bytes: bytes | None, wal_bytes: bytes | None,
     return out_rows, out_cols, applied
 
 
+def preflight_restore(archive, manifest: dict,
+                      crc_samples: int = 4) -> dict:
+    """Validate a manifest's FULL restore plan against the archive
+    before anything touches a data dir: every ``stored_in`` ref must
+    exist (an incremental leans on ancestors a bad prune could have
+    taken), and a deterministic spread of entries is read back and
+    CRC-checked. Failure names the missing or damaged object, so a
+    pruned-or-torn chain dies here — fast — instead of mid-restore.
+
+    Also the retention layer's verify-before-prune pass: a survivor
+    that fails this must abort the prune."""
+    files = resolve_files(manifest)
+    missing = [(e["stored_in"], e["path"]) for e in files.values()
+               if not archive.exists(e["stored_in"], e["path"])]
+    if missing:
+        sid, path = missing[0]
+        raise BackupError(
+            f"restore preflight: backup {manifest['id']!r} needs "
+            f"{len(missing)} object(s) the archive no longer has, "
+            f"first: {sid}/{path}")
+    ordered = [files[p] for p in sorted(files)]
+    if crc_samples <= 0 or not ordered:
+        sampled = []
+    elif crc_samples >= len(ordered):
+        sampled = ordered
+    else:
+        # Deterministic spread across the sorted plan (always includes
+        # the first and last entries).
+        step = (len(ordered) - 1) / (crc_samples - 1) if crc_samples > 1 \
+            else len(ordered)
+        sampled = [ordered[int(i * step)] for i in range(crc_samples)]
+    for entry in sampled:
+        data = archive.read(entry["stored_in"], entry["path"])
+        if file_crc(data) != entry.get("crc"):
+            raise BackupError(
+                f"restore preflight: backup {manifest['id']!r}: CRC "
+                f"mismatch on {entry['stored_in']}/{entry['path']}")
+    return {"checked": len(files), "crcChecked": len(sampled)}
+
+
 def select_backup_at(archive, timestamp: float) -> dict | None:
     """Latest complete backup captured at or before ``timestamp`` — the
     coarse half of PITR (pick the base archive by time, then ``pitr_ops``
@@ -275,6 +315,11 @@ class RestoreJob:
             raise BackupError(
                 f"restore would clobber existing index(es) "
                 f"{conflicting}: pass force to overwrite")
+        # Preflight the whole plan BEFORE touching any data dir (ours
+        # or a conflicting index we're about to force-drop): a broken
+        # chain must fail here, not as a mid-restore rollback.
+        preflight_restore(self.archive, manifest)
+        self._count("restore.preflights")
         for name in conflicting:
             # force: drop the live index everywhere before rebuilding.
             self.holder.delete_index(name)

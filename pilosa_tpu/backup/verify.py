@@ -20,7 +20,6 @@ from pilosa_tpu.backup.archive import (
     KIND_SNAP,
     KIND_TRANSLATE,
     KIND_WAL,
-    LocalDirArchive,
     file_crc,
     resolve_files,
 )
@@ -117,10 +116,11 @@ def verify_backup(store: ArchiveStore, backup_id: str) -> dict:
 def verify_archive(root, backup_id: str | None = None) -> dict:
     """Verify one backup, or every backup under an archive root.
 
-    ``root`` is a path or an ArchiveStore. Returns ``{"ok", "problems",
-    "checked", "backups"}`` with problems prefixed by backup id when
-    scanning the whole root."""
-    store = root if isinstance(root, ArchiveStore) else LocalDirArchive(root)
+    ``root`` is a directory path, an object-store URL, or an
+    ArchiveStore. Returns ``{"ok", "problems", "checked", "backups"}``
+    with problems prefixed by backup id when scanning the whole root."""
+    from pilosa_tpu.backup.objstore import open_archive
+    store = root if isinstance(root, ArchiveStore) else open_archive(root)
     if backup_id is not None:
         out = verify_backup(store, backup_id)
         out["backups"] = 1

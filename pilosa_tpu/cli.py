@@ -34,6 +34,15 @@ _DEFAULTS = {
     # Background integrity scrub: re-verify snapshot CRCs + repair
     # quarantined fragments from replicas (0 disables).
     "scrub_interval": 60.0,
+    # Unattended backups: every backup_interval seconds the coordinator
+    # captures an incremental into archive_url (a directory path or an
+    # s3-style http(s)://host:port/bucket[/prefix] URL; empty disables),
+    # opening a fresh full chain every backup_full_every runs and
+    # pruning superseded chains down to backup_keep_chains.
+    "backup_interval": 0.0,
+    "archive_url": "",
+    "backup_full_every": 8,
+    "backup_keep_chains": 2,
     # WAL records per fragment before a background snapshot triggers
     # (reference MaxOpN, fragment.go:84).
     "max_op_n": 10_000,
@@ -209,6 +218,14 @@ def cmd_server(args) -> int:
         cfg["qos_warmup"] = args.qos_warmup
     if args.scrub_interval is not None:
         cfg["scrub_interval"] = args.scrub_interval
+    if args.backup_interval is not None:
+        cfg["backup_interval"] = args.backup_interval
+    if args.archive_url is not None:
+        cfg["archive_url"] = args.archive_url
+    if args.backup_full_every is not None:
+        cfg["backup_full_every"] = args.backup_full_every
+    if args.backup_keep_chains is not None:
+        cfg["backup_keep_chains"] = args.backup_keep_chains
     if args.max_op_n is not None:
         cfg["max_op_n"] = args.max_op_n
     if args.quarantine_keep_n is not None:
@@ -275,6 +292,10 @@ def cmd_server(args) -> int:
         anti_entropy_interval=float(cfg["anti_entropy_interval"]),
         check_nodes_interval=float(cfg["check_nodes_interval"]),
         scrub_interval=float(cfg["scrub_interval"]),
+        backup_interval=float(cfg["backup_interval"]),
+        archive_url=str(cfg["archive_url"]) or None,
+        backup_full_every=int(cfg["backup_full_every"]),
+        backup_keep_chains=int(cfg["backup_keep_chains"]),
         max_op_n=int(cfg["max_op_n"]),
         join=str(cfg["join"]) or None,
         data_dir=cfg["data_dir"] or None,
@@ -498,7 +519,8 @@ def cmd_check(args) -> int:
     corruption), and jsonl line frames; report quarantined evidence
     files. ``--repair`` sweeps stale ``*.tmp`` crash leftovers.
     ``--archive`` additionally (or instead) verifies a backup archive
-    directory end to end. Exits non-zero when anything is BAD."""
+    (directory or object-store URL) end to end. Exits non-zero when
+    anything is BAD."""
     from pilosa_tpu.storage.integrity import LineCorruptError, parse_line
     from pilosa_tpu.storage.wal import scan_wal
     if not args.data_dir and not getattr(args, "archive", None):
@@ -654,9 +676,10 @@ def cmd_restore(args) -> int:
 
 
 def cmd_backup_verify(args) -> int:
-    """Offline end-to-end verification of a backup archive directory:
-    manifests, parent chains, per-file CRCs, snapshot footers, WAL
-    records, and meta line frames. Exits 1 on any damage."""
+    """Offline end-to-end verification of a backup archive (directory
+    or object-store URL): manifests, parent chains, per-file CRCs,
+    snapshot footers, WAL records, and meta line frames. Exits 1 on
+    any damage."""
     from pilosa_tpu.backup import verify_archive
     res = verify_archive(args.archive, backup_id=args.id)
     for prob in res["problems"]:
@@ -698,6 +721,12 @@ def cmd_generate_config(args) -> int:
           'check-nodes-interval = 5.0\n'
           '# background integrity scrub cadence, seconds (0 disables)\n'
           'scrub-interval = 60.0\n'
+          '# unattended backups: cadence (0 disables) + archive\n'
+          '# (a directory or http(s)://host:port/bucket object store)\n'
+          'backup-interval = 0.0\n'
+          'archive-url = ""\n'
+          'backup-full-every = 8\n'
+          'backup-keep-chains = 2\n'
           '# WAL records per fragment before a snapshot triggers\n'
           'max-op-n = 10000\n'
           '# preserved *.quarantine evidence files per fragment '
@@ -799,6 +828,19 @@ def main(argv: list[str] | None = None) -> int:
                         '("" disables)')
     s.add_argument("--import-pool-mb", type=int, default=None,
                    help="buffer-pool pages pre-faulted at boot (0 disables)")
+    s.add_argument("--backup-interval", type=float, default=None,
+                   help="unattended backup cadence, seconds "
+                        "(0 disables; needs --archive-url)")
+    s.add_argument("--archive-url", default=None,
+                   help="backup archive: a directory path or an "
+                        "s3-style http(s)://host:port/bucket[/prefix] "
+                        "object-store URL")
+    s.add_argument("--backup-full-every", type=int, default=None,
+                   help="start a new full chain every N scheduled "
+                        "backups (default 8)")
+    s.add_argument("--backup-keep-chains", type=int, default=None,
+                   help="retention: keep the newest N full chains, "
+                        "prune the rest (0 keeps all; default 2)")
     s.add_argument("--scrub-interval", type=float, default=None,
                    help="background integrity scrub cadence, seconds "
                         "(0 disables)")
@@ -934,7 +976,8 @@ def main(argv: list[str] | None = None) -> int:
     s.add_argument("--repair", action="store_true",
                    help="sweep stale .tmp crash leftovers")
     s.add_argument("--archive", default=None,
-                   help="also verify a backup archive directory")
+                   help="also verify a backup archive "
+                        "(directory or object-store URL)")
     s.set_defaults(fn=cmd_check)
 
     s = sub.add_parser("backup", help="back up the cluster to an archive")
@@ -971,7 +1014,8 @@ def main(argv: list[str] | None = None) -> int:
     s.add_argument("--id", default=None,
                    help="verify one backup id (default: all complete "
                         "backups in the archive)")
-    s.add_argument("archive")
+    s.add_argument("archive",
+                   help="archive directory or object-store URL")
     s.set_defaults(fn=cmd_backup_verify)
 
     s = sub.add_parser("inspect", help="data-dir fragment stats")

@@ -207,10 +207,129 @@ def _chaos_loop(sc: Scenario, target, stop: threading.Event,
             ok = target.heal_peer(act.node)
         elif act.action == "add_node":
             ok = target.add_node()
+        elif act.action == "dr_backup":
+            ok = target.dr_backup()
+        elif act.action == "dr_destroy_data":
+            ok = target.dr_destroy_data(act.node)
         else:
             ok = target.remove_node(act.node)
         applied.append({"atS": act.at_s, "action": act.action,
                         "node": act.node, "value": act.value, "ok": ok})
+
+
+# -- DR drill ------------------------------------------------------------
+
+
+def _dr_setup(sc: Scenario) -> dict:
+    """Boot the drill's fault-injected object store and derive the
+    node opts that point every node's backup scheduler at it."""
+    import tempfile
+
+    from pilosa_tpu.backup.faults import FakeObjectServer
+    cfg = dict(sc.dr or {})
+    srv = FakeObjectServer(seed=sc.seed)
+    srv.fail_rate = float(cfg.get("failRate", 0.15))
+    srv.torn_next_put = int(cfg.get("tornUploads", 2))
+    url = srv.url(bucket="drill")
+    return {
+        "srv": srv, "url": url, "cfg": cfg,
+        "data_root": tempfile.mkdtemp(prefix="loadgen-dr-"),
+        "node_opts": {
+            "backup_interval": float(cfg.get("intervalS", 3.0)),
+            "archive_url": url,
+            "backup_full_every": int(cfg.get("fullEvery", 1)),
+            "backup_keep_chains": int(cfg.get("keepChains", 1)),
+        },
+    }
+
+
+def _dr_epilogue(sc: Scenario, target, env: dict) -> dict:
+    """After the storm: final capture, restore into a fresh recovery
+    cluster, prove bit-equivalence, and prove every backup retention
+    left listed is still restorable. Returns the report's numeric
+    ``dr`` section."""
+    import shutil
+    import tempfile
+
+    from pilosa_tpu.backup import BackupError, open_archive, preflight_restore
+    srv, url = env["srv"], env["url"]
+    # One forced cycle captures the post-run state, so the recovery
+    # cluster has an exact target to be measured against.
+    dr: dict = {"finalBackupOk": 1 if target.dr_backup() else 0}
+
+    names = ("backup.scheduler.runs", "backup.scheduler.failed",
+             "backup.scheduler.skipped", "backup.retention.pruned",
+             "archive.retries", "archive.bytesOut", "archive.bytesIn")
+    sums = dict.fromkeys(names, 0.0)
+    for i in range(len(target.base_urls)):
+        try:
+            dvars = target.debug_vars(i)
+        except Exception:
+            continue
+        for n in names:
+            sums[n] += _counter_sum(dvars, n)
+    dr["backupRuns"] = int(sums["backup.scheduler.runs"])
+    dr["backupFailed"] = int(sums["backup.scheduler.failed"])
+    dr["backupSkipped"] = int(sums["backup.scheduler.skipped"])
+    dr["retentionPruned"] = int(sums["backup.retention.pruned"])
+    dr["archiveRetries"] = int(sums["archive.retries"])
+    dr["archiveBytesOut"] = int(sums["archive.bytesOut"])
+    dr["archiveBytesIn"] = int(sums["archive.bytesIn"])
+    dr["faultsInjected"] = srv.injected
+    dr["tornUploads"] = srv.torn
+
+    live = target.fragment_digest()
+    rec_root = tempfile.mkdtemp(prefix="loadgen-dr-rec-")
+    rec = ManagedTarget(n_nodes=int(env["cfg"].get("recoveryNodes", 2)),
+                        replica_n=sc.replica_n,
+                        node_opts=dict(sc.node_opts), data_root=rec_root)
+    try:
+        rec._post(rec.base_urls[0] + "/restore",
+                  json.dumps({"archive": url}))
+        deadline = time.time() + 120
+        st = {}
+        while time.time() < deadline:
+            try:
+                st = json.loads(rec._get(rec.base_urls[0]
+                                         + "/restore/status"))
+            except Exception:
+                st = {}
+            if st.get("state") in ("done", "failed"):
+                break
+            time.sleep(0.2)
+        dr["restoreDone"] = 1 if st.get("state") == "done" else 0
+        recovered = rec.fragment_digest()
+        dr["restoredFragments"] = len(recovered)
+        # Bit-equivalence, key by key: every restored replica's digest
+        # must be one the live cluster holds for that fragment (the
+        # backup captured exactly one healthy replica's bytes), and no
+        # fragment may appear on one side only.
+        mismatched = 0
+        for k in set(live) | set(recovered):
+            lv, rv = live.get(k), recovered.get(k)
+            if lv is None or rv is None or not rv <= lv:
+                mismatched += 1
+        dr["mismatchedFragments"] = mismatched
+    finally:
+        rec.close()
+        shutil.rmtree(rec_root, ignore_errors=True)
+
+    # Retention's standing invariant, re-proved from the outside: every
+    # backup the archive still lists passes a restore preflight.
+    arch = open_archive(url)
+    try:
+        ids = arch.list_backups()
+        unrestorable = 0
+        for bid in ids:
+            try:
+                preflight_restore(arch, arch.read_manifest(bid))
+            except BackupError:
+                unrestorable += 1
+        dr["survivingBackups"] = len(ids)
+        dr["unrestorableBackups"] = unrestorable
+    finally:
+        arch.close()
+    return dr
 
 
 # -- counters ------------------------------------------------------------
@@ -271,9 +390,20 @@ def run_scenario(sc: Scenario, target=None, out: str | None = None,
     from pilosa_tpu.obs.stats import MemoryStats
 
     owned = target is None
+    dr_env = None
+    if sc.dr is not None:
+        if not owned:
+            raise ValueError("a DR drill scenario needs a managed "
+                             "target (it owns the nodes it destroys)")
+        dr_env = _dr_setup(sc)
     if owned:
-        target = ManagedTarget(n_nodes=sc.nodes, replica_n=sc.replica_n,
-                               node_opts=dict(sc.node_opts))
+        node_opts = dict(sc.node_opts)
+        if dr_env is not None:
+            node_opts.update(dr_env["node_opts"])
+        target = ManagedTarget(
+            n_nodes=sc.nodes, replica_n=sc.replica_n,
+            node_opts=node_opts,
+            data_root=dr_env["data_root"] if dr_env else None)
     stats = MemoryStats()
     ops = build_ops(sc)
     try:
@@ -345,12 +475,18 @@ def run_scenario(sc: Scenario, target=None, out: str | None = None,
             t.join(timeout=30)
         after = _cluster_counters(target)
 
+        dr_section = (_dr_epilogue(sc, target, dr_env)
+                      if dr_env is not None else None)
         report = _build_report(sc, target, stats, ops, elapsed, dispatched,
                                max_lag, before, after, ingest_totals,
-                               chaos_applied)
+                               chaos_applied, dr_section)
     finally:
         if owned:
             target.close()
+        if dr_env is not None:
+            import shutil
+            dr_env["srv"].close()
+            shutil.rmtree(dr_env["data_root"], ignore_errors=True)
     errs = validate_report(report)
     if errs:
         raise RuntimeError(f"SLO report failed its own schema: {errs}")
@@ -363,7 +499,8 @@ def run_scenario(sc: Scenario, target=None, out: str | None = None,
 
 
 def _build_report(sc: Scenario, target, stats, ops, elapsed, dispatched,
-                  max_lag, before, after, ingest_totals, chaos_applied):
+                  max_lag, before, after, ingest_totals, chaos_applied,
+                  dr=None):
     delta = {k: after[k] - before[k] for k in after}
     server_hists = _server_class_hists(target)
 
@@ -489,5 +626,8 @@ def _build_report(sc: Scenario, target, stats, ops, elapsed, dispatched,
                 if ingest_totals["seconds"] else 0.0,
         },
         "chaos": chaos_applied,
+        "dr": (None if dr is None else dict(
+            dr, failedQueries=int(sum(per_class[c]["counts"]["error"]
+                                      for c in per_class)))),
         "exemplars": exemplars,
     }

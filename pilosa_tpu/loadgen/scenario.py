@@ -59,7 +59,10 @@ class IngestLeg:
 class ChaosAction:
     """One timeline entry. Actions: ``slow_peer`` (value = delay ms,
     via POST /internal/fault), ``heal_peer``, ``add_node`` (live
-    resize grow), ``remove_node`` (live resize shrink)."""
+    resize grow), ``remove_node`` (live resize shrink), ``dr_backup``
+    (force one scheduled-backup cycle now), ``dr_destroy_data``
+    (resize a member out and destroy its data directory — the DR
+    drill's disaster)."""
 
     at_s: float
     action: str
@@ -68,7 +71,8 @@ class ChaosAction:
 
     def __post_init__(self):
         if self.action not in ("slow_peer", "heal_peer",
-                               "add_node", "remove_node"):
+                               "add_node", "remove_node",
+                               "dr_backup", "dr_destroy_data"):
             raise ValueError(f"unknown chaos action {self.action!r}")
 
 
@@ -101,6 +105,15 @@ class Scenario:
     legs: list[QueryLeg] = field(default_factory=list)
     ingest: IngestLeg | None = None
     chaos: list[ChaosAction] = field(default_factory=list)
+
+    # disaster-recovery drill (managed mode only): when set, the engine
+    # boots a fault-injected in-process object store, gives every node
+    # a data dir plus an unattended backup scheduler pointed at it, and
+    # after the run restores the archive into a fresh recovery cluster
+    # and proves bit-equivalence. Keys: failRate (per-request 503
+    # probability), intervalS (scheduler cadence), fullEvery,
+    # keepChains, recoveryNodes, tornUploads.
+    dr: dict | None = None
 
     # driver
     max_workers: int = 64

@@ -162,6 +162,39 @@ def elastic() -> Scenario:
     )
 
 
+def dr_drill() -> Scenario:
+    """Unattended disaster recovery under fire: mixed traffic with a
+    trickle ingest while every node runs a backup scheduler against a
+    fault-injected object store (≥10% of archive requests 503, plus
+    torn uploads). Mid-run a gray failure comes and goes, a forced
+    backup cycle lands, and then one member is resized out and its
+    data dir destroyed. The run must keep zero failed queries; the
+    engine's DR epilogue then restores the archive into a fresh
+    recovery cluster, proves bit-equivalence fragment by fragment, and
+    proves every backup retention left listed still restores."""
+    return Scenario(
+        name="dr_drill", seed=97, duration_s=16.0, rate=30.0,
+        nodes=3, replica_n=2, shards=4, rows=32, density=0.004,
+        tenants=8, tenant_s=1.2,
+        legs=[QueryLeg(name="dashboard", weight=4.0, kind="dashboard",
+                       qos_class="interactive", population=16),
+              QueryLeg(name="adhoc", weight=2.0, kind="adhoc",
+                       qos_class="batch", population=32, no_cache=True),
+              QueryLeg(name="bsi_agg", weight=1.0, kind="bsi",
+                       qos_class="batch", population=8)],
+        ingest=IngestLeg(duty=0.25, shards=2, per_shard=8_000),
+        chaos=[ChaosAction(at_s=2.5, action="slow_peer", node=1,
+                           value=120.0),
+               ChaosAction(at_s=5.0, action="heal_peer", node=1),
+               ChaosAction(at_s=6.0, action="dr_backup"),
+               ChaosAction(at_s=8.5, action="dr_destroy_data", node=2),
+               ChaosAction(at_s=12.0, action="dr_backup")],
+        dr={"failRate": 0.15, "intervalS": 4.0, "fullEvery": 1,
+            "keepChains": 1, "recoveryNodes": 2, "tornUploads": 2},
+        node_opts={"qos_max_concurrent": 8},
+    )
+
+
 SCENARIOS = {
     "smoke": smoke,
     "smoke3": smoke3,
@@ -170,6 +203,7 @@ SCENARIOS = {
     "overload": overload,
     "ingest_under_query": ingest_under_query,
     "elastic": elastic,
+    "dr_drill": dr_drill,
 }
 
 

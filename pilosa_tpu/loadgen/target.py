@@ -18,8 +18,11 @@ cache, and profile paths a real client hits, not a bench backdoor.
 from __future__ import annotations
 
 import json
+import os
+import shutil
 import socket
 import threading
+import time
 import urllib.error
 import urllib.request
 
@@ -152,6 +155,12 @@ class _HTTPTargetBase:
     def remove_node(self, node: int) -> bool:
         return False
 
+    def dr_backup(self) -> bool:
+        return False
+
+    def dr_destroy_data(self, node: int) -> bool:
+        return False
+
     def close(self) -> None:
         pass
 
@@ -179,7 +188,8 @@ class ManagedTarget(_HTTPTargetBase):
     """Boot and own N in-process ServerNodes for one run."""
 
     def __init__(self, n_nodes: int = 1, replica_n: int = 1,
-                 node_opts: dict | None = None, timeout: float = 30.0):
+                 node_opts: dict | None = None, timeout: float = 30.0,
+                 data_root: str | None = None):
         from pilosa_tpu.server.node import ServerNode
         from pilosa_tpu.server.httpclient import HTTPInternalClient
         self.mode = "managed"
@@ -189,17 +199,29 @@ class ManagedTarget(_HTTPTargetBase):
                 "check_nodes_interval": 0.0, "qos_slow_query_ms": 1000.0,
                 "chaos_faults": True}
         opts.update(node_opts or {})
+        # data_root gives each node its own durable data dir (the DR
+        # drill needs real stores to back up and destroy); without it
+        # the nodes stay memory-only as before.
+        self._data_root = data_root
+        self._dir_seq = n_nodes
+        self._node_opts = opts
         addrs = [f"127.0.0.1:{p}" for p in _free_ports(n_nodes)]
         self.nodes = [ServerNode(bind=a, peers=addrs if n_nodes > 1 else None,
-                                 replica_n=replica_n, **opts)
-                      for a in addrs]
-        self._node_opts = opts
+                                 replica_n=replica_n,
+                                 **self._opts_for(i))
+                      for i, a in enumerate(addrs)]
         self._replica_n = replica_n
         self._lock = threading.Lock()
         for n in self.nodes:
             n.open()
         super().__init__([n.address for n in self.nodes], timeout)
         self._client = HTTPInternalClient(timeout=timeout)
+
+    def _opts_for(self, i: int) -> dict:
+        opts = dict(self._node_opts)
+        if self._data_root:
+            opts["data_dir"] = os.path.join(self._data_root, f"n{i}")
+        return opts
 
     def _peer(self, node: int = 0):
         from pilosa_tpu.cluster.node import URI, Node
@@ -213,44 +235,113 @@ class ManagedTarget(_HTTPTargetBase):
         from pilosa_tpu.server.node import ServerNode
         with self._lock:
             addr = f"127.0.0.1:{_free_ports(1)[0]}"
+            opts = self._opts_for(self._dir_seq)
+            self._dir_seq += 1
             joiner = ServerNode(bind=addr, join=self.nodes[0].id,
-                                replica_n=self._replica_n,
-                                **self._node_opts)
+                                replica_n=self._replica_n, **opts)
             joiner.open()
             self.nodes.append(joiner)
             self.base_urls.append(joiner.address)
             return True
 
-    def remove_node(self, node: int) -> bool:
+    def _coordinator(self):
+        return next((n for n in self.nodes
+                     if n.cluster.coordinator() is not None
+                     and n.cluster.coordinator().id == n.id),
+                    self.nodes[0])
+
+    def _remove(self, node: int):
+        """Resize a member out of the ring; returns the closed victim
+        ServerNode, or None when removal isn't possible."""
         with self._lock:
             if node <= 0 or node >= len(self.nodes):
-                return False   # never shoot node 0 (our setup anchor)
+                return None   # never shoot node 0 (our setup anchor)
             # Removal is a coordinator-only request, and the coordinator
             # is elected by node-id order — not necessarily nodes[0]. If
             # the named victim IS the coordinator, shoot another member
             # instead: the scenario asks for "a member leaves", not for
             # a coordinator handoff.
-            coord = next((n for n in self.nodes
-                          if n.cluster.coordinator() is not None
-                          and n.cluster.coordinator().id == n.id),
-                         self.nodes[0])
+            coord = self._coordinator()
             victim = self.nodes[node]
             if victim is coord:
                 others = [i for i in range(1, len(self.nodes))
                           if self.nodes[i] is not coord]
                 if not others:
-                    return False
+                    return None
                 node = others[-1]
                 victim = self.nodes[node]
             try:
                 self._post(f"{coord.address}/cluster/resize/remove-node",
                            json.dumps({"id": victim.id}))
             except (urllib.error.URLError, OSError):
-                return False
+                return None
             self.nodes.pop(node)
             self.base_urls.pop(node)
             victim.close()
-            return True
+            return victim
+
+    def remove_node(self, node: int) -> bool:
+        return self._remove(node) is not None
+
+    # -- DR drill surface ---------------------------------------------
+
+    def dr_backup(self) -> bool:
+        """Force one scheduled-backup cycle on the coordinator NOW
+        (drills and tests; the timer path stays untouched). Retries a
+        few times — the drill's archive injects faults on purpose."""
+        coord = self._coordinator()
+        sched = getattr(coord, "backup_scheduler", None)
+        if sched is None:
+            return False
+        deadline = time.time() + 30.0
+        while time.time() < deadline:
+            if not coord._backup_gate.acquire(blocking=False):
+                # a timer-driven run is mid-capture; wait it out
+                time.sleep(0.1)
+                continue
+            try:
+                st = sched.run_once(force=True)
+            finally:
+                coord._backup_gate.release()
+            if st in ("ran", "skipped-unchanged"):
+                return True
+            time.sleep(0.2)
+        return False
+
+    def dr_destroy_data(self, node: int) -> bool:
+        """The drill's disaster: resize the member out of the ring
+        (serving continues on the survivors), then destroy its data
+        directory beyond recovery — only the archive can bring those
+        bytes back."""
+        victim = self._remove(node)
+        if victim is None:
+            return False
+        if victim.data_dir:
+            shutil.rmtree(victim.data_dir, ignore_errors=True)
+        return True
+
+    def fragment_digest(self) -> dict[str, set[str]]:
+        """Bit-level content fingerprint of every fragment this cluster
+        owns: (index/field/view/shard) -> the set of per-replica block-
+        checksum digests. Only placement owners contribute — a resize
+        leaves restorable-but-stale bytes on former owners, and those
+        are not the cluster's state. The DR drill's equivalence check:
+        every restored fragment's digest must appear in the live set
+        (a backup captures exactly one healthy replica's bytes)."""
+        out: dict[str, set[str]] = {}
+        for n in self.nodes:
+            if n.store is None:
+                continue
+            for iname, fld, view, shard in n.store.all_fragment_keys():
+                if n.cluster is not None and n.id not in {
+                        m.id for m in n.cluster.shard_nodes(iname, shard)}:
+                    continue
+                blocks = n.api.fragment_blocks(iname, fld, view, shard)
+                digest = ";".join(f"{b}:{cs.hex()}"
+                                  for b, cs in sorted(blocks.items()))
+                out.setdefault(f"{iname}/{fld}/{view}/{shard}",
+                               set()).add(digest)
+        return out
 
     def close(self) -> None:
         self._client.close()

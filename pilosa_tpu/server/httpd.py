@@ -997,6 +997,15 @@ def _build_routes(api: API):
             "migration": mig.snapshot() if mig is not None else None,
         }
 
+    def get_debug_backup(pv, params, body):
+        """Unattended-backup health: the BackupScheduler's status doc
+        (runs/skips/failures, backoff, slowlog, last prune), or
+        {"enabled": false} when no scheduler runs on this node."""
+        handler = getattr(api, "backup_debug_handler", None)
+        if handler is None:
+            return 200, {"enabled": False}
+        return 200, handler()
+
     def post_resize_abort(pv, params, body):
         job = getattr(api, "resize_job", None)
         if job is not None:
@@ -1228,6 +1237,7 @@ def _build_routes(api: API):
         (r"/internal/fragment/blocks", {"GET": get_fragment_blocks}),
         (r"/internal/fragment/nodes", {"GET": get_fragment_nodes}),
         (r"/debug/resize", {"GET": get_debug_resize}),
+        (r"/debug/backup", {"GET": get_debug_backup}),
         (r"/internal/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)"
          r"/remote-available-shards/(?P<shard>[0-9]+)",
          {"DELETE": delete_remote_available_shard}),

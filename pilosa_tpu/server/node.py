@@ -52,6 +52,10 @@ class ServerNode:
                  anti_entropy_interval: float | None = None,
                  check_nodes_interval: float | None = None,
                  scrub_interval: float | None = None,
+                 backup_interval: float = 0.0,
+                 archive_url: str | None = None,
+                 backup_full_every: int = 8,
+                 backup_keep_chains: int = 2,
                  max_op_n: int | None = None,
                  join: str | None = None,
                  data_dir: str | None = None,
@@ -296,6 +300,7 @@ class ServerNode:
         self._sync_timer: threading.Timer | None = None
         self._check_timer: threading.Timer | None = None
         self._scrub_timer: threading.Timer | None = None
+        self._backup_timer: threading.Timer | None = None
         self._closed = False
         #: one resize job at a time (reference cluster.go:1447).
         self._resize_gate = threading.Lock()
@@ -310,6 +315,15 @@ class ServerNode:
         self._scrub_interval = (
             self.DEFAULT_SCRUB_INTERVAL
             if scrub_interval is None else scrub_interval)
+        #: unattended-DR knobs: with both --backup-interval and
+        #: --archive-url set, open() starts a BackupScheduler ticking
+        #: periodic incrementals into the archive (scheduler.py).
+        self._backup_interval = float(backup_interval or 0.0)
+        self._archive_url = archive_url
+        self._backup_full_every = int(backup_full_every)
+        self._backup_keep_chains = int(backup_keep_chains)
+        self.backup_scheduler = None
+        self.backup_archive = None
         # Device-side fold of remote bitmap legs (exec/device_reduce);
         # the PILOSA_TPU_DEVICE_REDUCE env var still overrides per-run.
         from pilosa_tpu.exec import device_reduce as _device_reduce
@@ -398,6 +412,7 @@ class ServerNode:
             self.api.backup_status_handler = self.backup_status
             self.api.restore_handler = self.handle_restore
             self.api.restore_status_handler = self.restore_status
+        self.api.backup_debug_handler = self.backup_debug
 
     def _wire_topology_persistence(self, data_dir: str) -> None:
         """Durable topology (reference .topology file, cluster.go:1657):
@@ -505,6 +520,21 @@ class ServerNode:
             self._schedule_check_nodes()
         if self.scrubber is not None and self._scrub_interval > 0:
             self._schedule_scrub()
+        if (self._backup_interval > 0 and self._archive_url
+                and self.store is not None):
+            from pilosa_tpu.backup import BackupScheduler, open_archive
+            self.backup_archive = open_archive(self._archive_url,
+                                               stats=self.stats)
+            self.backup_scheduler = BackupScheduler(
+                holder=self.holder, cluster=self.cluster,
+                client=(self.cluster.client
+                        if self.cluster is not None else None),
+                store=self.store, archive=self.backup_archive,
+                interval=self._backup_interval, node_id=self.id,
+                stats=self.stats, admission=self.qos,
+                full_every=self._backup_full_every,
+                keep_chains=self._backup_keep_chains)
+            self._schedule_backup()
         from pilosa_tpu.obs.runtime import RuntimeMonitor
         self.runtime_monitor = RuntimeMonitor(self.stats,
                                               self.executor.planner,
@@ -700,6 +730,27 @@ class ServerNode:
         self._scrub_timer.daemon = True
         self._scrub_timer.start()
 
+    def _schedule_backup(self) -> None:
+        # Tick at half the backup interval so a missed coordinator
+        # handoff costs at most half a cycle; the scheduler's own
+        # due/backoff gating makes extra ticks free.
+        def tick():
+            try:
+                if self._backup_gate.acquire(blocking=False):
+                    try:
+                        self.backup_scheduler.tick()
+                    finally:
+                        self._backup_gate.release()
+            except Exception:
+                pass  # scheduler.tick never raises; belt and braces
+            finally:
+                if not self._closed:
+                    self._schedule_backup()
+        self._backup_timer = threading.Timer(
+            self._jitter(max(0.05, self._backup_interval / 2.0)), tick)
+        self._backup_timer.daemon = True
+        self._backup_timer.start()
+
     #: membership push/pull piggybacks on every Nth liveness sweep
     #: (full-ring pulls each sweep would double detector traffic).
     DISCOVER_EVERY_N_SWEEPS = 5
@@ -747,6 +798,13 @@ class ServerNode:
             self._check_timer.cancel()
         if self._scrub_timer is not None:
             self._scrub_timer.cancel()
+        if self._backup_timer is not None:
+            self._backup_timer.cancel()
+        if self.backup_archive is not None:
+            try:
+                self.backup_archive.close()
+            except Exception:
+                pass
         if getattr(self, "runtime_monitor", None) is not None:
             self.runtime_monitor.close()
         if self.executor.planner is not None:
@@ -1038,22 +1096,22 @@ class ServerNode:
     # -- backup / restore --------------------------------------------------
 
     def handle_backup(self, req: dict) -> dict:
-        """POST /backup: start a cluster backup into the archive
-        directory named in the request; returns the backup id
-        immediately (poll /backup/status for completion)."""
+        """POST /backup: start a cluster backup into the archive named
+        in the request (directory path or object-store URL); returns
+        the backup id immediately (poll /backup/status)."""
         from pilosa_tpu.backup import (
             BackupError,
             BackupWriter,
-            LocalDirArchive,
             new_backup_id,
+            open_archive,
         )
         req = req or {}
         root = req.get("archive")
         if not root:
             raise BackupError(
-                "backup: 'archive' (directory path) is required")
+                "backup: 'archive' (directory path or URL) is required")
         parent = req.get("parent") or None
-        archive = LocalDirArchive(root)
+        archive = open_archive(root, stats=self.stats)
         if parent and not archive.has_manifest(parent):
             raise BackupError(
                 f"backup: parent {parent!r} not found in archive")
@@ -1091,16 +1149,16 @@ class ServerNode:
 
         from pilosa_tpu.backup import (
             BackupError,
-            LocalDirArchive,
             RestoreJob,
+            open_archive,
             select_backup_at,
         )
         req = req or {}
         root = req.get("archive")
         if not root:
             raise BackupError(
-                "restore: 'archive' (directory path) is required")
-        archive = LocalDirArchive(root)
+                "restore: 'archive' (directory path or URL) is required")
+        archive = open_archive(root, stats=self.stats)
         backup_id = req.get("id")
         if not backup_id:
             m = select_backup_at(archive, _time.time())
@@ -1137,6 +1195,16 @@ class ServerNode:
     def restore_status(self) -> dict:
         j = self._restore_job
         return dict(j.progress) if j is not None else {"state": "idle"}
+
+    def backup_debug(self) -> dict:
+        """GET /debug/backup: the scheduler's health document, or a
+        stub when unattended backups aren't configured on this node."""
+        if self.backup_scheduler is None:
+            return {"enabled": False}
+        doc = self.backup_scheduler.status()
+        doc["enabled"] = True
+        doc["archive"] = self._archive_url
+        return doc
 
     # -- warmup-from-observed-traffic --------------------------------------
 

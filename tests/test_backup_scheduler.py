@@ -1,0 +1,228 @@
+"""BackupScheduler — fake-clock determinism, backoff, and handoff.
+
+The scheduler's clock and jitter rng are injectable, so these tests
+replay the interval math exactly: cadence (waiting → full →
+skipped-unchanged → incremental), failure backoff growth and reset,
+chain rollover at ``full_every``, adopt-latest across a restart,
+coordinator handoff picking the chain up without a forced full, and
+retention pruning riding the run. No sleeps, no wall clock.
+"""
+
+import json
+import random
+
+from pilosa_tpu.backup import BackupScheduler, LocalDirArchive
+from pilosa_tpu.backup.faults import FaultyArchive
+from pilosa_tpu.backup.scheduler import (
+    FAILED,
+    RAN,
+    SKIP_NOT_COORDINATOR,
+    SKIP_NOT_DUE,
+    SKIP_UNCHANGED,
+)
+from pilosa_tpu.cluster.harness import LocalCluster
+from pilosa_tpu.obs.stats import MemoryStats
+from tests.test_backup import _close_stores, _seed
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _sched(lc, archive, node: int = 0, **kw):
+    cn = lc[node]
+    kw.setdefault("clock", FakeClock())
+    kw.setdefault("rng", random.Random(1))
+    return BackupScheduler(holder=cn.holder, cluster=cn.cluster,
+                           client=lc.client, store=cn.store,
+                           archive=archive, interval=kw.pop("interval", 10.0),
+                           **kw)
+
+
+def test_fake_clock_cadence(tmp_path):
+    lc = LocalCluster(1, data_dirs=[str(tmp_path / "n0")])
+    _seed(lc, n_cols=100_000, step=7_001)
+    archive = LocalDirArchive(str(tmp_path / "arch"))
+    clk = FakeClock()
+    stats = MemoryStats()
+    sched = _sched(lc, archive, clock=clk, stats=stats)
+
+    assert sched.tick() == SKIP_NOT_DUE          # not due yet
+    clk.advance(10.0)
+    assert sched.tick() == RAN                   # first run opens a full
+    full = sched.last_manifest
+    assert full["kind"] == "full"
+    assert sched.tick() == SKIP_NOT_DUE          # interval re-arms
+
+    clk.advance(10.0)
+    assert sched.tick() == SKIP_UNCHANGED        # epoch fast path: no-op
+
+    lc.query("i", "Set(123, f=1)")               # an index epoch moves
+    clk.advance(10.0)
+    assert sched.tick() == RAN
+    assert sched.last_manifest["kind"] == "incremental"
+    assert sched.last_manifest["parent"] == full["id"]
+
+    assert (sched.runs, sched.skipped, sched.failed) == (2, 1, 0)
+    assert stats.counter_value("backup.scheduler.runs") == 2
+    assert stats.counter_value("backup.scheduler.skipped") == 1
+    _close_stores(lc)
+
+
+def test_failure_backoff_grows_and_resets(tmp_path):
+    lc = LocalCluster(1, data_dirs=[str(tmp_path / "n0")])
+    _seed(lc, n_cols=100_000, step=7_001)
+    fa = FaultyArchive(LocalDirArchive(str(tmp_path / "arch")), seed=3)
+    clk = FakeClock()
+    sched = _sched(lc, fa, clock=clk, rng=random.Random(5))
+
+    clk.advance(10.0)
+    assert sched.tick() == RAN                   # healthy baseline + adopt
+
+    lc.query("i", "Set(5, f=2)")
+    fa.fail_next_ops = 1                         # next archive op dies
+    clk.advance(10.0)
+    assert sched.tick() == FAILED
+    assert sched.consecutive_failures == 1
+    assert "injected archive fault" in sched.last_error
+    # one interval of backoff, full-jittered up to +25%
+    gap1 = sched._backoff_until - clk.t
+    assert 0.0 < gap1 <= 10.0 * 1.25
+
+    clk.advance(9.0)
+    assert sched.tick() == SKIP_NOT_DUE          # inside the window
+
+    fa.fail_next_ops = 1
+    clk.advance(10.0 * 1.25 - 9.0 + 0.1)         # past any jitter
+    assert sched.tick() == FAILED
+    assert sched.consecutive_failures == 2
+    gap2 = sched._backoff_until - clk.t
+    assert 20.0 <= gap2 <= 20.0 * 1.25           # window doubled
+
+    clk.advance(gap2 + 0.1)                      # heal: archive works again
+    assert sched.tick() == RAN
+    assert sched.consecutive_failures == 0
+    assert sched.last_error is None
+    assert sched.last_manifest["kind"] == "incremental"
+    _close_stores(lc)
+
+
+def test_chain_rollover_and_retention_prune(tmp_path):
+    lc = LocalCluster(1, data_dirs=[str(tmp_path / "n0")])
+    _seed(lc, n_cols=100_000, step=7_001)
+    archive = LocalDirArchive(str(tmp_path / "arch"))
+    sched = _sched(lc, archive, full_every=2, keep_chains=1)
+
+    assert sched.run_once(force=True) == RAN
+    first_full = sched.last_manifest["id"]
+    lc.query("i", "Set(7, f=3)")
+    assert sched.run_once(force=True) == RAN
+    assert sched.last_manifest["kind"] == "incremental"
+
+    # third run hits full_every: a new chain opens, and keep_chains=1
+    # retention prunes the whole superseded one
+    lc.query("i", "Set(8, f=4)")
+    assert sched.run_once(force=True) == RAN
+    assert sched.last_manifest["kind"] == "full"
+    assert sched.last_prune is not None
+    assert sched.last_prune["pruned"] == 2
+    assert archive.list_backups() == [sched.last_manifest["id"]]
+    assert first_full not in archive.list_backups()
+    _close_stores(lc)
+
+
+def test_adopt_latest_across_restart(tmp_path):
+    lc = LocalCluster(1, data_dirs=[str(tmp_path / "n0")])
+    _seed(lc, n_cols=100_000, step=7_001)
+    archive = LocalDirArchive(str(tmp_path / "arch"))
+    s1 = _sched(lc, archive)
+    assert s1.run_once(force=True) == RAN
+    lc.query("i", "Set(9, f=5)")
+    assert s1.run_once(force=True) == RAN
+    last = s1.last_manifest["id"]
+
+    # a "restarted" scheduler: fresh state, same archive. It adopts the
+    # latest complete backup — including its epochs, so an unchanged
+    # cluster is still the free fast path, not a forced full.
+    s2 = _sched(lc, archive)
+    assert s2.run_once(force=True) == SKIP_UNCHANGED
+    assert s2.last_manifest["id"] == last
+    lc.query("i", "Set(10, f=6)")
+    assert s2.run_once(force=True) == RAN
+    assert s2.last_manifest["kind"] == "incremental"
+    assert s2.last_manifest["parent"] == last
+    _close_stores(lc)
+
+
+def test_coordinator_handoff_adopts_chain(tmp_path):
+    dirs = [str(tmp_path / f"n{i}") for i in range(2)]
+    lc = LocalCluster(2, replica_n=1, data_dirs=dirs)
+    _seed(lc, n_cols=100_000, step=7_001)
+    archive = LocalDirArchive(str(tmp_path / "arch"))
+    s0 = _sched(lc, archive, node=0, node_id="node0")
+    s1 = _sched(lc, archive, node=1, node_id="node1")
+
+    assert s1.run_once(force=True) == SKIP_NOT_COORDINATOR
+    assert s0.run_once(force=True) == RAN
+    first = s0.last_manifest["id"]
+
+    # handoff: node1 becomes coordinator in every node's view
+    for cn in lc.nodes:
+        for m in cn.cluster.nodes:
+            m.is_coordinator = (m.id == "node1")
+    lc.query("i", "Set(11, f=0)")
+    assert s0.run_once(force=True) == SKIP_NOT_COORDINATOR
+    assert s1.run_once(force=True) == RAN
+    # the new coordinator adopted the old one's backup as its parent —
+    # a handoff never forces a full
+    assert s1.last_manifest["kind"] == "incremental"
+    assert s1.last_manifest["parent"] == first
+    _close_stores(lc)
+
+
+def test_status_doc_and_slowlog(tmp_path):
+    lc = LocalCluster(1, data_dirs=[str(tmp_path / "n0")])
+    _seed(lc, n_cols=100_000, step=7_001)
+    archive = LocalDirArchive(str(tmp_path / "arch"))
+    clk = FakeClock()
+    sched = _sched(lc, archive, clock=clk, interval=1.0)
+
+    # a run that "takes" 6 fake seconds against a 1 s interval: the
+    # cadence silently degraded, and the slowlog must say so
+    clk.t = 10.0
+    assert sched.run_once(now=4.0) == RAN
+    assert len(sched.slowlog) == 1
+    assert sched.slowlog[0]["seconds"] >= 6.0
+
+    st = sched.status()
+    for key in ("intervalS", "fullEvery", "keepChains", "runs", "skipped",
+                "failed", "consecutiveFailures", "lastStatus", "lastError",
+                "lastSuccessEpoch", "lastBackupId", "runsInChain",
+                "nextDueInS", "backoffRemainingS", "lastPrune", "slowlog"):
+        assert key in st
+    assert st["lastBackupId"] == sched.last_manifest["id"]
+    assert st["lastStatus"] == RAN
+    json.dumps(st)   # the /debug/backup document must serialize
+    _close_stores(lc)
+
+
+def test_tick_never_raises(tmp_path):
+    lc = LocalCluster(1, data_dirs=[str(tmp_path / "n0")])
+    _seed(lc, n_cols=100_000, step=7_001)
+    sched = _sched(lc, LocalDirArchive(str(tmp_path / "arch")))
+
+    def boom(**kw):
+        raise RuntimeError("timer thread must survive this")
+
+    sched.run_once = boom
+    sched.clock.advance(10.0)
+    assert sched.tick() == FAILED
+    assert "survive" in sched.last_error
+    _close_stores(lc)

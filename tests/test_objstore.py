@@ -1,0 +1,359 @@
+"""ObjectArchiveStore against a fault-injecting in-process object server.
+
+Fast tests prove the S3 wire discipline one knob at a time: URL parsing
+and ``open_archive`` dispatch, tmp-key+finalize writes (a torn upload is
+never listed and the retry overwrites it), bounded full-jitter retry
+through 500-storms, content-CRC verification on read (a corrupted GET is
+detected and re-fetched), listing pagination, manifest-gated backup
+listing, and the retention reachability proof run generatively over
+random chain shapes. The slow test is the acceptance path: a real
+full + incremental capture of a live cluster through the object store —
+with faults on — restored onto a differently sized cluster.
+"""
+
+import json
+import random
+
+import pytest
+
+from pilosa_tpu.backup import (
+    ArchiveStore,
+    BackupError,
+    BackupWriter,
+    LocalDirArchive,
+    ObjectArchiveStore,
+    RestoreJob,
+    new_backup_id,
+    open_archive,
+    parse_archive_url,
+    plan_prune,
+    preflight_restore,
+    prune_archive,
+    resolve_files,
+    verify_archive,
+)
+from pilosa_tpu.backup.archive import file_crc
+from pilosa_tpu.backup.faults import FakeObjectServer, FaultyArchive
+from pilosa_tpu.obs.stats import MemoryStats
+
+
+@pytest.fixture
+def objsrv():
+    srv = FakeObjectServer(seed=7)
+    yield srv
+    srv.close()
+
+
+def _store(srv, **kw) -> ObjectArchiveStore:
+    kw.setdefault("rng", random.Random(3))
+    return ObjectArchiveStore(srv.url(bucket="b"), **kw)
+
+
+# ---------------------------------------------------------------------------
+# URL parsing + factory dispatch
+# ---------------------------------------------------------------------------
+
+
+def test_parse_archive_url():
+    scheme, host, port, bucket, prefix = parse_archive_url(
+        "http://127.0.0.1:9000/bucket")
+    assert (scheme, host, port, bucket, prefix) == \
+        ("http", "127.0.0.1", 9000, "bucket", "")
+    _, _, port, bucket, prefix = parse_archive_url(
+        "https://s3.local/b/pre/fix/")
+    assert (port, bucket, prefix) == (443, "b", "pre/fix/")
+    with pytest.raises(BackupError):
+        parse_archive_url("http://hostonly")   # no bucket
+
+
+def test_open_archive_dispatch(tmp_path, objsrv):
+    local = open_archive(str(tmp_path / "a"))
+    assert isinstance(local, LocalDirArchive)
+    assert isinstance(open_archive(f"file://{tmp_path}/b"), LocalDirArchive)
+    # an ArchiveStore instance passes through untouched
+    assert open_archive(local) is local
+    obj = open_archive(objsrv.url())
+    assert isinstance(obj, ObjectArchiveStore)
+    obj.close()
+    with pytest.raises(BackupError):
+        open_archive("")
+
+
+# ---------------------------------------------------------------------------
+# wire discipline under faults
+# ---------------------------------------------------------------------------
+
+
+def test_objstore_roundtrip_and_manifest_gate(objsrv):
+    a = _store(objsrv)
+    bid = new_backup_id("full")
+    a.write(bid, "data/i/f/standard/0.snap", b"payload")
+    assert a.read(bid, "data/i/f/standard/0.snap") == b"payload"
+    assert a.exists(bid, "data/i/f/standard/0.snap")
+    assert not a.exists(bid, "nope")
+    assert a.list_backups() == []          # manifest-written-last gate
+    a.write_manifest(bid, {"format": 1, "id": bid, "files": []})
+    assert a.list_backups() == [bid]
+    assert a.read_manifest(bid)["id"] == bid
+    a.delete(bid, "data/i/f/standard/0.snap")
+    assert not a.exists(bid, "data/i/f/standard/0.snap")
+    a.delete(bid, "data/i/f/standard/0.snap")   # missing is not an error
+    a.close()
+
+
+def test_objstore_traversal_guard(objsrv):
+    a = _store(objsrv)
+    with pytest.raises(BackupError):
+        a.write("bid/../../etc", "x", b"d")
+    with pytest.raises(BackupError):
+        a.read(new_backup_id("full"), "../escape")
+    a.close()
+
+
+def test_objstore_retries_through_error_storm(objsrv):
+    stats = MemoryStats()
+    a = _store(objsrv, stats=stats, attempts=8)
+    objsrv.fail_rate = 0.3
+    objsrv.error_burst(3, status=500)
+    bid = new_backup_id("full")
+    for i in range(6):
+        a.write(bid, f"f{i}", bytes([i]) * 64)
+    for i in range(6):
+        assert a.read(bid, f"f{i}") == bytes([i]) * 64
+    assert objsrv.injected > 0
+    assert stats.counter_value("archive.retries") >= objsrv.injected
+    assert stats.counter_value("archive.bytesOut") >= 6 * 64
+    a.close()
+
+
+def test_objstore_gives_up_after_bounded_attempts(objsrv):
+    a = _store(objsrv, attempts=2)
+    objsrv.error_burst(50, status=503)
+    with pytest.raises(BackupError):
+        a.write(new_backup_id("full"), "f", b"d")
+    a.close()
+
+
+def test_objstore_torn_upload_is_never_listed(objsrv):
+    """A PUT whose connection dies mid-body leaves a half-object at a
+    tmp key only; the retry overwrites that same tmp key and the
+    finalize copy publishes whole bytes. No ``.tmp-`` junk survives in
+    listings and no torn object is ever readable."""
+    a = _store(objsrv)
+    bid = new_backup_id("full")
+    objsrv.torn_next_put = 1
+    data = b"x" * 4096
+    a.write(bid, "big.snap", data)
+    assert objsrv.torn == 1
+    assert a.read(bid, "big.snap") == data
+    a.write_manifest(bid, {"format": 1, "id": bid, "files": []})
+    assert a.list_backups() == [bid]
+    with objsrv.lock:
+        assert not [k for k in objsrv.objects if ".tmp-" in k]
+    a.close()
+
+
+def test_objstore_corrupt_read_detected_and_refetched(objsrv):
+    a = _store(objsrv)
+    bid = new_backup_id("full")
+    a.write(bid, "f.snap", b"precious bytes")
+    objsrv.corrupt_next_get = 1
+    # first GET serves flipped bytes under a stale CRC; the store must
+    # reject it and re-fetch rather than hand damage to a restore
+    assert a.read(bid, "f.snap") == b"precious bytes"
+    a.close()
+
+
+def test_objstore_listing_pagination(objsrv):
+    a = _store(objsrv)
+    objsrv.max_keys_page = 3
+    bids = []
+    for _ in range(5):
+        bid = new_backup_id("full")
+        a.write(bid, "payload", b"p")
+        a.write_manifest(bid, {"format": 1, "id": bid, "files": []})
+        bids.append(bid)
+    assert sorted(a.list_backups()) == sorted(bids)
+    a.close()
+
+
+def test_objstore_delete_backup_removes_every_object(objsrv):
+    a = _store(objsrv)
+    bid = new_backup_id("full")
+    for i in range(4):
+        a.write(bid, f"data/f{i}", b"d")
+    a.write_manifest(bid, {"format": 1, "id": bid, "files": []})
+    keep = new_backup_id("full")
+    a.write(keep, "data/f0", b"k")
+    a.write_manifest(keep, {"format": 1, "id": keep, "files": []})
+    a.delete_backup(bid)
+    assert a.list_backups() == [keep]
+    assert not a.has_manifest(bid)
+    for i in range(4):
+        assert not a.exists(bid, f"data/f{i}")
+    assert a.exists(keep, "data/f0")
+    a.close()
+
+
+def test_faulty_archive_wrapper(tmp_path):
+    inner = LocalDirArchive(str(tmp_path / "a"))
+    fa = FaultyArchive(inner, seed=1)
+    assert isinstance(fa, ArchiveStore)
+    fa.fail_next_ops = 2
+    with pytest.raises(BackupError):
+        fa.write("b", "f", b"x")
+    with pytest.raises(BackupError):
+        fa.list_backups()
+    assert fa.faults_injected == 2
+    fa.write("b", "f", b"x")               # burst exhausted: passes through
+    assert fa.read("b", "f") == b"x"
+
+
+# ---------------------------------------------------------------------------
+# retention: generative reachability proof
+# ---------------------------------------------------------------------------
+
+
+def _synthetic_archive(tmp_path, rng: random.Random, n_chains: int):
+    """Random full+incremental chains whose incrementals reference
+    ancestor payloads via ``stored_in`` — the shapes retention must
+    reason about."""
+    arch = LocalDirArchive(str(tmp_path / "arch"))
+    created = 1_000.0
+    for c in range(n_chains):
+        parent = None
+        parent_files: dict[str, dict] = {}
+        for depth in range(1 + rng.randrange(3)):
+            bid = f"{2000 + c:04d}{depth}-{'full' if parent is None else 'incremental'}-x{c}{depth}"
+            files = []
+            # carry forward a random subset of the parent's files as refs
+            for path, e in parent_files.items():
+                if rng.random() < 0.7:
+                    files.append({"path": path, "kind": "snap",
+                                  "crc": e["crc"],
+                                  "stored_in": e["stored_in"]})
+            data = bytes([c, depth]) * 8
+            path = f"data/i/f/standard/{depth}.snap"
+            arch.write(bid, path, data)
+            files.append({"path": path, "kind": "snap",
+                          "crc": file_crc(data)})
+            created += 1.0
+            arch.write_manifest(bid, {
+                "format": 1, "id": bid, "parent": parent,
+                "kind": "full" if parent is None else "incremental",
+                "created": created, "epochs": {}, "schema": {},
+                "files": files})
+            parent = bid
+            parent_files = resolve_files(arch.read_manifest(bid))
+    return arch
+
+
+@pytest.mark.parametrize("seed", [1, 7, 23])
+def test_retention_never_prunes_reachable_generative(tmp_path, seed):
+    rng = random.Random(seed)
+    arch = _synthetic_archive(tmp_path, rng, n_chains=4)
+    keep = 1 + rng.randrange(3)
+    plan = plan_prune(arch, keep)
+    # the proof: no victim is reachable from any survivor's refs
+    referenced = set()
+    for bid in plan["survivors"]:
+        for e in resolve_files(arch.read_manifest(bid)).values():
+            referenced.add(e["stored_in"])
+    assert not (set(plan["victims"]) & referenced)
+    summary = prune_archive(arch, keep)
+    assert summary["aborted"] is None
+    # the invariant retention exists for: everything still listed is
+    # fully restorable, right now
+    for bid in arch.list_backups():
+        preflight_restore(arch, arch.read_manifest(bid))
+    assert len({bid for bid in plan["survivors"]}
+               & set(arch.list_backups())) == len(plan["survivors"])
+
+
+def test_prune_aborts_when_a_survivor_is_damaged(tmp_path):
+    rng = random.Random(5)
+    arch = _synthetic_archive(tmp_path, rng, n_chains=3)
+    plan = plan_prune(arch, 1)
+    assert plan["victims"]
+    # damage one survivor's payload: prune must abort, deleting nothing
+    victim_entry = None
+    for bid in plan["survivors"]:
+        for e in resolve_files(arch.read_manifest(bid)).values():
+            victim_entry = e
+            break
+        break
+    arch.delete(victim_entry["stored_in"], victim_entry["path"])
+    before = set(arch.list_backups())
+    summary = prune_archive(arch, 1)
+    assert summary["aborted"] is not None
+    assert summary["pruned"] == 0
+    assert set(arch.list_backups()) == before
+
+
+def test_prune_journal_replay_sweeps_crashed_prune(tmp_path):
+    from pilosa_tpu.backup.retention import JOURNAL_ID, JOURNAL_NAME
+    arch = LocalDirArchive(str(tmp_path / "arch"))
+    dead = new_backup_id("full")
+    arch.write(dead, "payload", b"orphaned")
+    # a crash mid-prune: victims journaled, manifest already deleted,
+    # payloads still on disk
+    arch.write(JOURNAL_ID, JOURNAL_NAME, json.dumps(
+        {"state": "pruning", "victims": [dead], "keep": []}).encode())
+    live = new_backup_id("full")
+    data = b"alive"
+    arch.write(live, "data/f0", data)
+    arch.write_manifest(live, {
+        "format": 1, "id": live, "parent": None, "created": 2.0,
+        "files": [{"path": "data/f0", "kind": "snap",
+                   "crc": file_crc(data)}]})
+    summary = prune_archive(arch, 1)
+    assert summary["resumed"] == 1
+    assert not arch.exists(dead, "payload")
+    assert not arch.exists(JOURNAL_ID, JOURNAL_NAME)
+    assert arch.list_backups() == [live]
+
+
+# ---------------------------------------------------------------------------
+# slow: the acceptance path through a real cluster
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_full_incremental_roundtrip_through_object_store(tmp_path, objsrv):
+    from pilosa_tpu.cluster.harness import LocalCluster
+    from tests.test_backup import _close_stores, _counts, _seed
+
+    objsrv.fail_rate = 0.1   # the storm is on for the whole round trip
+    stats = MemoryStats()
+    archive = ObjectArchiveStore(objsrv.url(bucket="b"), stats=stats,
+                                 attempts=8, rng=random.Random(11))
+    dirs = [str(tmp_path / f"n{i}") for i in range(2)]
+    lc = LocalCluster(2, replica_n=1, data_dirs=dirs)
+    try:
+        _seed(lc, n_cols=1_500_000, step=37_717)
+        n0 = lc[0]
+        full = BackupWriter(n0.holder, n0.cluster, lc.client, n0.store,
+                            archive).run()
+        for c in range(0, 200_000, 13_007):
+            lc.query("i", f"Set({c + 3}, f={(c + 3) % 7})")
+        incr = BackupWriter(n0.holder, n0.cluster, lc.client, n0.store,
+                            archive).run(parent=full["id"])
+        assert incr["kind"] == "incremental"
+        expect = _counts(lc)
+    finally:
+        _close_stores(lc)
+
+    res = verify_archive(archive)
+    assert res["ok"], res["problems"]
+
+    dirs3 = [str(tmp_path / f"r{i}") for i in range(3)]
+    lc3 = LocalCluster(3, replica_n=2, data_dirs=dirs3)
+    try:
+        n = lc3[0]
+        RestoreJob(n.holder, n.cluster, lc3.client, archive,
+                   incr["id"], store=n.store).run()
+        assert _counts(lc3) == expect
+    finally:
+        _close_stores(lc3)
+    assert stats.counter_value("archive.retries") > 0
+    archive.close()
