@@ -950,6 +950,79 @@ def bench_bsi(extra):
 
 
 # ---------------------------------------------------------------------------
+# config 3b: approximate analytics (HLL distinct + SimilarTopN)
+# ---------------------------------------------------------------------------
+
+
+def bench_sketch(extra):
+    """Sketch vs exact A/B (pilosa_tpu/sketch).
+
+    Two series: Count(Distinct(...)) through the fused register path
+    against its own exact fallback, and SimilarTopN against the
+    equivalent client-side loop of N Count(Intersect(...)) queries —
+    the one-dispatch claim is asserted against the planner's raw
+    counter, not inferred from latency."""
+    from pilosa_tpu.config import SHARD_WIDTH
+    from pilosa_tpu.core import FieldOptions, Holder
+    from pilosa_tpu.core.field import FIELD_TYPE_INT
+    from pilosa_tpu.exec import Executor
+    from pilosa_tpu.parallel import MeshPlanner, make_mesh
+
+    cols = 16 * SHARD_WIDTH
+    n_vals = 2_000_000
+    n_rows = 256
+    rng = np.random.default_rng(29)
+
+    h = Holder()
+    idx = h.create_index("sk")
+    v = idx.create_field("v", FieldOptions(type=FIELD_TYPE_INT,
+                                           min=0, max=10_000_000))
+    f = idx.create_field("f")
+    vc = rng.choice(cols, n_vals, replace=False).astype(np.uint64)
+    v.import_values(vc, rng.integers(0, 10_000_000, n_vals))
+    f.import_bits(rng.integers(0, n_rows, n_vals, dtype=np.uint64),
+                  rng.integers(0, cols, n_vals, dtype=np.uint64))
+
+    planner = MeshPlanner(h, make_mesh())
+    ex = Executor(h, planner=planner, result_cache=False)
+
+    sketch_q = "Count(Distinct(field=v, threshold=0))"
+    exact_q = "Count(Distinct(field=v, threshold=100000000))"
+    (est,) = ex.execute("sk", sketch_q)          # warm/compile
+    (true,) = ex.execute("sk", exact_q)
+    extra["sketch_distinct_rel_err"] = round(abs(est - true) / true, 4)
+    d0 = planner.dispatches
+    qps, p50, _ = _timer(lambda: ex.execute("sk", sketch_q), N_LAT)
+    assert (planner.dispatches - d0) == N_LAT, \
+        "fused distinct must cost exactly one dispatch per query"
+    extra["sketch_distinct_qps"] = round(qps, 1)
+    extra["sketch_distinct_p50_ms"] = round(p50, 3)
+    _, p50e, _ = _timer(lambda: ex.execute("sk", exact_q),
+                        max(3, N_LAT // 5))
+    extra["sketch_distinct_exact_p50_ms"] = round(p50e, 3)
+
+    sim_q = "SimilarTopN(f, Row(f=7), n=10)"
+    ex.execute("sk", sim_q)                      # warm/compile
+    d0 = planner.dispatches
+    qps, p50, _ = _timer(lambda: ex.execute("sk", sim_q),
+                         max(5, N_LAT // 3))
+    assert (planner.dispatches - d0) == max(5, N_LAT // 3), \
+        "fused SimilarTopN must cost exactly one dispatch per query"
+    extra["sketch_simtopn_p50_ms"] = round(p50, 3)
+    extra["sketch_simtopn_qps"] = round(qps, 1)
+
+    # the pre-sketch spelling: one Count(Intersect(...)) per candidate
+    # row from the client — N round trips instead of one dispatch.
+    def loop():
+        for rid in range(0, n_rows, 8):   # 32 of 256 rows: a LOWER bound
+            ex.execute("sk", f"Count(Intersect(Row(f=7), Row(f={rid})))")
+    loop()                                       # warm/compile
+    _, p50l, _ = _timer(loop, 3)
+    extra["sketch_simtopn_loop32_p50_ms"] = round(p50l, 3)
+    planner.close()
+
+
+# ---------------------------------------------------------------------------
 # config 3c: dispatch fusion + same-plan coalescing (one launch per query)
 # ---------------------------------------------------------------------------
 
@@ -1684,9 +1757,9 @@ def main() -> None:
 
     want = (set(c.strip() for c in CONFIGS.split(","))
             if CONFIGS != "all"
-            else {"star", "topn", "bsi", "dispatch", "ingest", "time",
-                  "cluster", "cache", "oversub", "backup", "overload",
-                  "obs", "elastic"})
+            else {"star", "topn", "bsi", "sketch", "dispatch", "ingest",
+                  "time", "cluster", "cache", "oversub", "backup",
+                  "overload", "obs", "elastic"})
     extra: dict = {"backend": jax.default_backend(),
                    "devices": len(jax.devices())}
 
@@ -1718,6 +1791,7 @@ def main() -> None:
     if "star" in want:
         qps, cpu_qps = bench_star_trace(extra)
     for name, fn in (("topn", bench_topn), ("bsi", bench_bsi),
+                     ("sketch", bench_sketch),
                      ("dispatch", bench_dispatch),
                      ("ingest", bench_ingest),
                      ("time", bench_time), ("cluster", bench_cluster),

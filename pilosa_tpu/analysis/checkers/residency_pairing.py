@@ -19,7 +19,13 @@ Checked, per module that declares BOTH tables at top level:
 * every class appearing in a ``KERNELS`` key is declared in
   ``REPR_CLASSES`` — an undeclared class is unreachable by the
   planner's policy and its kernels are dead weight (usually a typo'd
-  constant).
+  constant);
+* no ``KERNELS`` entry maps to a literal ``None`` — a ``None`` stub
+  satisfies the pairing contract on paper while handing the planner a
+  non-callable, which converts the loud plan-time KeyError this rule
+  exists to prevent into a confusing TypeError deep inside a traced
+  program (the hll row grew this way: each sketch op must point at a
+  real kernel in pilosa_tpu/sketch/kernels.py, never a placeholder).
 """
 
 from __future__ import annotations
@@ -92,16 +98,28 @@ def check(mod: ModuleInfo, project: Mapping[str, ModuleInfo]) -> list[Finding]:
 
     # (class, op) pairs actually registered in the dispatch dict.
     table: dict[str, set[str]] = {}
+    stubs: list[tuple[str, str, int]] = []
     if isinstance(kernels_node.value, ast.Dict):
-        for key in kernels_node.value.keys:
+        for key, value in zip(kernels_node.value.keys,
+                              kernels_node.value.values):
             if not (isinstance(key, ast.Tuple) and len(key.elts) == 2):
                 continue
             klass = _resolve(key.elts[0], env)
             op = _resolve(key.elts[1], env)
             if klass is not None and op is not None:
                 table.setdefault(klass, set()).add(op)
+                if (isinstance(value, ast.Constant)
+                        and value.value is None):
+                    stubs.append((klass, op, value.lineno))
 
     findings: list[Finding] = []
+    for klass, op, lineno in stubs:
+        findings.append(Finding(
+            RULE, mod.path, lineno,
+            f"KERNELS entry ({klass!r}, {op!r}) maps to a literal None "
+            f"stub — it satisfies the pairing contract but dispatches a "
+            f"non-callable, turning the plan-time KeyError this rule "
+            f"prevents into a TypeError inside a traced program"))
     baseline = table.get(BASELINE_CLASS)
     if baseline:
         for klass in classes:

@@ -12,6 +12,13 @@ Three sub-checks over ``server/wire.py``:
    side reads must be passed by at least one decode-side constructor
    call — the exact shape of the ``Pair.key`` bug, where keyed TopN
    results lost their keys crossing the node boundary.
+4. Every envelope tag an encoder stamps (the constant under a ``"t"``
+   dict key — ``"hll"``, ``"hll_frame"``, ``"simpartial"``, …) must be
+   compared against by some decode-side function. Sub-check 2 can't
+   see this class of drop: the ``"t"`` *key* is read by every decoder,
+   but a tag *value* nobody dispatches on (the sketch register-blob
+   frames were the near-miss) means that result type decodes into a
+   raw dict and fails far from the codec.
 """
 
 from __future__ import annotations
@@ -83,6 +90,38 @@ def _read_keys(fns: list[ast.FunctionDef]) -> set[str]:
     return out
 
 
+def _encoded_tags(fns: list[ast.FunctionDef]) -> list[tuple[str, int]]:
+    """Constant strings stamped under a ``"t"`` dict key by encoders —
+    the envelope tags decode-side dispatch must cover."""
+    out: list[tuple[str, int]] = []
+    for fn in fns:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Dict):
+                continue
+            for k, v in zip(node.keys, node.values):
+                if k is None or const_str(k) != "t":
+                    continue
+                s = const_str(v)
+                if s is not None:
+                    out.append((s, v.lineno))
+    return out
+
+
+def _compared_strings(fns: list[ast.FunctionDef]) -> set[str]:
+    """Constant strings tested by ==/!= anywhere decode-side."""
+    out: set[str] = set()
+    for fn in fns:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Compare) and any(
+                    isinstance(op, (ast.Eq, ast.NotEq))
+                    for op in node.ops):
+                for operand in [node.left, *node.comparators]:
+                    s = const_str(operand)
+                    if s is not None:
+                        out.add(s)
+    return out
+
+
 def _dataclasses(mod: ModuleInfo) -> dict[str, list[str]]:
     """dataclass name -> ordered field names (AnnAssign order)."""
     out: dict[str, list[str]] = {}
@@ -141,6 +180,19 @@ def check(mod: ModuleInfo, project: Mapping[str, ModuleInfo]) -> list[Finding]:
                 RULE, mod.path, lineno,
                 f"encode-side key '{key}' is never read by any decode "
                 f"function — silently dropped at the far end"))
+
+    # 4. every stamped envelope tag must be dispatched on somewhere
+    # decode-side, else that result type arrives as an undecoded dict.
+    compared = _compared_strings(dec_fns)
+    seen_tags: set[str] = set()
+    for tag, lineno in _encoded_tags(enc_fns):
+        if tag not in compared and tag not in seen_tags:
+            seen_tags.add(tag)
+            findings.append(Finding(
+                RULE, mod.path, lineno,
+                f"envelope tag '{tag}' is stamped by an encoder but no "
+                f"decode function ever compares against it — that "
+                f"result type arrives as a raw dict"))
 
     # 3. dataclass field coverage: fields the encoders read must be
     # reconstructible on the decode side (the Pair.key class).

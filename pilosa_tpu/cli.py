@@ -152,6 +152,12 @@ _DEFAULTS = {
     # non-resident leaf stacks.
     "residency_packed": "auto",
     "prefetch": "on",
+    # Approximate analytics (pilosa_tpu/sketch): HLL precision for
+    # Count(Distinct(...)) — 2^p registers, ~1.04/sqrt(2^p) relative
+    # error — and the estimated cardinality below which the answer is
+    # computed exactly instead (0 disables the exact fallback).
+    "sketch_precision": 12,
+    "sketch_exact_threshold": 1024,
     # Per-query cost profiles: retain the slowest N at /debug/queries
     # (0 disables the ring). profile_queries=False limits profiling to
     # explicit ?profile=true requests.
@@ -278,6 +284,10 @@ def cmd_server(args) -> int:
         cfg["residency_packed"] = args.residency_packed
     if args.prefetch is not None:
         cfg["prefetch"] = args.prefetch
+    if args.sketch_precision is not None:
+        cfg["sketch_precision"] = args.sketch_precision
+    if args.sketch_exact_threshold is not None:
+        cfg["sketch_exact_threshold"] = args.sketch_exact_threshold
     if args.profile_ring is not None:
         cfg["profile_ring_n"] = args.profile_ring
     if args.profile_queries is not None:
@@ -340,6 +350,8 @@ def cmd_server(args) -> int:
         inline_transfer=str(cfg["inline_transfer"]) or "auto",
         residency_packed=str(cfg["residency_packed"]) or "auto",
         prefetch=str(cfg["prefetch"]) or "on",
+        sketch_precision=int(cfg["sketch_precision"]),
+        sketch_exact_threshold=int(cfg["sketch_exact_threshold"]),
         profile_ring_n=int(cfg["profile_ring_n"]),
         profile_queries=(str(cfg["profile_queries"]).lower()
                          in ("1", "true", "yes", "on")),
@@ -793,6 +805,11 @@ def cmd_generate_config(args) -> int:
           '# uploads for non-resident leaf stacks (on|off)\n'
           'residency-packed = "auto"\n'
           'prefetch = "on"\n'
+          '# approximate analytics: HLL precision for Count(Distinct)\n'
+          '# (2^p registers, ~1.04/sqrt(2^p) error) and the estimated\n'
+          '# cardinality below which the answer is computed exactly\n'
+          'sketch-precision = 12\n'
+          'sketch-exact-threshold = 1024\n'
           '# per-query cost profiles: slowest-N retention ring served\n'
           '# at /debug/queries (0 disables); profile-queries = false\n'
           '# limits profiling to explicit ?profile=true requests\n'
@@ -936,6 +953,14 @@ def main(argv: list[str] | None = None) -> int:
     s.add_argument("--prefetch", choices=("on", "off"), default=None,
                    help="upload non-resident leaf stacks asynchronously "
                         "ahead of query execution (default on)")
+    s.add_argument("--sketch-precision", type=int, default=None,
+                   help="HLL precision p for Count(Distinct(...)): 2^p "
+                        "registers, ~1.04/sqrt(2^p) relative error "
+                        "(default 12 = ~1.6%%; range 4..18)")
+    s.add_argument("--sketch-exact-threshold", type=int, default=None,
+                   help="answer Count(Distinct(...)) EXACTLY when the "
+                        "estimate falls below this cardinality "
+                        "(default 1024; 0 disables the fallback)")
     s.add_argument("--profile-ring", type=int, default=None,
                    help="retain the slowest N query cost profiles at "
                         "/debug/queries (default 64; 0 disables)")

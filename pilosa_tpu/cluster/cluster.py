@@ -497,13 +497,23 @@ class Cluster:
         # collect and fold ONCE at the end — on device, one batched
         # program — instead of a host union chain per completion.
         from pilosa_tpu.core.row import Row as _Row
+        from pilosa_tpu.sketch.hll import HLLSketch as _HLL
         row_accs: list = []
         defer_rows = getattr(reduce_fn, "reduce_kind", None) == "row_union"
+        # HLL register partials (Count(Distinct) legs) defer the same
+        # way: register-max is associative/commutative, so the deferred
+        # batch folds in ONE stacked np.max instead of a pairwise chain.
+        reg_accs: list = []
+        defer_regs = (getattr(reduce_fn, "reduce_kind", None)
+                      == "register_max")
 
         def fold(acc):
             nonlocal result
             if defer_rows and isinstance(acc, _Row):
                 row_accs.append(acc)
+                return
+            if defer_regs and isinstance(acc, _HLL):
+                reg_accs.append(acc)
                 return
             result = acc if result is None else reduce_fn(result, acc)
         # The fan-out pool's threads don't inherit contextvars; carry
@@ -761,6 +771,10 @@ class Cluster:
             # to the union chain this replaces.
             from pilosa_tpu.exec import device_reduce
             acc = device_reduce.union_rows(row_accs)
+            result = acc if result is None else reduce_fn(result, acc)
+        if reg_accs:
+            from pilosa_tpu.sketch.hll import merge_all
+            acc = merge_all(reg_accs)
             result = acc if result is None else reduce_fn(result, acc)
         return result
 

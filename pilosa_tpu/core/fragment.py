@@ -715,6 +715,7 @@ class Fragment:
     def set_value(self, column_id: int, bit_depth: int, value: int) -> bool:
         """Sign-magnitude BSI write (reference setValueBase fragment.go:939)."""
         with self._lock:
+            gen_before = self.generation
             changed = False
             changed |= self.set_bit(BSI_EXISTS_BIT, column_id)
             if value < 0:
@@ -727,6 +728,12 @@ class Fragment:
                     changed |= self.set_bit(BSI_OFFSET_BIT + i, column_id)
                 else:
                     changed |= self.clear_bit(BSI_OFFSET_BIT + i, column_id)
+            if changed and getattr(self, "_hll_planes", None):
+                from pilosa_tpu.sketch import store as sketch_store
+                sketch_store.observe_values(
+                    self, np.asarray([self._local(column_id)], dtype=np.int64),
+                    np.asarray([value], dtype=np.int64),
+                    gen_before, self.generation)
             return changed
 
     #: exists-plane cardinality below which value() keeps the per-bit
@@ -796,6 +803,12 @@ class Fragment:
             self.bulk_import_sorted_local(
                 np.full(len(cols), BSI_EXISTS_BIT, dtype=np.int64),
                 local_all[o], clear=True)
+            # A clear un-exists columns — not expressible as a plane
+            # point-overwrite, so drop the sketch state wholesale.
+            if (getattr(self, "_hll_planes", None)
+                    or getattr(self, "_hll_regs", None)):
+                from pilosa_tpu.sketch import store as sketch_store
+                sketch_store.invalidate(self)
             return
         vals = np.asarray(values, dtype=np.int64)
         # Keep the LAST occurrence of each duplicated column.
@@ -837,8 +850,15 @@ class Fragment:
             self.bulk_import_sorted_local(rows, local, clear=clear_flag)
 
         with self._lock:  # one atomic overwrite, clears before sets
+            gen_before = self.generation
             _run(clr_rows, clr_cols, True)
             _run(set_rows, set_cols, False)
+            if (self.generation != gen_before
+                    and getattr(self, "_hll_planes", None)):
+                from pilosa_tpu.sketch import store as sketch_store
+                sketch_store.observe_values(self, local_u.astype(np.int64),
+                                            vals_u, gen_before,
+                                            self.generation)
 
     def _import_values_device(self, local_u: np.ndarray, vals_u: np.ndarray,
                               bit_depth: int) -> None:
@@ -855,6 +875,7 @@ class Fragment:
         plane_ids = [BSI_EXISTS_BIT, BSI_SIGN_BIT] + list(
             range(BSI_OFFSET_BIT, BSI_OFFSET_BIT + bit_depth))
         with self._lock:
+            gen_before = self.generation
             added = removed = 0
             for j, rid in enumerate(plane_ids):
                 set_w = planes[j]
@@ -888,6 +909,12 @@ class Fragment:
                 if self.op_writer:
                     self._emit_value_wal(local_u, vals_u, bit_depth,
                                          removed, added)
+                if getattr(self, "_hll_planes", None):
+                    from pilosa_tpu.sketch import store as sketch_store
+                    sketch_store.observe_values(self,
+                                                local_u.astype(np.int64),
+                                                vals_u, gen_before,
+                                                self.generation)
 
     def _emit_value_wal(self, local_u: np.ndarray, vals_u: np.ndarray,
                         bit_depth: int, removed: int, added: int) -> None:

@@ -30,7 +30,7 @@ from typing import Any, Callable, Iterable
 import numpy as np
 
 from pilosa_tpu.cache.tenant import current_tenant
-from pilosa_tpu.config import SHARD_WIDTH
+from pilosa_tpu.config import SHARD_WIDTH, WORDS_PER_SHARD
 from pilosa_tpu.core import timequantum as tq
 from pilosa_tpu.core.field import FIELD_TYPE_BOOL, FIELD_TYPE_INT, FIELD_TYPE_TIME
 from pilosa_tpu.core.holder import Holder
@@ -45,6 +45,10 @@ from pilosa_tpu.errors import (
 )
 from pilosa_tpu.exec import fuse as _fuse
 from pilosa_tpu.obs import profile as _profile
+from pilosa_tpu.ops import bitops
+from pilosa_tpu import sketch as _sketch
+from pilosa_tpu.sketch import hll as _hll
+from pilosa_tpu.sketch import store as sketch_store
 from pilosa_tpu.exec.result import (
     FieldRow,
     GroupCount,
@@ -522,6 +526,16 @@ class Executor:
             return self._execute_group_by(idx, c, shards, opt)
         if name == "Options":
             return self._execute_options(idx, c, shards, opt)
+        if name == "Distinct":
+            # Bare Distinct() has no client-facing result shape — it is
+            # the map half of Count(Distinct(...)), which intercepts it
+            # in _execute_count. Remotes DO execute it bare (the
+            # coordinator ships the inner call) and return partials.
+            if not opt.remote:
+                raise QueryError("Distinct() must be wrapped in Count()")
+            return self._execute_distinct(idx, c, shards, opt)
+        if name == "SimilarTopN":
+            return self._execute_similar_top_n(idx, c, shards, opt)
         if name in _BITMAP_CALLS:
             return self._execute_bitmap_call(idx, c, shards, opt)
         raise QueryError(f"unknown call: {name}")
@@ -927,6 +941,8 @@ class Executor:
     def _execute_count(self, idx: Index, c: Call, shards, opt) -> int:
         if len(c.children) != 1:
             raise QueryError("Count() requires a single bitmap input")
+        if c.children[0].name == "Distinct":
+            return self._execute_distinct(idx, c.children[0], shards, opt)
 
         planner = self._planner_for(c.children[0], opt)
 
@@ -948,6 +964,224 @@ class Executor:
         return self.map_reduce(idx, shards, c, opt, map_fn,
                                lambda p, v: (p or 0) + v,
                                local_batch_fn=local_batch) or 0
+
+    # ------------------------------------------------------------------
+    # approximate analytics (pilosa_tpu/sketch)
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _row_words_for(filt: Row | None, shard: int) -> np.ndarray | None:
+        """A filter Row's [W] uint32 word plane for one shard. None for
+        "no filter" (distinct from a filter that matched nothing, which
+        is an all-zero plane)."""
+        if filt is None:
+            return None
+        seg = filt.segments.get(shard)
+        if seg is None:
+            return np.zeros(WORDS_PER_SHARD, dtype=np.uint32)
+        return np.asarray(seg, dtype=np.uint32)
+
+    def _execute_distinct(self, idx: Index, c: Call, shards, opt) -> Any:
+        """Count(Distinct(filter?, field=f)): HLL estimate over the
+        field's register planes, fused to one device dispatch per node
+        by the planner, with an EXACT per-shard-unique fallback when
+        the estimate lands under the threshold (where relative HLL
+        error is most visible and exact is cheapest).
+
+        The coordinator pins the resolved precision/threshold into the
+        shipped call so every node sketches at the same precision, and
+        remotes (opt.remote) return the raw partial — HLLSketch on the
+        sketch leg, DistinctValues on the exact leg — which rides the
+        cluster aggregate wire and folds as register-max / set-union.
+        """
+        field_name, ok = c.string_arg("field")
+        if not ok:
+            raise QueryError("Distinct(): field required")
+        f = idx.field(field_name)
+        if f is None:
+            raise FieldNotFoundError(field_name)
+        if f.bsi_group is None:
+            raise QueryError(
+                f"Distinct(): field {field_name!r} has no BSI data "
+                "(int field required)")
+        if len(c.children) > 1:
+            raise QueryError("Distinct() only accepts a single bitmap input")
+        depth = f.bsi_group.bit_depth
+
+        p, has_p = c.uint_arg("precision")
+        p = _sketch.validate_precision(p) if has_p else _sketch.precision()
+        thr, has_thr = c.uint_arg("threshold")
+        if not has_thr:
+            thr = _sketch.exact_threshold()
+
+        cc = c.clone()
+        cc.args["precision"] = int(p)
+        cc.args["threshold"] = int(thr)
+
+        if cc.args.get("exact"):
+            part = self._distinct_exact(idx, cc, shards, opt, f, depth)
+            return part if opt.remote else int(len(part.values))
+
+        def map_fn(shard):
+            _, frag = self._bsi_fragment(idx, field_name, shard)
+            if frag is None:
+                return _hll.HLLSketch.empty(p)
+            filt = self._agg_filter(idx, cc, shard)
+            fw = self._row_words_for(filt, shard)
+            return sketch_store.shard_sketch(frag, depth, p, fw)
+
+        def reduce_fn(prev, v):
+            return v if prev is None else prev.merge(v)
+
+        # The cluster layer defers sketch legs and folds them in one
+        # stacked register-max when it sees this tag (mirror of the
+        # "row_union" deferred fold in _execute_bitmap_call).
+        reduce_fn.reduce_kind = "register_max"
+
+        local_batch = None
+        if (self.planner is not None
+                and getattr(self.planner, "sketch_supported", False)
+                and self.planner.supports_distinct(idx, cc)):
+            def local_batch(shs):
+                regs = self.planner.execute_distinct_registers(
+                    idx, cc, list(shs), p)
+                return _hll.HLLSketch(p=p, regs=regs)
+
+        sk = self.map_reduce(idx, shards, cc, opt, map_fn, reduce_fn,
+                             local_batch_fn=local_batch)
+        sk = sk or _hll.HLLSketch.empty(p)
+        if opt.remote:
+            return sk
+        est = sk.estimate()
+        if thr and est < thr:
+            ec = cc.clone()
+            ec.args["exact"] = True
+            part = self._distinct_exact(idx, ec, shards, opt, f, depth)
+            return int(len(part.values))
+        return int(round(est))
+
+    def _distinct_exact(self, idx: Index, c: Call, shards, opt, f,
+                        depth: int) -> "_hll.DistinctValues":
+        """Exact leg: per-shard sorted unique values, host union fold.
+        Runs through map_reduce so remote nodes produce DistinctValues
+        partials over their own shards."""
+        base = np.int64(f.bsi_group.base)
+
+        def map_fn(shard):
+            _, frag = self._bsi_fragment(idx, f.name, shard)
+            if frag is None:
+                return _hll.DistinctValues.empty()
+            filt = self._agg_filter(idx, c, shard)
+            fw = self._row_words_for(filt, shard)
+            vals = sketch_store.shard_distinct(frag, depth, fw)
+            return _hll.DistinctValues(values=vals + base)
+
+        def reduce_fn(prev, v):
+            return v if prev is None else prev.merge(v)
+
+        part = self.map_reduce(idx, shards, c, opt, map_fn, reduce_fn)
+        return part or _hll.DistinctValues.empty()
+
+    def _execute_similar_top_n(self, idx: Index, c: Call, shards,
+                               opt) -> Any:
+        """SimilarTopN(f, Row(...), n=, metric=): Jaccard/overlap of
+        the filter row against EVERY row of the field, one fused device
+        dispatch per node (row cube ∧ filter popcounts + device top-k).
+        Returns the TopN pair shape: Pair(id=row, count=overlap),
+        best-score-first."""
+        field_name = c.args.get("_field")
+        if not field_name:
+            raise QueryError("SimilarTopN(): field required")
+        f = idx.field(field_name)
+        if f is None:
+            raise FieldNotFoundError(field_name)
+        if f.field_type == FIELD_TYPE_INT:
+            raise QueryError("SimilarTopN(): set field required")
+        if len(c.children) != 1:
+            raise QueryError("SimilarTopN() requires a single bitmap input")
+        n, has_n = c.uint_arg("n")
+        if not has_n or not n:
+            n = _sketch.DEFAULT_SIMILAR_N
+        metric, has_m = c.string_arg("metric")
+        if not has_m:
+            metric = "jaccard"
+        if metric not in ("jaccard", "overlap"):
+            raise QueryError(f"SimilarTopN(): unknown metric {metric!r}")
+
+        cc = c.clone()
+        cc.args["n"] = int(n)
+        cc.args["metric"] = metric
+        filter_call = cc.children[0]
+
+        def map_fn(shard):
+            return self._similar_shard(idx, field_name, filter_call, shard)
+
+        def reduce_fn(prev, v):
+            return v if prev is None else prev.merge(v)
+
+        local_batch = None
+        if (self.planner is not None
+                and getattr(self.planner, "sketch_supported", False)
+                and self.planner.supports_similar(idx, field_name,
+                                                  filter_call)):
+            def local_batch(shs):
+                shs = list(shs)
+                row_ids = self._field_row_ids(idx, field_name, shs)
+                res = self.planner.execute_similar(
+                    idx, field_name, filter_call, row_ids, shs)
+                if res is None:
+                    # Cube over the HBM gate — host per-shard fold.
+                    acc = None
+                    for shard in shs:
+                        acc = reduce_fn(acc, map_fn(shard))
+                    return acc or _hll.SimPartial.empty()
+                ids, inter, selfc, filtc, order = res
+                return _hll.SimPartial(ids=ids, overlap=inter,
+                                       selfcnt=selfc, filtcnt=filtc,
+                                       order=order)
+
+        part = self.map_reduce(idx, shards, cc, opt, map_fn, reduce_fn,
+                               local_batch_fn=local_batch)
+        part = part or _hll.SimPartial.empty()
+        if opt.remote:
+            return part
+        return [Pair(id=rid, count=cnt)
+                for rid, cnt, _score in part.top_pairs(n, metric)]
+
+    def _similar_shard(self, idx: Index, field_name: str,
+                       filter_call: Call, shard: int) -> "_hll.SimPartial":
+        """Host oracle / remote map half: one shard's overlap and
+        cardinality totals for every row of the field."""
+        frag = self.holder.fragment(idx.name, field_name, VIEW_STANDARD,
+                                    shard)
+        if frag is None:
+            return _hll.SimPartial.empty()
+        filt = self._bitmap_call_shard(idx, filter_call, shard)
+        fw = self._row_words_for(filt, shard)
+        if fw is None:
+            fw = np.zeros(WORDS_PER_SHARD, dtype=np.uint32)
+        rids = list(frag.row_ids())
+        ids = np.asarray(rids, dtype=np.uint64)
+        overlap = np.zeros(len(rids), dtype=np.int64)
+        selfcnt = np.zeros(len(rids), dtype=np.int64)
+        for i, rid in enumerate(rids):
+            words = frag.row_words(rid)
+            overlap[i] = bitops.np_count(words & fw)
+            selfcnt[i] = bitops.np_count(words)
+        return _hll.SimPartial(ids=ids, overlap=overlap, selfcnt=selfcnt,
+                               filtcnt=int(bitops.np_count(fw)))
+
+    def _field_row_ids(self, idx: Index, field_name: str,
+                       shards) -> list[int]:
+        """Sorted union of the field's row ids over the given shards —
+        the id-ascending candidate universe the similarity cube stacks."""
+        ids: set[int] = set()
+        for shard in shards:
+            frag = self.holder.fragment(idx.name, field_name,
+                                        VIEW_STANDARD, shard)
+            if frag is not None:
+                ids.update(int(r) for r in frag.row_ids())
+        return sorted(ids)
 
     # ------------------------------------------------------------------
     # TopN (reference executor.go:857 two-pass)

@@ -48,6 +48,7 @@ import jax.numpy as jnp
 
 from pilosa_tpu.config import SHARD_WIDTH, WORDS_PER_SHARD
 from pilosa_tpu.ops import bitops
+from pilosa_tpu.sketch import kernels as sketch_kernels
 
 _MODES = ("on", "off", "auto")
 _default_mode = "auto"
@@ -56,7 +57,11 @@ _default_mode = "auto"
 #: residency-pairing checker pairs against KERNELS below.
 DENSE = "dense"
 PACKED = "packed"
-REPR_CLASSES = (DENSE, PACKED)
+#: HLL register planes (pilosa_tpu/sketch): [S, 2^p] uint8 register
+#: stacks plus packed [S, C] bucket|rho column planes for the filtered
+#: distinct path — Count(Distinct(...)) never materializes a row set.
+HLL = "hll"
+REPR_CLASSES = (DENSE, PACKED, HLL)
 
 #: padding value for packed index stacks: one past the last valid
 #: in-shard column. Chosen so ``idx >> 5`` lands exactly on the trash
@@ -213,6 +218,10 @@ KERNELS = {
     (PACKED, "count"): packed_count,
     (PACKED, "and_count"): packed_and_dense_count,
     (PACKED, "pair_count"): packed_pair_count,
+    (HLL, "expand"): sketch_kernels.hll_expand,
+    (HLL, "count"): sketch_kernels.hll_count,
+    (HLL, "and_count"): sketch_kernels.hll_and_count,
+    (HLL, "pair_count"): sketch_kernels.hll_pair_count,
 }
 
 

@@ -78,6 +78,13 @@ def _leg_query(leg, rank: int, rng: np.random.Generator,
         if rank % 2:
             return INDEX, f"TopN(f, Row(f={rank % n_rows}), n=10)"
         return INDEX, "TopN(f, n=10)"
+    if leg.kind == "distinct":
+        if rank % 2:
+            return INDEX, (f"Count(Distinct(Row(f={rank % n_rows}), "
+                           "field=v))")
+        return INDEX, "Count(Distinct(field=v))"
+    if leg.kind == "similar":
+        return INDEX, f"SimilarTopN(f, Row(f={rank % n_rows}), n=10)"
     # keyed
     return INDEX_KEYED, f'Count(Row(kf="k{rank % leg.population}"))'
 

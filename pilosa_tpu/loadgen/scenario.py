@@ -11,7 +11,8 @@ from __future__ import annotations
 from dataclasses import asdict, dataclass, field
 
 #: query-leg kinds the engine knows how to drive
-LEG_KINDS = ("dashboard", "adhoc", "bsi", "topn", "keyed")
+LEG_KINDS = ("dashboard", "adhoc", "bsi", "topn", "keyed", "distinct",
+             "similar")
 
 
 @dataclass
@@ -26,6 +27,10 @@ class QueryLeg:
     - ``topn``: TopN ranking, optionally filtered.
     - ``keyed``: string-keyed Count/Row queries (exercises key
       translation on the hot path).
+    - ``distinct``: Count(Distinct(...)) over the int field — the HLL
+      sketch planes (filtered and unfiltered spellings).
+    - ``similar``: SimilarTopN row-similarity ranking over the set
+      field.
     """
 
     name: str

@@ -29,6 +29,10 @@ def _mixed_legs(keyed: bool = True) -> list[QueryLeg]:
                  qos_class="batch", population=16, zipf_s=1.0),
         QueryLeg(name="topn", weight=1.0, kind="topn",
                  qos_class="interactive", population=8, zipf_s=1.0),
+        QueryLeg(name="distinct", weight=1.0, kind="distinct",
+                 qos_class="batch", population=16, zipf_s=1.0),
+        QueryLeg(name="similar", weight=1.0, kind="similar",
+                 qos_class="interactive", population=8, zipf_s=1.0),
     ]
     if keyed:
         legs.append(QueryLeg(name="keyed", weight=1.0, kind="keyed",

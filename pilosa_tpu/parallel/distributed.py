@@ -148,6 +148,10 @@ class DistributedMeshPlanner(MeshPlanner):
         # order every other process expects. Both stay off here.
         self.residency_packed_supported = False
         self.prefetch_supported = False
+        # Sketch stacks (hll planes / simtopn cubes) assemble host-side
+        # on one node; the distributed mesh falls back to the executor's
+        # per-shard map + register-max reduce instead.
+        self.sketch_supported = False
         self._pid = jax.process_index()
         flat = list(self.mesh.devices.reshape(-1))
         #: (device, global mesh position) for this process's devices.

@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from pilosa_tpu.config import WORDS_PER_SHARD
+from pilosa_tpu.config import SHARD_WIDTH, WORDS_PER_SHARD
 from pilosa_tpu.core import timequantum as tq
 from pilosa_tpu.core.index import Index
 from pilosa_tpu.core.row import Row
@@ -190,6 +190,12 @@ class MeshPlanner:
         #: schedules async uploads here; _stack_rows rendezvouses with
         #: inflight uploads instead of re-building (parallel.prefetch).
         self.prefetcher = ResidencyPrefetcher(self, stats=stats)
+        #: fused sketch programs (pilosa_tpu.sketch): HLL distinct-count
+        #: register planes and the SimilarTopN row-cube ranking; off for
+        #: the distributed planner — its per-process stack assembly has
+        #: no hll/simtopn build path yet, and the host map/reduce spine
+        #: (register-max partials over the wire) covers it instead.
+        self.sketch_supported = True
 
     # ------------------------------------------------------------------
     # public API
@@ -637,6 +643,180 @@ class MeshPlanner:
                                  n_shards, is_min)
 
         return self.batcher.submit(cons_cnt, fold)
+
+    # ------------------------------------------------------------------
+    # approximate analytics (pilosa_tpu.sketch): Count(Distinct) as ONE
+    # fused program — filter tree → masked register gather → segment-max
+    # — and SimilarTopN as ONE program over the field's row cube. The
+    # estimate itself (harmonic mean in float64) and the final ranking
+    # run in the host fold; no row set ever leaves the device.
+    # ------------------------------------------------------------------
+
+    #: refuse to build a SimilarTopN row cube past this HBM footprint —
+    #: the executor falls back to the per-shard host oracle instead.
+    SIM_CUBE_MAX_BYTES = 1 << 30
+
+    def supports_distinct(self, idx: Index, c: Call) -> bool:
+        """True for Distinct calls whose (optional) filter child is a
+        plannable bitmap tree over an existing BSI field."""
+        if not self.sketch_supported or c.name != "Distinct":
+            return False
+        if len(c.children) > 1:
+            return False
+        if c.children and not self.supports(c.children[0]):
+            return False
+        field_name, ok = c.string_arg("field")
+        if not ok:
+            return False
+        f = idx.field(field_name)
+        return f is not None and f.bsi_group is not None
+
+    def execute_distinct_registers(self, idx: Index, c: Call,
+                                   shards: list[int], p: int) -> np.ndarray:
+        """Merged uint8[2^p] HLL registers of the filtered column set
+        across ``shards`` — one device dispatch."""
+        return self.dispatch_distinct(idx, c, shards, p).result()
+
+    def dispatch_distinct(self, idx: Index, c: Call, shards: list[int],
+                          p: int):
+        """Async register fold: Future[uint8[2^p]]. Plans like the fused
+        aggregates (shared plan cache, structural program cache); the
+        unfiltered form reduces the cached [S, 2^p] register stack, the
+        filtered form traces the filter tree into the same program as
+        the masked plane gather."""
+        from concurrent.futures import Future
+        if not shards:
+            fut: Future = Future()
+            fut.set_result(np.zeros(1 << p, dtype=np.uint8))
+            return fut
+        fn, arrays = self._prepare_distinct(idx, c, shards, p)
+        _fuse.add_fused_steps(_fuse.call_steps(c))
+
+        def fold(host):
+            return np.asarray(host, dtype=np.uint8)
+
+        return self.coalescer.dispatch(fn, arrays, fold)
+
+    def _prepare_distinct(self, idx: Index, c: Call, shards: list[int],
+                          p: int):
+        field_name, _ = c.string_arg("field")
+        f = idx.field(field_name)
+        depth = f.bsi_group.bit_depth
+        plan_key = (idx.name, idx.instance_id, idx.schema_epoch.value,
+                    f"distinct{p}:{c}", tuple(shards))
+        with self._cache_lock:
+            hit = self._plan_cache.get(plan_key)
+            if hit is not None:
+                self._plan_cache.move_to_end(plan_key)
+        if hit is not None:
+            hit = self._revalidate_plan(idx, plan_key, hit, tuple(shards))
+        if hit is not None:
+            leaves, fn = hit[0], hit[1]
+        else:
+            if c.children:
+                leaves = [("hll", field_name, depth, p)]
+                filt_sig = self._signature(idx, c.children[0], leaves,
+                                           tuple(shards))
+            else:
+                leaves = [("hllreg", field_name, depth, p)]
+                filt_sig = None
+            full_sig = ("distinct", p, depth, filt_sig)
+            fn = self._compiled_distinct(full_sig, p, filt_sig)
+            with self._cache_lock:
+                self._plan_cache[plan_key] = (leaves, fn, idx.epoch.value)
+                while len(self._plan_cache) > self.PLAN_CACHE_SIZE:
+                    self._plan_cache.popitem(last=False)
+        self._prefetch_leaves(idx, leaves, tuple(shards))
+        arrays = [self._fetch_leaf(idx, leaf, tuple(shards))
+                  for leaf in leaves]
+        return fn, arrays
+
+    def _compiled_distinct(self, full_sig: tuple, p: int,
+                           filt_sig) -> Callable:
+        fn = self._fn_cache.get(full_sig)
+        if fn is not None:
+            return fn
+        hll_expand = _residency.kernel(_residency.HLL, "expand")
+
+        def program(*args):
+            if filt_sig is None:
+                # args[0]: the cached [S, 2^p] register stack.
+                return jnp.max(args[0], axis=0)
+            # args[0]: the packed [S, C] bucket|rho plane; the barrier
+            # pins the filter tree as one shared value (same rationale
+            # as _compiled_agg).
+            filt = jax.lax.optimization_barrier(_eval_node(filt_sig, args))
+            return jnp.max(hll_expand(args[0], filt, p), axis=0)
+
+        fn = self._jit_program(program, None)
+        self._fn_cache[full_sig] = fn
+        self._register_fn(fn, full_sig, program)
+        return fn
+
+    def supports_similar(self, idx: Index, field_name: str,
+                         filter_call: Call | None) -> bool:
+        if not self.sketch_supported:
+            return False
+        if filter_call is not None and not self.supports(filter_call):
+            return False
+        return idx.field(field_name) is not None
+
+    def execute_similar(self, idx: Index, field_name: str,
+                        filter_call: Call, row_ids: list[int],
+                        shards: list[int]):
+        """One-dispatch row-vs-all similarity: (ids, overlap, selfcnt,
+        filtcnt) with int64 host widening, or None when the candidate
+        cube would blow the HBM gate (the executor's host oracle takes
+        over). The filter tree traces INTO the program, so warm queries
+        cost exactly one launch.
+
+        No prepared-plan cache: a cached entry would pin a row-id
+        universe that any Set() can grow, and _revalidate_plan only
+        re-checks ``prow`` leaves — the structural _fn_cache still
+        dedupes compiles by (padded R, filter shape)."""
+        if not shards or not row_ids:
+            return None
+        s_pad = self._pad(len(shards))
+        r = len(row_ids)
+        r_pad = max(8, 1 << (r - 1).bit_length())
+        if r_pad * s_pad * WORDS_PER_SHARD * 4 > self.SIM_CUBE_MAX_BYTES:
+            return None
+        ids = tuple(int(x) for x in row_ids)
+        leaves: list[tuple] = [("simtopn", field_name, ids, r_pad)]
+        filt_sig = self._signature(idx, filter_call, leaves, tuple(shards))
+        full_sig = ("simtopn", r_pad, filt_sig)
+        fn = self._compiled_similar(full_sig, r_pad, filt_sig)
+        self._prefetch_leaves(idx, leaves, tuple(shards))
+        arrays = [self._fetch_leaf(idx, leaf, tuple(shards))
+                  for leaf in leaves]
+        _fuse.add_fused_steps(_fuse.call_steps(filter_call) + 1)
+        ids_arr = np.asarray(ids, dtype=np.uint64)
+
+        def fold(host):
+            order, inter, selfc, filtc = host
+            inter = np.asarray(inter).astype(np.int64)[:r]
+            selfc = np.asarray(selfc).astype(np.int64)[:r]
+            return (ids_arr, inter, selfc, int(filtc),
+                    np.asarray(order)[:r])
+
+        return self.coalescer.dispatch(fn, arrays, fold).result()
+
+    def _compiled_similar(self, full_sig: tuple, r_pad: int,
+                          filt_sig) -> Callable:
+        fn = self._fn_cache.get(full_sig)
+        if fn is not None:
+            return fn
+        from pilosa_tpu.sketch import kernels as sketch_kernels
+        sim = sketch_kernels.similar_program(r_pad)
+
+        def program(*args):
+            filt = jax.lax.optimization_barrier(_eval_node(filt_sig, args))
+            return sim(args[0], filt)
+
+        fn = self._jit_program(program, None)
+        self._fn_cache[full_sig] = fn
+        self._register_fn(fn, full_sig, program)
+        return fn
 
     # ------------------------------------------------------------------
     # TopN batched counts. Filterless: each fragment's generation-cached
@@ -1449,6 +1629,21 @@ class MeshPlanner:
                                     shards)
             cube = self._stack_planes(idx, field_name, depth, shards)
             return (exists, sign, cube)
+        if kind == "hll":
+            # Filtered-distinct leaf: packed [S_pad, C] bucket|rho<<18
+            # column plane (sketch/store), cached like any stack.
+            _, field_name, depth, p = leaf
+            return self._stack_hll_planes(idx, field_name, depth, p, shards)
+        if kind == "hllreg":
+            # Unfiltered-distinct leaf: [S_pad, 2^p] uint8 register
+            # stack — 2^p bytes per shard resident instead of 4 MiB.
+            _, field_name, depth, p = leaf
+            return self._stack_hll_registers(idx, field_name, depth, p,
+                                             shards)
+        if kind == "simtopn":
+            _, field_name, row_ids, r_pad = leaf
+            return self._stack_row_cube(idx, field_name, row_ids, r_pad,
+                                        shards)
         raise QueryError(f"unknown leaf kind {kind!r}")
 
     def _stack_planes(self, idx: Index, field_name: str, depth: int,
@@ -1486,6 +1681,110 @@ class MeshPlanner:
             arr = jnp.zeros((0,) + zero.shape, zero.dtype)
         # count_upload=False: the cube is stacked from already-uploaded
         # (and upload-counted) per-plane rows — no new link traffic.
+        self._insert_stack(key, epoch, gens, arr,
+                           _residency.stack_nbytes(arr),
+                           count_upload=False)
+        return arr
+
+    def _hll_stack(self, idx: Index, field_name: str, tag: tuple,
+                   shards: tuple, build) -> jax.Array:
+        """Shared cache protocol for the sketch stacks: the same
+        two-tier (epoch, then per-fragment generation) validation as
+        _stack_rows, keyed under the ``hll`` representation class so
+        /debug/device accounts their HBM separately."""
+        view = view_bsi_name(field_name)
+        key = (idx.name, idx.instance_id, field_name, view, tag, shards,
+               _residency.HLL)
+        epoch = idx.epoch.value
+        with self._cache_lock:
+            hit = self._stack_cache.get(key)
+            if hit is not None:
+                if hit[0] == epoch:
+                    self._stack_cache.move_to_end(key)
+                    return hit[2]
+                gens = self._gens(idx.name, field_name, view, shards)
+                if gens == hit[1]:
+                    self._stack_cache[key] = (epoch, gens, hit[2])
+                    self._stack_cache.move_to_end(key)
+                    return hit[2]
+            else:
+                gens = None
+        if gens is None:
+            gens = self._gens(idx.name, field_name, view, shards)
+        arr = build(view)
+        self._insert_stack(key, epoch, gens, arr,
+                           _residency.stack_nbytes(arr))
+        return arr
+
+    def _stack_hll_planes(self, idx: Index, field_name: str, depth: int,
+                          p: int, shards: tuple) -> jax.Array:
+        """[S_pad, SHARD_WIDTH] int32 packed bucket|rho column planes."""
+        from pilosa_tpu.sketch import store as sketch_store
+
+        def build(view: str) -> jax.Array:
+            s_pad = self._pad(len(shards))
+            mat = np.zeros((s_pad, SHARD_WIDTH), dtype=np.int32)
+            for i, shard in enumerate(shards):
+                frag = self.holder.fragment(idx.name, field_name, view,
+                                            shard)
+                if frag is not None:
+                    mat[i] = sketch_store.plane(frag, depth, p)
+            return jax.device_put(mat, shard_spec(self.mesh))
+
+        return self._hll_stack(idx, field_name, ("hll", depth, p), shards,
+                               build)
+
+    def _stack_hll_registers(self, idx: Index, field_name: str, depth: int,
+                             p: int, shards: tuple) -> jax.Array:
+        """[S_pad, 2^p] uint8 per-shard register files (zero padding
+        rows are the register-max identity)."""
+        from pilosa_tpu.sketch import store as sketch_store
+
+        def build(view: str) -> jax.Array:
+            s_pad = self._pad(len(shards))
+            mat = np.zeros((s_pad, 1 << p), dtype=np.uint8)
+            for i, shard in enumerate(shards):
+                frag = self.holder.fragment(idx.name, field_name, view,
+                                            shard)
+                if frag is not None:
+                    mat[i] = sketch_store.registers(frag, depth, p)
+            return jax.device_put(mat, shard_spec(self.mesh))
+
+        return self._hll_stack(idx, field_name, ("hllreg", depth, p),
+                               shards, build)
+
+    def _stack_row_cube(self, idx: Index, field_name: str,
+                        row_ids: tuple, r_pad: int,
+                        shards: tuple) -> jax.Array:
+        """[r_pad, S_pad, W] cube of every candidate row's dense stack
+        (SimilarTopN), stacked from the per-row cached stacks and
+        cached itself under the same validation; zero padding rows rank
+        with overlap 0 and are sliced off in the host fold."""
+        view = VIEW_STANDARD
+        key = (idx.name, idx.instance_id, field_name, view,
+               ("simcube", row_ids, r_pad), shards, _residency.DENSE)
+        epoch = idx.epoch.value
+        with self._cache_lock:
+            hit = self._stack_cache.get(key)
+            if hit is not None:
+                if hit[0] == epoch:
+                    self._stack_cache.move_to_end(key)
+                    return hit[2]
+                gens = self._gens(idx.name, field_name, view, shards)
+                if gens == hit[1]:
+                    self._stack_cache[key] = (epoch, gens, hit[2])
+                    self._stack_cache.move_to_end(key)
+                    return hit[2]
+            else:
+                gens = None
+        if gens is None:
+            gens = self._gens(idx.name, field_name, view, shards)
+        bits = [self._stack_rows(idx, field_name, view, rid, shards)
+                for rid in row_ids]
+        zero = self._zeros_stack(len(shards))
+        bits.extend(zero for _ in range(r_pad - len(bits)))
+        arr = jnp.stack(bits, axis=0)
+        # count_upload=False: stacked from already-counted row uploads.
         self._insert_stack(key, epoch, gens, arr,
                            _residency.stack_nbytes(arr),
                            count_upload=False)

@@ -222,6 +222,9 @@ class _Parser:
     def _call_TopN(self) -> Call:
         return self._posfield_call("TopN")
 
+    def _call_SimilarTopN(self) -> Call:
+        return self._posfield_call("SimilarTopN")
+
     def _call_Rows(self) -> Call:
         return self._posfield_call("Rows")
 

@@ -98,6 +98,8 @@ class ServerNode:
                  inline_transfer: str = "auto",
                  residency_packed: str = "auto",
                  prefetch: str = "on",
+                 sketch_precision: int = 12,
+                 sketch_exact_threshold: int = 1024,
                  profile_ring_n: int = 64,
                  profile_queries: bool = True):
         host, _, port = bind.partition(":")
@@ -350,6 +352,12 @@ class ServerNode:
         _residency.set_mode(residency_packed)
         from pilosa_tpu.parallel import prefetch as _prefetch
         _prefetch.set_mode(prefetch)
+        # Approximate-analytics knobs (README "Approximate analytics");
+        # PILOSA_TPU_SKETCH_PRECISION / _SKETCH_EXACT_THRESHOLD
+        # override per-run.
+        from pilosa_tpu import sketch as _sketch
+        _sketch.set_precision(sketch_precision)
+        _sketch.set_exact_threshold(sketch_exact_threshold)
         # In-flight byte budget for the /internal/import-stream pipeline
         # (0 = unbounded); trips 429 + Retry-After, never queues.
         from pilosa_tpu.qos import IngestGate

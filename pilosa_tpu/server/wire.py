@@ -17,6 +17,7 @@ import numpy as np
 
 from pilosa_tpu.core.row import Row
 from pilosa_tpu.exec.result import FieldRow, GroupCount, Pair, ValCount
+from pilosa_tpu.sketch.hll import DistinctValues, HLLSketch, SimPartial
 
 #: binary frame response for remote queries (see encode_frames).
 FRAMES_CONTENT_TYPE = "application/x-pilosa-frames"
@@ -44,6 +45,20 @@ def encode_result(r: Any) -> dict:
         return {"t": "valcount", "val": r.val, "count": r.count}
     if isinstance(r, Pair):
         return {"t": "pair", "id": r.id, "count": r.count, "key": r.key}
+    if isinstance(r, HLLSketch):
+        return {"t": "hll", "p": int(r.p),
+                "regs": [int(x) for x in r.regs]}
+    if isinstance(r, DistinctValues):
+        return {"t": "distinct", "vals": [int(x) for x in r.values]}
+    if isinstance(r, SimPartial):
+        # ``order`` (device top-k) deliberately stays off the wire: it
+        # only ranks ONE node's totals and the coordinator re-ranks the
+        # merged sums.
+        return {"t": "simpartial",
+                "ids": [int(x) for x in r.ids],
+                "overlap": [int(x) for x in r.overlap],
+                "selfcnt": [int(x) for x in r.selfcnt],
+                "filtcnt": int(r.filtcnt)}
     if isinstance(r, list):
         if r and isinstance(r[0], Pair):
             d = {"t": "pairs",
@@ -91,6 +106,16 @@ def decode_result(d: dict) -> Any:
                 for item in d["items"]]
     if t == "rowids":
         return list(d["items"])
+    if t == "hll":
+        return HLLSketch(p=int(d["p"]),
+                         regs=np.asarray(d["regs"], dtype=np.uint8))
+    if t == "distinct":
+        return DistinctValues(values=np.asarray(d["vals"], dtype=np.int64))
+    if t == "simpartial":
+        return SimPartial(ids=np.asarray(d["ids"], dtype=np.uint64),
+                          overlap=np.asarray(d["overlap"], dtype=np.int64),
+                          selfcnt=np.asarray(d["selfcnt"], dtype=np.int64),
+                          filtcnt=int(d["filtcnt"]))
     if t == "scalar":
         return d["v"]
     raise TypeError(f"undecodable internal result {d!r}")
@@ -150,6 +175,25 @@ def _encode_agg_frame(r: Any, blobs: list[bytes]) -> dict | None:
                     "vc": _arr_meta(np.array([r.val, r.count],
                                              dtype=np.int64), blobs)}
         return None
+    if isinstance(r, HLLSketch):
+        # Register blob: 2^p raw uint8 bytes instead of a JSON int list
+        # (a p=14 register file is 16 KiB of bytes vs ~64 KiB of text).
+        return {"t": "hll_frame", "p": int(r.p),
+                "regs": _arr_meta(np.asarray(r.regs, dtype=np.uint8),
+                                  blobs)}
+    if isinstance(r, DistinctValues):
+        return {"t": "distinct_frame",
+                "vals": _arr_meta(np.asarray(r.values, dtype=np.int64),
+                                  blobs)}
+    if isinstance(r, SimPartial):
+        # ``order`` stays off the wire — see the JSON encoding above.
+        return {"t": "simpartial_frame", "filtcnt": int(r.filtcnt),
+                "ids": _arr_meta(np.asarray(r.ids, dtype=np.uint64),
+                                 blobs),
+                "overlap": _arr_meta(np.asarray(r.overlap,
+                                                dtype=np.int64), blobs),
+                "selfcnt": _arr_meta(np.asarray(r.selfcnt,
+                                                dtype=np.int64), blobs)}
     if not isinstance(r, list) or len(r) < _AGG_BLOB_MIN:
         return None
     if isinstance(r[0], Pair):
@@ -474,6 +518,25 @@ def decode_frames(data: bytes) -> list[Any]:
                 if len(vc) != 2:
                     raise ValueError("valcount frame shape mismatch")
                 out.append(ValCount(int(vc[0]), int(vc[1])))
+            elif t == "hll_frame":
+                regs = _read_arr(m["regs"], blobs)
+                if len(regs) != (1 << int(m["p"])):
+                    raise ValueError("hll frame register length mismatch")
+                out.append(HLLSketch(p=int(m["p"]),
+                                     regs=regs.astype(np.uint8)))
+            elif t == "distinct_frame":
+                out.append(DistinctValues(
+                    values=_read_arr(m["vals"], blobs).astype(np.int64)))
+            elif t == "simpartial_frame":
+                ids = _read_arr(m["ids"], blobs).astype(np.uint64)
+                overlap = _read_arr(m["overlap"], blobs)
+                selfcnt = _read_arr(m["selfcnt"], blobs)
+                if len(overlap) != len(ids) or len(selfcnt) != len(ids):
+                    raise ValueError("simpartial frame shape mismatch")
+                out.append(SimPartial(ids=ids,
+                                      overlap=overlap.astype(np.int64),
+                                      selfcnt=selfcnt.astype(np.int64),
+                                      filtcnt=int(m["filtcnt"])))
             else:
                 out.append(decode_result(m))
         return out

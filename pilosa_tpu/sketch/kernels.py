@@ -1,0 +1,119 @@
+"""Device kernel variants for the ``hll`` representation class.
+
+The resident forms (built host-side in sketch/store.py, uploaded and
+cached by the planner like any other leaf stack):
+
+* register stack — ``[S, 2^p]`` uint8, one HLL register file per
+  shard. The unfiltered ``Count(Distinct(...))`` reduces it with a
+  single register-max over the shard axis.
+* packed plane — ``[S, SHARD_WIDTH]`` int32 of ``bucket | rho << 18``
+  per column (0 = column absent). The FILTERED path needs per-column
+  granularity: the filter tree evaluates to ``[S, W]`` word planes
+  inside the same program, masks the rho entries, and a segment-max
+  re-derives the registers of exactly the surviving columns — the
+  "masked register gather" of the fused program, with no row set ever
+  leaving the device.
+
+All four kernels are pure traced jax so they can sit in the planner's
+``KERNELS`` row for the class (the residency-pairing checker holds
+every class to the full dense op set).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pilosa_tpu.sketch.hll import (BUCKET_MASK, RHO_SHIFT, _alpha,
+                                   estimate_from_registers)
+
+
+def hll_expand(packed, filt, p: int):
+    """Masked register gather: ``[S, C]`` packed plane + ``[S, W]``
+    filter words -> ``[S, 2^p]`` uint8 registers of the filtered
+    columns. One segment-max over shard-offset buckets keeps the whole
+    reduction a single XLA scatter-max."""
+    s = packed.shape[0]
+    m = 1 << p
+    bits = (filt[:, :, None] >> jnp.arange(32, dtype=jnp.uint32)) \
+        & jnp.uint32(1)
+    mask = bits.reshape(s, -1).astype(jnp.int32)         # [S, C]
+    rho = (packed >> RHO_SHIFT) * mask
+    seg = ((packed & BUCKET_MASK)
+           + jnp.arange(s, dtype=jnp.int32)[:, None] * m)
+    regs = jax.ops.segment_max(rho.reshape(-1), seg.reshape(-1),
+                               num_segments=s * m)
+    # Empty segments come back as the dtype minimum; clamp to "no
+    # observation" before narrowing to the uint8 register file.
+    return jnp.maximum(regs, 0).astype(jnp.uint8).reshape(s, m)
+
+
+def hll_reduce(regs):
+    """[S, m] register stack -> [m] merged registers (register max)."""
+    return jnp.max(regs, axis=0)
+
+
+def hll_count(regs, p: int | None = None):
+    """Device-side harmonic estimate of one register array (float32,
+    with the linear-counting small-range correction traced as a
+    select). The executor's host fold recomputes in float64; this
+    variant exists so fully-fused consumers can keep the estimate on
+    device."""
+    regs = regs.astype(jnp.float32)
+    m = regs.shape[-1]
+    est = _alpha(m) * m * m / jnp.sum(jnp.exp2(-regs), axis=-1)
+    zeros = jnp.sum((regs == 0).astype(jnp.float32), axis=-1)
+    linear = m * jnp.log(m / jnp.maximum(zeros, 1.0))
+    return jnp.where((est <= 2.5 * m) & (zeros > 0), linear, est)
+
+
+def hll_and_count(a_regs, b_regs):
+    """Estimated |A ∧ B| by inclusion-exclusion over register maxima:
+    est(A) + est(B) - est(A ∪ B). Approximate (like everything HLL);
+    exact-path consumers use the dense kernels instead."""
+    union = jnp.maximum(a_regs, b_regs)
+    return hll_count(a_regs) + hll_count(b_regs) - hll_count(union)
+
+
+def hll_pair_count(a_regs, b_regs):
+    """Same inclusion-exclusion estimate; registered under the
+    ``pair_count`` op so the class carries the full dense op set."""
+    return hll_and_count(a_regs, b_regs)
+
+
+def similar_program(r: int):
+    """The fused SimilarTopN program over a candidate row cube: one
+    dispatch computes, for every candidate row, its overlap with the
+    filter, its own cardinality, the filter cardinality, and the
+    device top-k ranking of the overlap totals.
+
+    ``cube``: [R, S, W] uint32 — every row of the field, id-ascending.
+    ``filt``: [S, W] uint32 — the already-evaluated filter tree.
+    Returns (order [R], inter [R], selfc [R], filtc []) — int32
+    per-row totals summed over the shard axis inside the program (safe
+    to ~2k full shards before int32 could saturate; the host fold
+    re-widens to int64 before any cross-node addition)."""
+
+    from pilosa_tpu.ops import bitops
+
+    def program(cube, filt):
+        inter = jnp.sum(bitops.popcount_words(cube & filt[None]),
+                        axis=(1, 2))
+        selfc = jnp.sum(bitops.popcount_words(cube), axis=(1, 2))
+        filtc = jnp.sum(bitops.popcount_words(filt))
+        _, order = jax.lax.top_k(inter, r)
+        return order, inter, selfc, filtc
+
+    return program
+
+
+def np_uint8_stack(regs_list: list[np.ndarray], s_pad: int,
+                   m: int) -> np.ndarray:
+    """Host-side [S_pad, m] uint8 assembly with zero padding rows
+    (zero registers merge as identity under register-max)."""
+    mat = np.zeros((s_pad, m), dtype=np.uint8)
+    for i, regs in enumerate(regs_list):
+        if regs is not None:
+            mat[i] = regs
+    return mat

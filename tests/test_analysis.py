@@ -219,6 +219,51 @@ def test_wire_symmetry_only_runs_on_wire_module():
                     path="pilosa_tpu/server/api.py") == []
 
 
+# The sketch register-blob near-miss: the frame encoder stamps
+# "hll_frame" but the decode dispatch chain has no matching arm, so
+# register planes arrive as raw meta dicts. Sub-check 2 can't catch it
+# ("t" and "p" and "regs" are all read *somewhere*) — only the tag
+# sub-check sees the missing dispatch.
+HLL_TAG_BUG = """
+def encode_result(r):
+    return {"t": "hll", "p": r.p, "regs": r.regs}
+
+def encode_frames(results):
+    return b""
+
+def _encode_agg_frame(r):
+    return {"t": "hll_frame", "p": r.p, "regs": r.regs}
+
+def decode_result(d):
+    t = d.get("t")
+    if t == "hll":
+        return HLL(d["p"], d["regs"])
+    raise ValueError(t)
+
+def decode_frames(data):
+    m = _meta(data)
+    t = m.get("t")
+    if t == "hll":
+        return HLL(m["p"], m["regs"])
+    raise ValueError(t)
+"""
+
+
+def test_wire_symmetry_catches_undispatched_tag():
+    fs = run_rule(wire_symmetry, HLL_TAG_BUG,
+                  path="pilosa_tpu/server/wire.py")
+    assert len(fs) == 1 and "'hll_frame'" in fs[0].message
+    assert "raw dict" in fs[0].message
+
+
+def test_wire_symmetry_dispatched_tags_pass():
+    src = HLL_TAG_BUG.replace(
+        '    if t == "hll":\n        return HLL(m["p"], m["regs"])',
+        '    if t == "hll_frame":\n        return HLL(m["p"], m["regs"])')
+    assert run_rule(wire_symmetry, src,
+                    path="pilosa_tpu/server/wire.py") == []
+
+
 # -- jit-purity --------------------------------------------------------------
 
 JIT_IMPURE = """
@@ -447,11 +492,11 @@ PACKED = "packed"
 REPR_CLASSES = (DENSE, PACKED)
 
 KERNELS = {
-    (DENSE, "expand"): None,
-    (DENSE, "count"): None,
-    (DENSE, "and_count"): None,
-    (PACKED, "expand"): None,
-    (PACKED, "count"): None,
+    (DENSE, "expand"): k_expand,
+    (DENSE, "count"): k_count,
+    (DENSE, "and_count"): k_and_count,
+    (PACKED, "expand"): pk_expand,
+    (PACKED, "count"): pk_count,
 }
 """
 
@@ -470,10 +515,10 @@ def test_residency_pairing_catches_missing_kernel_variant():
 
 def test_residency_pairing_catches_undeclared_class():
     src = PAIRING_BUG.replace(
-        '    (PACKED, "count"): None,',
-        '    (PACKED, "count"): None,\n'
-        '    (PACKED, "and_count"): None,\n'
-        '    ("packd", "expand"): None,')
+        '    (PACKED, "count"): pk_count,',
+        '    (PACKED, "count"): pk_count,\n'
+        '    (PACKED, "and_count"): pk_and_count,\n'
+        '    ("packd", "expand"): pk_expand,')
     fs = run_rule(residency_pairing, src,
                   path="pilosa_tpu/exec/residency.py")
     assert len(fs) == 1 and "'packd'" in fs[0].message
@@ -482,11 +527,62 @@ def test_residency_pairing_catches_undeclared_class():
 
 def test_residency_pairing_symmetric_tables_pass():
     src = PAIRING_BUG.replace(
-        '    (PACKED, "count"): None,',
-        '    (PACKED, "count"): None,\n'
-        '    (PACKED, "and_count"): None,')
+        '    (PACKED, "count"): pk_count,',
+        '    (PACKED, "count"): pk_count,\n'
+        '    (PACKED, "and_count"): pk_and_count,')
     assert run_rule(residency_pairing, src,
                     path="pilosa_tpu/exec/residency.py") == []
+
+
+def test_residency_pairing_catches_none_stub():
+    # A class can "declare" its full row with None placeholders and
+    # sail past the width check — the stub sub-check keeps the table
+    # honest: every registered entry must be a real kernel.
+    src = PAIRING_BUG.replace(
+        '    (PACKED, "count"): pk_count,',
+        '    (PACKED, "count"): pk_count,\n'
+        '    (PACKED, "and_count"): None,')
+    fs = run_rule(residency_pairing, src,
+                  path="pilosa_tpu/exec/residency.py")
+    assert len(fs) == 1 and "None" in fs[0].message
+    assert "'and_count'" in fs[0].message and "'packed'" in fs[0].message
+
+
+def test_residency_pairing_hll_full_row_passes():
+    # The sketch class as wired: hll declares a variant for every op
+    # in the dense contract, all pointing at real kernels.
+    src = """
+    DENSE = "dense"
+    HLL = "hll"
+    REPR_CLASSES = (DENSE, HLL)
+
+    KERNELS = {
+        (DENSE, "expand"): k_expand,
+        (DENSE, "count"): k_count,
+        (HLL, "expand"): hll_expand,
+        (HLL, "count"): hll_count,
+    }
+    """
+    assert run_rule(residency_pairing, src,
+                    path="pilosa_tpu/exec/residency.py") == []
+
+
+def test_residency_pairing_hll_partial_row_flagged():
+    src = """
+    DENSE = "dense"
+    HLL = "hll"
+    REPR_CLASSES = (DENSE, HLL)
+
+    KERNELS = {
+        (DENSE, "expand"): k_expand,
+        (DENSE, "count"): k_count,
+        (HLL, "expand"): hll_expand,
+    }
+    """
+    fs = run_rule(residency_pairing, src,
+                  path="pilosa_tpu/exec/residency.py")
+    assert len(fs) == 1 and "'hll'" in fs[0].message
+    assert "count" in fs[0].message
 
 
 def test_residency_pairing_out_of_scope_module_ignored():

@@ -1,0 +1,418 @@
+"""Approximate analytics tests (pilosa_tpu/sketch).
+
+The contract has two halves:
+
+* ``Count(Distinct(...))`` is *approximate with a proven bound*: the
+  generative tests accept any estimate within 2× the theoretical HLL
+  standard error 1.04/sqrt(2^p) — and the register algebra underneath
+  (merge = element-wise max) must be associative, commutative, and
+  idempotent, because cross-shard and cross-node folds reorder freely.
+* ``SimilarTopN(...)`` is *exact*: overlap counts are popcounts, so the
+  fused device path must be bit-identical to a host oracle.
+
+Both fused paths must cost exactly ONE device dispatch warm — that is
+the point of registering the hll representation class — proven against
+the planner's raw dispatch counter with the result cache disabled.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from pilosa_tpu.config import SHARD_WIDTH
+from pilosa_tpu.core import FieldOptions, Holder
+from pilosa_tpu.core.field import FIELD_TYPE_INT
+from pilosa_tpu.errors import QueryError
+from pilosa_tpu.exec import Executor, Pair
+from pilosa_tpu import sketch as sketch_mod
+from pilosa_tpu.parallel import MeshPlanner, make_mesh
+from pilosa_tpu.sketch import hll
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) == 8, "conftest must provide 8 virtual devices"
+    return make_mesh()
+
+
+def _build(seed: int, n: int = 6000, shards: int = 2):
+    """Holder with an int field ``v`` (a value on every used column)
+    and a set field ``f`` whose rows 1 and 2 overlap — returns the
+    numpy ground truth alongside."""
+    rng = np.random.default_rng(seed)
+    cols = np.sort(rng.choice(shards * SHARD_WIDTH, size=n, replace=False))
+    vals = rng.integers(0, 90_000, n)
+    r = rng.random(n)
+    in1, in2 = r < 0.55, (r > 0.35) & (r < 0.85)
+
+    h = Holder()
+    idx = h.create_index("i")
+    f = idx.create_field("f")
+    v = idx.create_field("v", FieldOptions(type=FIELD_TYPE_INT,
+                                           min=0, max=100_000))
+    f.import_bits(np.concatenate([np.ones(in1.sum(), dtype=np.uint64),
+                                  np.full(in2.sum(), 2, dtype=np.uint64)]),
+                  np.concatenate([cols[in1], cols[in2]]))
+    v.import_values(cols, vals)
+    return h, cols, vals, in1, in2
+
+
+# -- the register algebra ----------------------------------------------------
+
+
+def test_register_merge_commutative_associative_idempotent():
+    rng = np.random.default_rng(3)
+    p = 10
+    a, b, c = (hll.HLLSketch(p, rng.integers(0, 30, 1 << p).astype(np.uint8))
+               for _ in range(3))
+    ab, ba = a.merge(b), b.merge(a)
+    assert np.array_equal(ab.regs, ba.regs)
+    assert np.array_equal(a.merge(b.merge(c)).regs,
+                          a.merge(b).merge(c).regs)
+    assert np.array_equal(a.merge(a).regs, a.regs)
+    assert np.array_equal(a.merge(hll.HLLSketch.empty(p)).regs, a.regs)
+    assert np.array_equal(hll.merge_all([a, b, c]).regs,
+                          c.merge(a).merge(b).regs)
+
+
+def test_merge_of_sketches_is_sketch_of_union():
+    # The property the cluster fold relies on: merging per-node
+    # sketches must give byte-identical registers to sketching the
+    # union of the raw values directly.
+    rng = np.random.default_rng(7)
+    p = 12
+    a_vals = rng.integers(0, 1 << 40, 4000)
+    b_vals = rng.integers(0, 1 << 40, 4000)
+    sa = hll.sketch_values(a_vals, p)
+    sb = hll.sketch_values(b_vals, p)
+    merged = sa.merge(sb)
+    direct = hll.sketch_values(np.concatenate([a_vals, b_vals]), p)
+    assert np.array_equal(merged.regs, direct.regs)
+
+
+# -- estimate quality (generative, deterministic seeds) ----------------------
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_distinct_estimate_within_theoretical_bound(seed):
+    h, cols, vals, in1, in2 = _build(seed)
+    e = Executor(h)
+    p = sketch_mod.precision()
+    tol = 2.0 * hll.error_bound(p)
+
+    # threshold=0 pins the pure sketch path — no exact fallback.
+    cases = [
+        ("Count(Distinct(field=v, threshold=0))",
+         len(np.unique(vals))),
+        ("Count(Distinct(Row(f=1), field=v, threshold=0))",
+         len(np.unique(vals[in1]))),
+        ("Count(Distinct(Intersect(Row(f=1), Row(f=2)), field=v, "
+         "threshold=0))",
+         len(np.unique(vals[in1 & in2]))),
+        ("Count(Distinct(Union(Row(f=1), Row(f=2)), field=v, "
+         "threshold=0))",
+         len(np.unique(vals[in1 | in2]))),
+    ]
+    for pql, true in cases:
+        (est,) = e.execute("i", pql)
+        assert abs(est - true) <= max(tol * true, 2), \
+            f"{pql}: est={est} true={true} tol={tol:.4f}"
+
+
+def test_exact_fallback_below_threshold():
+    # Under the cardinality threshold the answer is EXACT, not an
+    # estimate — the sketch only triages.
+    rng = np.random.default_rng(11)
+    h = Holder()
+    idx = h.create_index("i")
+    idx.create_field("f")
+    v = idx.create_field("v", FieldOptions(type=FIELD_TYPE_INT,
+                                           min=-500, max=500))
+    cols = np.sort(rng.choice(SHARD_WIDTH, 800, replace=False))
+    vals = rng.integers(-500, 500, 800)  # ~550 distinct < 1024 default
+    v.import_values(cols, vals)
+    e = Executor(h)
+    assert e.execute("i", "Count(Distinct(field=v))") == \
+        [len(np.unique(vals))]
+
+
+def test_bare_distinct_rejected():
+    h = Holder()
+    idx = h.create_index("i")
+    idx.create_field("v", FieldOptions(type=FIELD_TYPE_INT,
+                                       min=0, max=10))
+    with pytest.raises(QueryError):
+        Executor(h).execute("i", "Distinct(field=v)")
+
+
+# -- epoch invalidation on mutation ------------------------------------------
+
+
+def test_mutation_invalidates_sketch_planes(monkeypatch):
+    # Regression for the stale-plane class of bug: after the first
+    # Distinct builds register planes, further ingest must be visible —
+    # the re-query must match a fresh holder built from the full data.
+    # Host ingest path: the device transpose adopts read-only plane
+    # views that reject later point Set()s (pre-existing, unrelated to
+    # the sketch hooks this test pins).
+    monkeypatch.setenv("PILOSA_TPU_INGEST_TRANSPOSE", "off")
+    rng = np.random.default_rng(5)
+    cols1 = np.arange(0, 4000, dtype=np.uint64)
+    vals1 = rng.integers(0, 50_000, 4000)
+    cols2 = np.arange(4000, 8000, dtype=np.uint64)
+    vals2 = rng.integers(50_000, 99_000, 4000)
+    opts = FieldOptions(type=FIELD_TYPE_INT, min=0, max=100_000)
+
+    h = Holder()
+    h.create_index("i").create_field("v", opts)
+    h.field("i", "v").import_values(cols1, vals1)
+    e = Executor(h, result_cache=False)
+    pql = "Count(Distinct(field=v, threshold=0))"
+    (before,) = e.execute("i", pql)
+
+    h.field("i", "v").import_values(cols2, vals2)
+    (after,) = e.execute("i", pql)
+
+    h2 = Holder()
+    h2.create_index("i").create_field("v", opts)
+    h2.field("i", "v").import_values(np.concatenate([cols1, cols2]),
+                                     np.concatenate([vals1, vals2]))
+    (fresh,) = Executor(h2).execute("i", pql)
+    assert after == fresh
+    assert after != before  # the second batch is disjoint in value space
+
+    # point mutation (Set on the int field) must also invalidate
+    e.execute("i", "Set(9000, v=77777)")
+    (bumped,) = e.execute("i", pql)
+    h2.field("i", "v").import_values(np.asarray([9000], dtype=np.uint64),
+                                     np.asarray([77777]))
+    (fresh2,) = Executor(h2).execute("i", pql)
+    assert bumped == fresh2
+
+
+# -- SimilarTopN: exact, bit-identical to the host oracle --------------------
+
+
+def _similar_oracle(h, filt_row, n, metric="jaccard"):
+    e = Executor(h)
+    (base,) = e.execute("i", f"Row(f={filt_row})")
+    base_cols = set(base.columns().tolist())
+    scored = []
+    for rid in range(64):
+        (row,) = e.execute("i", f"Row(f={rid})")
+        rc = set(row.columns().tolist())
+        if not rc:
+            continue
+        inter = len(rc & base_cols)
+        if inter == 0:
+            continue
+        if metric == "jaccard":
+            score = inter / len(rc | base_cols)
+        else:
+            score = float(inter)
+        scored.append((rid, inter, score))
+    scored.sort(key=lambda t: (-t[2], -t[1], t[0]))
+    return [(rid, inter) for rid, inter, _ in scored[:n]]
+
+
+def _seed_similar(seed=13, rows=20, n=5000, shards=2):
+    rng = np.random.default_rng(seed)
+    h = Holder()
+    h.create_index("i").create_field("f")
+    row_ids = rng.integers(0, rows, n, dtype=np.uint64)
+    cols = rng.integers(0, shards * SHARD_WIDTH, n, dtype=np.uint64)
+    h.field("i", "f").import_bits(row_ids, cols)
+    return h
+
+
+@pytest.mark.parametrize("metric", ["jaccard", "overlap"])
+def test_similar_topn_matches_host_oracle(metric):
+    h = _seed_similar()
+    e = Executor(h)
+    got = e.execute("i", f'SimilarTopN(f, Row(f=3), n=6, '
+                         f'metric="{metric}")')[0]
+    want = _similar_oracle(h, 3, 6, metric)
+    assert [(p.id, p.count) for p in got] == want
+    assert all(isinstance(p, Pair) for p in got)
+
+
+def test_similar_topn_device_path_bit_identical(mesh):
+    h = _seed_similar(seed=17)
+    plain = Executor(h)
+    fast = Executor(h, planner=MeshPlanner(h, mesh))
+    try:
+        for pql in ('SimilarTopN(f, Row(f=0), n=8)',
+                    'SimilarTopN(f, Row(f=7), n=4, metric="overlap")'):
+            a = plain.execute("i", pql)[0]
+            b = fast.execute("i", pql)[0]
+            assert [(p.id, p.count) for p in a] == \
+                [(p.id, p.count) for p in b], pql
+    finally:
+        fast.planner.close()
+
+
+# -- one fused dispatch warm -------------------------------------------------
+
+
+def test_single_dispatch_warm(mesh):
+    # The acceptance criterion: Count(Distinct(...)) and
+    # SimilarTopN(...) each cost exactly ONE device dispatch once the
+    # program is compiled. The result cache is disabled — it would
+    # serve the repeat in zero dispatches and prove nothing.
+    h, *_ = _build(seed=4, n=4000)
+    planner = MeshPlanner(h, mesh)
+    e = Executor(h, planner=planner, result_cache=False)
+    queries = [
+        "Count(Distinct(field=v, threshold=0))",
+        "Count(Distinct(Row(f=1), field=v, threshold=0))",
+        "SimilarTopN(f, Row(f=1), n=4)",
+    ]
+    try:
+        for pql in queries:
+            e.execute("i", pql)              # warm: compile + dispatch
+            d0 = planner.dispatches
+            e.execute("i", pql)
+            assert planner.dispatches - d0 == 1, pql
+    finally:
+        planner.close()
+
+
+# -- cluster: register-max merge over the aggregate wire ---------------------
+
+
+def test_cluster_distinct_one_dispatch_per_node():
+    from pilosa_tpu.cluster.harness import LocalCluster
+
+    lc = LocalCluster(3, replica_n=1, planner_factory=lambda i: None)
+    for cn in lc.nodes:
+        cn.executor.planner = MeshPlanner(cn.holder)
+        cn.executor.result_cache = None     # measure raw dispatches
+    try:
+        lc.create_index("i")
+        lc.create_field("i", "v", FieldOptions(type=FIELD_TYPE_INT,
+                                               min=0, max=100_000))
+        rng = np.random.default_rng(23)
+        n_shards = 6
+        cols = np.sort(rng.choice(n_shards * SHARD_WIDTH, 9000,
+                                  replace=False))
+        vals = rng.integers(0, 90_000, 9000)
+        owners = set()
+        for shard in range(n_shards):
+            m = (cols // SHARD_WIDTH) == shard
+            if not m.any():
+                continue
+            node = lc[0].cluster.shard_nodes("i", shard)[0]
+            owners.add(node.id)
+            lc.client.peers[node.id].holder.field("i", "v") \
+                .import_values(cols[m], vals[m])
+        assert len(owners) > 1, "data must span nodes"
+
+        true = len(np.unique(vals))
+        pql = "Count(Distinct(field=v, threshold=0))"
+        (est,) = lc.query("i", pql, cache=False)    # warm/compile
+        tol = 2.0 * hll.error_bound(sketch_mod.precision())
+        assert abs(est - true) <= tol * true
+
+        # cluster answer == merging every node's registers by hand
+        merged = hll.merge_all([
+            hll.sketch_values(vals[(cols // SHARD_WIDTH) == s],
+                              sketch_mod.precision())
+            for s in range(n_shards)])
+        assert est == int(round(merged.estimate()))
+
+        d0 = {cn.id: cn.executor.planner.dispatches for cn in lc.nodes}
+        (est2,) = lc.query("i", pql, cache=False)
+        assert est2 == est
+        for cn in lc.nodes:
+            want = 1 if cn.id in owners else 0
+            assert cn.executor.planner.dispatches - d0[cn.id] == want, cn.id
+
+        # exact fallback agrees with ground truth through the same wire
+        (exact,) = lc.query("i",
+                            "Count(Distinct(field=v, threshold=100000))",
+                            cache=False)
+        assert exact == true
+
+        # SimilarTopN ships its partials over the same wire
+        lc.create_field("i", "f")
+        rows = rng.integers(0, 16, 4000, dtype=np.uint64)
+        fcols = rng.integers(0, n_shards * SHARD_WIDTH, 4000,
+                             dtype=np.uint64)
+        for shard in range(n_shards):
+            m = (fcols // SHARD_WIDTH) == shard
+            if not m.any():
+                continue
+            node = lc[0].cluster.shard_nodes("i", shard)[0]
+            lc.client.peers[node.id].holder.field("i", "f") \
+                .import_bits(rows[m], fcols[m])
+        got = lc.query("i", "SimilarTopN(f, Row(f=2), n=5)",
+                       cache=False)[0]
+        assert got and all(p.count > 0 for p in got)
+        assert got[0].id == 2          # a row is most similar to itself
+    finally:
+        for cn in lc.nodes:
+            cn.executor.planner.close()
+
+
+# -- plan-signature canonicalization (cache keying) --------------------------
+
+
+def test_signature_canonicalizes_default_spellings():
+    from pilosa_tpu.cache.signature import plan_signature
+    from pilosa_tpu.pql import parse
+
+    p, thr = sketch_mod.precision(), sketch_mod.exact_threshold()
+    assert plan_signature(parse("Count(Distinct(Row(f=1), field=v))")) == \
+        plan_signature(parse(f"Count(Distinct(Row(f=1), field=v, "
+                             f"precision={p}, threshold={thr}))"))
+    assert plan_signature(parse("SimilarTopN(f, Row(f=1))")) == \
+        plan_signature(parse(f'SimilarTopN(f, Row(f=1), '
+                             f'n={sketch_mod.DEFAULT_SIMILAR_N}, '
+                             f'metric="jaccard")'))
+    # a DIFFERENT literal must not collapse into the default
+    assert plan_signature(parse("Count(Distinct(field=v))")) != \
+        plan_signature(parse("Count(Distinct(field=v, precision=10))"))
+    # non-sketch queries are untouched (and still memoized)
+    q = parse("Count(Row(f=1))")
+    assert plan_signature(q) == "Count(Row(f=1))"
+    assert getattr(q, "_plan_signature", None) is not None
+
+
+def test_signature_rekeys_on_knob_change():
+    # The silent-path regression: signatures bake in CURRENT server
+    # defaults, so flipping the precision knob must re-key implicit
+    # spellings (no memoized stale signature may survive).
+    from pilosa_tpu.cache.signature import plan_signature
+    from pilosa_tpu.pql import parse
+
+    old = sketch_mod.precision()
+    sig_before = plan_signature(parse("Count(Distinct(field=v))"))
+    try:
+        sketch_mod.set_precision(old + 1)
+        sig_after = plan_signature(parse("Count(Distinct(field=v))"))
+        assert sig_before != sig_after
+    finally:
+        sketch_mod.set_precision(old)
+    assert plan_signature(parse("Count(Distinct(field=v))")) == sig_before
+
+
+def test_equivalent_spellings_share_result_cache_entry(mesh):
+    # End to end: the explicit-defaults spelling must be served from
+    # the result cache entry the implicit spelling populated — zero new
+    # device dispatches.
+    h, *_ = _build(seed=9, n=3000)
+    planner = MeshPlanner(h, mesh)
+    e = Executor(h, planner=planner)
+    p, thr = sketch_mod.precision(), sketch_mod.exact_threshold()
+    try:
+        e.execute("i", "Count(Distinct(Row(f=1), field=v, threshold=0))")
+        d0 = planner.dispatches
+        (res,) = e.execute(
+            "i", f"Count(Distinct(Row(f=1), field=v, precision={p}, "
+                 f"threshold=0))")
+        assert planner.dispatches == d0
+        assert res == e.execute(
+            "i", "Count(Distinct(Row(f=1), field=v, threshold=0))")[0]
+    finally:
+        planner.close()
