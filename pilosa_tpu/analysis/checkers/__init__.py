@@ -3,6 +3,7 @@ and ``check(mod, project) -> list[Finding]``."""
 
 from pilosa_tpu.analysis.checkers import (
     contextvar_hygiene,
+    coordinator_fence,
     epoch_audit,
     executor_lifecycle,
     jit_purity,
@@ -21,6 +22,7 @@ ALL_CHECKERS = [
     executor_lifecycle,
     resize_cutover,
     residency_pairing,
+    coordinator_fence,
 ]
 
 RULES = [c.RULE for c in ALL_CHECKERS]

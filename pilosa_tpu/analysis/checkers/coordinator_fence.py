@@ -1,0 +1,106 @@
+"""coordinator-fence: coordinator-only duties must consult the fence.
+
+Partition-tolerance invariant (cluster/cluster.py ``observe_quorum``):
+a node that cannot reach a strict majority of the ring fences itself,
+because its claim to coordinatorship is exactly as stale as its view of
+the membership. Any entry point that acts with CLUSTER-WIDE authority
+on the strength of "I am the coordinator" — capturing a scheduled
+backup, pruning the shared archive, beginning a resize, push-repairing
+a fragment onto replicas — must therefore check the fence before
+acting, or a partitioned minority coordinator races the majority's
+successor: two schedulers capture into one archive, retention prunes
+chains the other side still references, a stale resize begins against
+a ring that already moved on, and a minority scrub overwrites the
+majority's newer writes the moment the partition heals.
+
+The duty roster below is explicit (path suffix → qualified names), the
+same shape as the runtime's own gates, so adding a coordinator duty
+without a fence check fails CI here rather than in a split-brain
+postmortem. A gate "consults the fence" when the function body
+references an identifier containing ``fence`` (``self._is_fenced()``,
+a ``fence`` callable parameter, ``cluster.fenced``) or reads one via
+``getattr(x, "fenced")`` — a mere string literal like
+``"fencingToken"`` in a payload does not count, because building a
+token is not checking one. Suppress with
+``# analysis: ignore[coordinator-fence]`` plus a justification when a
+duty is fence-exempt by design.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections.abc import Mapping
+
+from pilosa_tpu.analysis.engine import Finding, ModuleInfo
+
+RULE = "coordinator-fence"
+
+#: path suffix -> qualified names of coordinator-authority entry points
+#: that must consult the quorum fence before acting.
+ENTRYPOINTS = {
+    "backup/scheduler.py": {"BackupScheduler.run_once"},
+    "backup/retention.py": {"prune_archive"},
+    "cluster/resize.py": {"ResizeJob.run"},
+    "cluster/scrub.py": {"Scrubber._scrub_fragment"},
+}
+
+
+def _wanted(path: str) -> set[str] | None:
+    for suffix, names in ENTRYPOINTS.items():
+        if path.endswith(suffix):
+            return names
+    return None
+
+
+def _qualified_defs(tree: ast.Module):
+    """(qualname, def-node) for module functions and class methods —
+    one level of class nesting, matching how the roster names them."""
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node.name, node
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield f"{node.name}.{sub.name}", sub
+
+
+def _consults_fence(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and "fence" in node.id.lower():
+            return True
+        if isinstance(node, ast.Attribute) and "fence" in node.attr.lower():
+            return True
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and node.func.id == "getattr" and len(node.args) >= 2
+                and isinstance(node.args[1], ast.Constant)
+                and isinstance(node.args[1].value, str)
+                and "fence" in node.args[1].value.lower()):
+            return True
+    return False
+
+
+def check(mod: ModuleInfo, project: Mapping[str, ModuleInfo]) -> list[Finding]:
+    wanted = _wanted(mod.path)
+    if wanted is None:
+        return []
+    findings: list[Finding] = []
+    seen: set[str] = set()
+    for qualname, fn in _qualified_defs(mod.tree):
+        if qualname not in wanted:
+            continue
+        seen.add(qualname)
+        if not _consults_fence(fn):
+            findings.append(Finding(
+                RULE, mod.path, fn.lineno,
+                f"coordinator duty {qualname} never consults the quorum "
+                f"fence — a partitioned minority coordinator would run it "
+                f"concurrently with the majority's successor (check "
+                f"cluster.fenced / a fence gate before acting)"))
+    for qualname in sorted(wanted - seen):
+        findings.append(Finding(
+            RULE, mod.path, 1,
+            f"coordinator duty {qualname} is on the fence roster but no "
+            f"longer exists in this module — update ENTRYPOINTS in "
+            f"analysis/checkers/coordinator_fence.py so the renamed duty "
+            f"stays gated"))
+    return findings

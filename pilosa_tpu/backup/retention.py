@@ -108,10 +108,20 @@ def _replay_journal(archive: ArchiveStore, stats, logger) -> int:
 
 
 def prune_archive(archive: ArchiveStore, keep_chains: int,
-                  stats=None, logger=None) -> dict:
+                  stats=None, logger=None, fence=None) -> dict:
     """Apply the keep-N-full-chains policy. Returns a summary dict;
     ``aborted`` is set (and nothing was deleted) when a survivor
-    failed its pre-prune verification."""
+    failed its pre-prune verification, or when the ``fence`` gate
+    (a callable; the scheduler passes its quorum-fence check) says a
+    partitioned minority must not delete from a shared archive a
+    majority-side successor may be writing to."""
+    if fence is not None and fence():
+        if stats is not None:
+            stats.count("backup.retention.fenced")
+        if logger is not None:
+            logger.printf("backup retention: skipped while fenced")
+        return {"pruned": 0, "victims": [], "survivors": 0,
+                "stillReferenced": [], "resumed": 0, "aborted": "fenced"}
     resumed = _replay_journal(archive, stats, logger)
     plan = plan_prune(archive, keep_chains)
     victims, survivors = plan["victims"], plan["survivors"]

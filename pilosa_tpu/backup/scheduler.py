@@ -51,6 +51,7 @@ SLOWLOG_KEEP = 16
 RAN = "ran"
 SKIP_UNCHANGED = "skipped-unchanged"
 SKIP_NOT_COORDINATOR = "skipped-not-coordinator"
+SKIP_FENCED = "skipped-fenced"
 SKIP_NOT_DUE = "waiting"
 FAILED = "failed"
 
@@ -116,6 +117,15 @@ class BackupScheduler:
             return True
         coord = self.cluster.coordinator()
         return coord is None or coord.id == self.node_id
+
+    def _is_fenced(self) -> bool:
+        """Fencing gate for the coordinator duty: a fenced coordinator
+        is on the minority side of a partition, where the majority may
+        already have a successor ticking — two schedulers capturing and
+        pruning the same archive is exactly the split-brain retention
+        was not designed to survive."""
+        return (self.cluster is not None
+                and getattr(self.cluster, "fenced", False))
 
     def _current_epochs(self) -> dict:
         epochs = {}
@@ -191,6 +201,14 @@ class BackupScheduler:
             self.last_status = SKIP_NOT_COORDINATOR
             return SKIP_NOT_COORDINATOR
 
+        if self._is_fenced():
+            # Still nominally coordinator, but we cannot see a majority
+            # of the ring: suspend the duty until the fence lifts.
+            self.skipped += 1
+            self._count("backup.scheduler.skippedFenced")
+            self.last_status = SKIP_FENCED
+            return SKIP_FENCED
+
         if not self._adopted:
             self._adopt_latest()
 
@@ -237,7 +255,7 @@ class BackupScheduler:
             try:
                 self.last_prune = prune_archive(
                     self.archive, self.keep_chains, stats=self.stats,
-                    logger=self.logger)
+                    logger=self.logger, fence=self._is_fenced)
             except BaseException as e:
                 # Retention trouble alerts but never fails the backup.
                 self._count("backup.retention.failures")
@@ -290,6 +308,7 @@ class BackupScheduler:
             "consecutiveFailures": self.consecutive_failures,
             "lastStatus": self.last_status,
             "lastError": self.last_error,
+            "fenced": self._is_fenced(),
             "lastSuccessEpoch": self.last_success_wall,
             "lastBackupId": (self.last_manifest or {}).get("id"),
             "runsInChain": self._runs_in_chain,

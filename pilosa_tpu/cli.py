@@ -31,6 +31,10 @@ _DEFAULTS = {
     "replica_n": 1,
     "anti_entropy_interval": 10.0,
     "check_nodes_interval": 5.0,
+    # Quorum fencing: a fenced minority node refuses all external
+    # traffic with 503. True opts queries/exports out of the fence
+    # (stale reads stay available; writes and schema stay fenced).
+    "fence_stale_reads": False,
     # Background integrity scrub: re-verify snapshot CRCs + repair
     # quarantined fragments from replicas (0 disables).
     "scrub_interval": 60.0,
@@ -254,6 +258,8 @@ def cmd_server(args) -> int:
         cfg["hedge_budget_pct"] = args.hedge_budget_pct
     if args.chaos_faults:
         cfg["chaos_faults"] = True
+    if args.fence_stale_reads:
+        cfg["fence_stale_reads"] = True
     if args.compile_cache_dir is not None:
         cfg["compile_cache_dir"] = args.compile_cache_dir
     if args.plan_buckets is not None:
@@ -334,6 +340,8 @@ def cmd_server(args) -> int:
         hedge_delay_ms=float(cfg["hedge_delay_ms"]),
         hedge_budget_pct=float(cfg["hedge_budget_pct"]),
         chaos_faults=bool(cfg["chaos_faults"]),
+        fence_stale_reads=(str(cfg["fence_stale_reads"]).lower()
+                           in ("1", "true", "yes", "on")),
         compile_cache_dir=str(cfg["compile_cache_dir"]) or None,
         plan_buckets=str(cfg["plan_buckets"]) or "pow2",
         result_cache_mb=int(cfg["result_cache_mb"]),
@@ -731,6 +739,8 @@ def cmd_generate_config(args) -> int:
           'replica-n = 1\n'
           'anti-entropy-interval = 10.0\n'
           'check-nodes-interval = 5.0\n'
+          '# serve stale reads while quorum-fenced (writes stay fenced)\n'
+          'fence-stale-reads = false\n'
           '# background integrity scrub cadence, seconds (0 disables)\n'
           'scrub-interval = 60.0\n'
           '# unattended backups: cadence (0 disables) + archive\n'
@@ -888,6 +898,9 @@ def main(argv: list[str] | None = None) -> int:
                    help="fixed hedge delay, ms (0 = measured p95)")
     s.add_argument("--hedge-budget-pct", type=float, default=None,
                    help="hedges as a %% of primary legs (default 5)")
+    s.add_argument("--fence-stale-reads", action="store_true",
+                   help="serve queries/exports while quorum-fenced "
+                        "(stale reads; writes and schema stay fenced)")
     s.add_argument("--chaos-faults", action="store_true",
                    help="mount POST /internal/fault (chaos testing "
                         "only; never on production nodes)")

@@ -109,6 +109,36 @@ class LocalClient:
         self.slow: dict[str, float] = {}
         #: optional BreakerRegistry, same contract as the HTTP client's.
         self.breakers = None
+        #: directed partition faults: (src_id, dst_id) -> mode ("drop" |
+        #: "timeout"). Unlike ``down`` (a node dead for EVERYONE), a
+        #: pair fault cuts one link in one direction — the asymmetric-
+        #: partition fault the SWIM indirect probes exist for. Enforced
+        #: by the per-node bound views ``bind()`` hands out; the shared
+        #: unbound client has no source identity and bypasses it.
+        self.pair_faults: dict[tuple[str, str], str] = {}
+
+    def bind(self, src_id: str) -> "BoundLocalClient":
+        """A view of this client with a source identity, so outbound
+        calls can honor (src, dst) pair faults."""
+        return BoundLocalClient(self, src_id)
+
+    def set_pair_fault(self, src_id: str, dst_id: str,
+                       mode: str = "drop") -> None:
+        if mode not in ("drop", "timeout"):
+            raise ValueError(f"unknown pair fault mode {mode!r}")
+        self.pair_faults[(src_id, dst_id)] = mode
+
+    def clear_pair_faults(self) -> None:
+        self.pair_faults.clear()
+
+    def check_pair(self, src_id: str, dst_id: str) -> None:
+        """Raise ConnectionError when the src->dst link is faulted.
+        In-process "timeout" doesn't sleep (tests stay fast) — both
+        modes surface as the ConnectionError a blown socket would."""
+        mode = self.pair_faults.get((src_id, dst_id))
+        if mode is not None:
+            raise ConnectionError(
+                f"partition fault ({mode}): link {src_id}->{dst_id} is down")
 
     def register(self, node_id: str, server: Any) -> None:
         self.peers[node_id] = server
@@ -222,6 +252,19 @@ class LocalClient:
         """Liveness probe (the /version check of confirmNodeDown)."""
         self._peer(node)
 
+    def indirect_probe(self, via, target) -> bool:
+        """SWIM indirect confirmation: ask intermediary ``via`` whether
+        IT can reach ``target``. Models the two hops the HTTP path
+        takes: us->via (via must be up), then via->target (via's own
+        link faults and target's liveness apply)."""
+        try:
+            self._peer(via)
+            self.check_pair(via.id, target.id)
+            self._peer(target)
+        except ConnectionError:
+            return False
+        return True
+
     def send_import(self, node, index, field, shard, rows=None, cols=None,
                     values=None, timestamps=None, clear=False):
         """Field-level import routed to an owning node (api.go:967)."""
@@ -261,3 +304,35 @@ class LocalClient:
 
     def attr_block_data(self, node, index, field, block):
         return self._peer(node).handle_attr_block_data(index, field, block)
+
+
+class BoundLocalClient:
+    """A LocalClient view carrying a source node identity. Every method
+    whose first positional argument is a peer Node first checks the
+    (src, dst) pair-fault table, then delegates — so the harness can
+    cut individual links (symmetric or one-way) while the shared
+    registry/down/slow state stays in one place.
+
+    For ``indirect_probe(via, target)`` the checked link is src->via
+    (reaching the INTERMEDIARY); the via->target hop is the base
+    client's job — that is exactly what makes an asymmetric partition
+    survivable: src can't see target, but via can."""
+
+    def __init__(self, base: LocalClient, src_id: str):
+        self._base = base
+        self.src_id = src_id
+
+    def __getattr__(self, name):
+        attr = getattr(self._base, name)
+        if not callable(attr):
+            return attr
+
+        def bound(*args, **kwargs):
+            if args and isinstance(args[0], Node):
+                self._base.check_pair(self.src_id, args[0].id)
+            return attr(*args, **kwargs)
+
+        return bound
+
+    def __repr__(self):
+        return f"BoundLocalClient({self.src_id!r})"

@@ -125,6 +125,25 @@ class Cluster:
         #: on. Observed load only — no coordination with peers.
         self._inflight: dict[str, int] = {}
         self._inflight_lock = threading.Lock()
+        #: quorum self-fence: True while this node's liveness sweep
+        #: cannot reach a strict majority of the ring. A fenced node
+        #: 503s non-internal writes (reads too, unless the operator
+        #: opts into staleness below) and suspends coordinator duties
+        #: — the partitioned-minority half of split-brain safety.
+        self.fenced = False
+        #: explicit staleness knob: serve reads (query/export) while
+        #: fenced. Off by default — a fenced minority's data may be
+        #: arbitrarily stale, so the operator must opt in.
+        self.fence_stale_reads = False
+        #: fn() called on the fenced->unfenced transition (regained
+        #: majority): ServerNode wires this to a dirty-sync so a
+        #: rejoining minority repairs against the majority's writes.
+        self.on_unfence: Callable | None = None
+        #: per-peer failure-detector observations for /debug/membership:
+        #: node id -> {"lastProbeOk", "lastProbeAt", "indirect", ...}.
+        #: Written only by check_nodes (one sweep at a time), read by
+        #: the debug handler; plain dict swaps keep it race-benign.
+        self.membership_log: dict[str, dict] = {}
 
     #: shared fan-out pool size — bounds total in-flight remote
     #: sub-queries, not per-query fan-out.
@@ -284,6 +303,57 @@ class Cluster:
 
     def set_state(self, state: str) -> None:
         self.state = state
+
+    # -- quorum fencing ----------------------------------------------------
+
+    def observe_quorum(self, reachable: int, total: int | None = None) -> bool:
+        """Feed one liveness sweep's reachability tally (self + peers
+        answering direct or indirect probes) into the fence. Fence when
+        the reachable set is not a strict majority of the ring; un-fence
+        (and fire ``on_unfence`` -> dirty-sync) when majority returns.
+
+        Rings smaller than 3 are exempt: with 2 nodes a single peer loss
+        would fence BOTH sides (no majority exists), turning every
+        routine degraded-replica situation into an outage. Returns the
+        new fenced state."""
+        if total is None:
+            total = len(self.nodes)
+        has_quorum = total < 3 or 2 * reachable > total
+        if self.fenced and has_quorum:
+            self.fenced = False
+            self.stats.count("cluster.unfenced")
+            hook = self.on_unfence
+            if hook is not None:
+                try:
+                    hook()
+                except Exception:
+                    pass  # rejoin repair is best-effort, never fatal
+        elif not self.fenced and not has_quorum:
+            self.fenced = True
+            self.stats.count("cluster.fenced")
+        return self.fenced
+
+    def fencing_token(self) -> int:
+        """Monotonic fencing token: the topology version. Every
+        committed resize and every coordinator takeover bumps it, so a
+        deposed coordinator's in-flight broadcasts carry a token older
+        than what its peers have already adopted."""
+        return self.topology_version
+
+    def check_fencing_token(self, message: dict) -> bool:
+        """Receiver-side token check for coordinator-initiated internal
+        messages (resize-begin, index-dirty, ...). A token older than
+        our topology version means the sender was coordinator of a ring
+        we have since moved past — reject. Messages without a token are
+        accepted (peer-to-peer traffic and old senders don't carry
+        one)."""
+        token = message.get("fencingToken")
+        if token is None:
+            return True
+        if int(token) < self.topology_version:
+            self.stats.count("cluster.staleTokenRejected")
+            return False
+        return True
 
     # -- placement ---------------------------------------------------------
 
@@ -584,8 +654,12 @@ class Cluster:
                     # instance-level query_node overrides (test
                     # fault-injection hooks) must keep intercepting the
                     # fan-out, so it only runs on a pristine client.
+                    # Hooks land on the shared base when the client is a
+                    # bound per-node view, so check there too.
                     meta = getattr(self.client, "query_node_meta", None)
-                    if meta is None or "query_node" in self.client.__dict__:
+                    hooked = getattr(self.client, "_base",
+                                     self.client).__dict__
+                    if meta is None or "query_node" in hooked:
                         return self.client.query_node(
                             node, idx.name, pql, node_shards, remote=True)[0]
                     results, epochs = meta(node, idx.name, pql, node_shards,

@@ -100,7 +100,11 @@ class DirtyBroadcaster:
         for name in sorted(pending):
             shards = pending[name]
             msg = {"type": "index-dirty", "index": name,
-                   "sender": self.cluster.local_id}
+                   "sender": self.cluster.local_id,
+                   # Receivers drop dirty coordination from a sender
+                   # whose topology view is stale (deposed coordinator
+                   # still flushing across a healed partition).
+                   "fencingToken": self.cluster.fencing_token()}
             if shards is not None:
                 # Shard detail lets peers bump ONLY the mutated shards
                 # (their plans elsewhere keep cached results), and the

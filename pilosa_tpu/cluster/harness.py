@@ -120,6 +120,8 @@ class ClusterNode:
             from pilosa_tpu.cluster.resize import deliver_completion
             deliver_completion(message)
         elif t == "index-dirty":
+            if not self.cluster.check_fencing_token(message):
+                return  # stale coordinator's dirty coordination
             from pilosa_tpu.cluster.dirty import apply_index_dirty
             apply_index_dirty(self.holder, message,
                               self.executor.remote_epochs)
@@ -307,11 +309,15 @@ class LocalCluster:
                  for i in range(n)]
         self.nodes: list[ClusterNode] = []
         for i in range(n):
+            # Each node talks through a BOUND view of the shared
+            # transport so directed pair faults (partition drills) apply
+            # to its outbound traffic specifically.
             cluster = Cluster(local_id=f"node{i}",
                               nodes=[Node(id=m.id, uri=m.uri,
                                           is_coordinator=m.is_coordinator)
                                      for m in nodes],
-                              replica_n=replica_n, client=self.client)
+                              replica_n=replica_n,
+                              client=self.client.bind(f"node{i}"))
             cluster.set_state(STATE_NORMAL)
             planner = planner_factory(i) if planner_factory else None
             cn = ClusterNode(f"node{i}", cluster, planner=planner,
@@ -373,13 +379,13 @@ class LocalCluster:
                        for n in coord.cluster.nodes]
         c = Cluster(node_id, member_list + [new_member],
                     replica_n=coord.cluster.replica_n,
-                    client=self.client)
+                    client=self.client.bind(node_id))
         c.set_state(STATE_STARTING)
         cn = ClusterNode(node_id, c)
         cn.apply_schema(coord.holder.schema())
         self.client.register(node_id, cn)
         self.nodes.append(cn)
-        job = ResizeJob(coord.cluster, coord.holder, self.client)
+        job = ResizeJob(coord.cluster, coord.holder, coord.cluster.client)
         state = job.run([Node(id=n.id, uri=n.uri,
                               is_coordinator=n.is_coordinator)
                          for n in coord.cluster.nodes] + [new_member])
@@ -400,7 +406,7 @@ class LocalCluster:
                 for n in coord.cluster.nodes if n.id != node_id]
         if len(keep) == len(coord.cluster.nodes):
             raise LookupError(f"{node_id} not in ring")
-        job = ResizeJob(coord.cluster, coord.holder, self.client)
+        job = ResizeJob(coord.cluster, coord.holder, coord.cluster.client)
         state = job.run(keep)
         if state != "DONE":
             raise RuntimeError(f"remove_node resize ended {state}")
@@ -432,3 +438,43 @@ class LocalCluster:
     def fast(self, node_id: str) -> None:
         """Heal a slow-peer fault."""
         self.client.slow.pop(node_id, None)
+
+    # -- partition faults --------------------------------------------------
+
+    def _node_ids(self, group) -> set[str]:
+        return {m if isinstance(m, str) else self.nodes[m].id
+                for m in group}
+
+    def partition(self, group, mode: str = "drop") -> None:
+        """Symmetric network partition: every link between ``group``
+        (node ids or indices) and the rest of the ring is cut, BOTH
+        directions. Nodes inside a side still see each other — exactly
+        the split-brain the quorum fence exists for. Unlike ``down``,
+        membership state is untouched: each side's failure detector
+        must discover the split itself."""
+        side = self._node_ids(group)
+        rest = {cn.id for cn in self.nodes} - side
+        for a in side:
+            for b in rest:
+                self.client.set_pair_fault(a, b, mode)
+                self.client.set_pair_fault(b, a, mode)
+
+    def block_link(self, src, dst, mode: str = "drop") -> None:
+        """Asymmetric fault: cut ONLY src->dst. dst can still reach
+        src, and everyone else sees both — the case SWIM indirect
+        probes keep from false-positiving into node-down churn."""
+        (src_id,) = self._node_ids([src])
+        (dst_id,) = self._node_ids([dst])
+        self.client.set_pair_fault(src_id, dst_id, mode)
+
+    def heal_partition(self) -> None:
+        """Heal every partition fault (symmetric and asymmetric)."""
+        self.client.clear_pair_faults()
+
+    def check_all_nodes(self, discover: bool = False) -> None:
+        """One failure-detector sweep on every node (deterministic
+        drills run the detector by hand instead of on timers)."""
+        from pilosa_tpu.cluster.resize import check_nodes
+        for cn in self.nodes:
+            check_nodes(cn.cluster, cn.cluster.client, retries=1,
+                        discover=discover)

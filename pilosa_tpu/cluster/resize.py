@@ -100,7 +100,12 @@ def apply_resize_begin(cluster: Cluster, message: dict) -> None:
     table so every write fanned out by THIS member also lands on the
     shard's future owners. Replaces any stale table — one job at a time
     is enforced at the coordinator's resize gate, so a new begin means
-    the previous job is dead."""
+    the previous job is dead. A begin carrying a STALE fencing token is
+    from a coordinator deposed by a takeover/commit we already adopted:
+    installing its table would dual-apply writes toward a ring that
+    will never commit, so it is rejected outright."""
+    if not cluster.check_fencing_token(message):
+        return
     from pilosa_tpu.cluster.migration import MigrationTable
     cluster.migration = MigrationTable.from_message(cluster, message)
 
@@ -646,6 +651,13 @@ class ResizeJob:
         return sorted(out)
 
     def run(self, new_nodes: list[Node]) -> str:
+        # Coordinator duty gate: a fenced coordinator is (by its own
+        # failure detector's evidence) on the minority side of a
+        # partition — the majority may be electing a successor right
+        # now, and a resize begun here would race its commits.
+        if getattr(self.cluster, "fenced", False):
+            self.state = "FAILED"
+            return self.state
         old_view = Cluster("_old", [Node(id=n.id, uri=n.uri)
                                     for n in self.cluster.nodes],
                            replica_n=self.cluster.replica_n,
@@ -669,7 +681,10 @@ class ResizeJob:
                  "coordinator": coord_json,
                  "nodes": [n.to_json() for n in new_nodes],
                  "replicaN": self.cluster.replica_n,
-                 "partitionN": self.cluster.partition_n}
+                 "partitionN": self.cluster.partition_n,
+                 # Fencing token: peers reject this begin if they have
+                 # already adopted a newer topology (deposed coordinator).
+                 "fencingToken": self.cluster.fencing_token()}
         # Per-target completion tracking (reference
         # ResizeInstructionComplete + per-node map, cluster.go:1315,
         # :1413-1438): the new topology is committed ONLY after every
@@ -884,6 +899,7 @@ def check_nodes(cluster: Cluster, client, retries: int = 2,
     membership push/pull (one GET per live peer) — callers on a tight
     sweep cadence can run it every few sweeps."""
     changed = []
+    reachable = 1  # self
     for node in list(cluster.nodes):
         if node.id == cluster.local_id:
             continue
@@ -896,6 +912,7 @@ def check_nodes(cluster: Cluster, client, retries: int = 2,
             except ConnectionError:
                 continue
         direct_alive = alive
+        indirect_verdicts: dict[str, bool] = {}
         # Indirect confirmation only for a SUSPECT transition (a peer
         # we thought was up going unreachable) — confirming an
         # already-DOWN corpse every sweep would put constant probe load
@@ -923,12 +940,18 @@ def check_nodes(cluster: Cluster, client, retries: int = 2,
                             return client.indirect_probe(via, node)
                         except (ConnectionError, OSError, RuntimeError):
                             return False
-                    alive = any(pool.map(ask, picked))
+                    verdicts = list(pool.map(ask, picked))
+                indirect_verdicts = {via.id: ok
+                                     for via, ok in zip(picked, verdicts)}
+                alive = any(verdicts)
             elif picked:
+                ok = False
                 try:
-                    alive = client.indirect_probe(picked[0], node)
+                    ok = bool(client.indirect_probe(picked[0], node))
                 except (ConnectionError, OSError, RuntimeError):
                     pass
+                indirect_verdicts = {picked[0].id: ok}
+                alive = alive or ok
         # Membership push/pull only over a DIRECTLY-reachable link: a
         # peer alive only via indirect probe is unreachable from here,
         # and a full-timeout GET at it would stall the whole sweep.
@@ -953,16 +976,33 @@ def check_nodes(cluster: Cluster, client, retries: int = 2,
         live = next((n for n in cluster.nodes if n.id == node.id), None)
         if live is None:
             continue
+        if alive:
+            reachable += 1
+        # Per-peer observation record for GET /debug/membership: what
+        # THIS node's detector last saw, not a consensus view.
+        cluster.membership_log[live.id] = {
+            "state": live.state,
+            "lastProbeOk": alive,
+            "lastProbeDirect": direct_alive,
+            "lastProbeAt": time.time(),
+            "indirect": indirect_verdicts,
+        }
         if alive and live.state == "DOWN":
             live.state = "READY"
             changed.append(live.id)
+            cluster.stats.count("cluster.nodeUp")
             cluster._emit(EVENT_UPDATE, live.id, "READY")
         elif not alive and live.state != "DOWN":
             live.state = "DOWN"
             changed.append(live.id)
+            cluster.stats.count("cluster.nodeDown")
             cluster._emit(EVENT_UPDATE, live.id, "DOWN")
     if changed:
         cluster._update_state()
+    # Quorum self-fence: this sweep IS our view of the ring — fence
+    # when the reachable set (self + direct/indirect-alive peers) is
+    # not a strict majority, un-fence when majority returns.
+    cluster.observe_quorum(reachable, len(cluster.nodes))
     _recover_stuck_resizing(cluster, client)
     return changed
 

@@ -157,8 +157,12 @@ class Scrubber:
             if res:
                 out["repaired"] += 1
                 out["released"] += 1
+        # While fenced, leave the dirty marks in place instead of
+        # draining them into scrubs that the fencing gate below will
+        # refuse — they are the rejoin repair's worklist.
         dirty = (self.cluster.dirty_shards.drain()
-                 if self.cluster is not None else set())
+                 if self.cluster is not None
+                 and not getattr(self.cluster, "fenced", False) else set())
         for index, shard in sorted(dirty):
             idx = self.holder.index(index)
             if idx is None:
@@ -192,6 +196,14 @@ class Scrubber:
         replicas (majority vote INCLUDING the local copy — no corruption
         evidence here, just a suspected missed write)."""
         index, field, view, shard = key
+        # Fencing gate: push-repair from a fenced minority would
+        # overwrite the majority's newer writes with our stale copy the
+        # moment the partition heals enough to reach one replica. A
+        # fenced node keeps its dirty marks and repairs after rejoin.
+        if self.cluster is not None and getattr(self.cluster, "fenced",
+                                                False):
+            self._count("integrity.scrubFenced")
+            return False
         if not self._owns(index, shard):
             return False
         frag = self.holder.fragment(index, field, view, shard)

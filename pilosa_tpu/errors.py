@@ -74,6 +74,20 @@ class ApiMethodNotAllowedError(PilosaError):
     message = "api method not allowed"
 
 
+class ClusterFencedError(PilosaError):
+    """This node cannot reach a majority of the ring: it has fenced
+    itself and refuses non-internal traffic (503 + Retry-After on the
+    HTTP surface) so a partitioned minority never accepts writes the
+    majority will skip. Reads may be re-enabled behind the explicit
+    stale-reads knob (Cluster.fence_stale_reads)."""
+
+    message = "node is fenced: cannot reach a quorum of the cluster"
+
+    #: seconds a client should wait before retrying — one failure-
+    #: detector sweep is the soonest the fence can possibly lift.
+    retry_after = 5.0
+
+
 class NameError_(PilosaError):
     message = "invalid name"
 

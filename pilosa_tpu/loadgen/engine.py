@@ -18,6 +18,7 @@ into full cost profiles via ``/debug/queries/<trace-id>``.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -218,10 +219,16 @@ def _chaos_loop(sc: Scenario, target, stop: threading.Event,
             ok = target.dr_backup()
         elif act.action == "dr_destroy_data":
             ok = target.dr_destroy_data(act.node)
+        elif act.action == "partition":
+            ok = target.partition(act.group, act.mode, act.value)
+        elif act.action == "heal_partition":
+            ok = target.heal_partition()
         else:
             ok = target.remove_node(act.node)
         applied.append({"atS": act.at_s, "action": act.action,
-                        "node": act.node, "value": act.value, "ok": ok})
+                        "node": act.node, "value": act.value,
+                        "group": list(act.group), "mode": act.mode,
+                        "ok": ok})
 
 
 # -- DR drill ------------------------------------------------------------
@@ -339,6 +346,89 @@ def _dr_epilogue(sc: Scenario, target, env: dict) -> dict:
     return dr
 
 
+# -- partition drill -----------------------------------------------------
+
+
+def _partition_epilogue(sc: Scenario, target) -> dict:
+    """After a split-brain drill: heal whatever is still cut, drive
+    failure-detector sweeps until every node un-fences, force a repair
+    pass, and prove the replicas converged bit-identically. Returns
+    the report's numeric ``partition`` section."""
+    healed = target.heal_partition()
+    nodes = getattr(target, "nodes", None)   # managed mode only
+
+    def sweep():
+        if nodes is None:
+            return
+        from pilosa_tpu.cluster.resize import check_nodes
+        for n in nodes:
+            if n.cluster is None:
+                continue
+            try:
+                check_nodes(n.cluster, n.cluster.client, retries=1,
+                            discover=False)
+            except Exception:
+                pass
+
+    still_fenced = len(target.base_urls)
+    deadline = time.time() + 30.0
+    while time.time() < deadline:
+        sweep()
+        still_fenced = 0
+        for i in range(len(target.base_urls)):
+            try:
+                doc = json.loads(target._get(
+                    target.base_urls[i] + "/debug/membership"))
+            except Exception:
+                still_fenced += 1
+                continue
+            if doc.get("fenced"):
+                still_fenced += 1
+        if still_fenced == 0:
+            break
+        time.sleep(0.3)
+
+    out: dict = {"healedOk": 1 if healed else 0,
+                 "stillFenced": still_fenced}
+
+    names = ("cluster.fenced", "cluster.unfenced",
+             "cluster.staleTokenRejected", "cluster.nodeDown",
+             "cluster.nodeUp", "backup.scheduler.skippedFenced")
+    sums = dict.fromkeys(names, 0.0)
+    for i in range(len(target.base_urls)):
+        try:
+            dvars = target.debug_vars(i)
+        except Exception:
+            continue
+        for n in names:
+            sums[n] += _counter_sum(dvars, n)
+    out["fencedTransitions"] = int(sums["cluster.fenced"])
+    out["unfencedTransitions"] = int(sums["cluster.unfenced"])
+    out["staleTokenRejected"] = int(sums["cluster.staleTokenRejected"])
+    out["nodeDownEvents"] = int(sums["cluster.nodeDown"])
+    out["nodeUpEvents"] = int(sums["cluster.nodeUp"])
+    out["schedulerSkippedFenced"] = int(
+        sums["backup.scheduler.skippedFenced"])
+
+    # Convergence: after the repair passes every fragment's replicas
+    # must hold bit-identical content — a healed partition that leaves
+    # divergent replicas is the drill's core failure mode.
+    if nodes is not None:
+        for _ in range(2):
+            for n in nodes:
+                try:
+                    n._sync_schema()
+                    if n.syncer is not None:
+                        n.syncer.sync_holder()
+                except Exception:
+                    pass
+        digests = target.fragment_digest()
+        out["fragments"] = len(digests)
+        out["mismatchedFragments"] = sum(
+            1 for d in digests.values() if len(d) > 1)
+    return out
+
+
 # -- counters ------------------------------------------------------------
 
 
@@ -398,6 +488,9 @@ def run_scenario(sc: Scenario, target=None, out: str | None = None,
 
     owned = target is None
     dr_env = None
+    has_partition = any(a.action in ("partition", "heal_partition")
+                        for a in sc.chaos)
+    part_root = None
     if sc.dr is not None:
         if not owned:
             raise ValueError("a DR drill scenario needs a managed "
@@ -407,10 +500,20 @@ def run_scenario(sc: Scenario, target=None, out: str | None = None,
         node_opts = dict(sc.node_opts)
         if dr_env is not None:
             node_opts.update(dr_env["node_opts"])
+        elif has_partition:
+            # Partition drills need durable nodes (the epilogue's
+            # fragment-digest convergence check reads the stores) and,
+            # when the scenario enables scheduled backups, a shared
+            # directory archive for the coordinator to capture into.
+            import tempfile
+            part_root = tempfile.mkdtemp(prefix="loadgen-partition-")
+            if float(node_opts.get("backup_interval", 0.0) or 0.0) > 0:
+                node_opts.setdefault(
+                    "archive_url", os.path.join(part_root, "archive"))
         target = ManagedTarget(
             n_nodes=sc.nodes, replica_n=sc.replica_n,
             node_opts=node_opts,
-            data_root=dr_env["data_root"] if dr_env else None)
+            data_root=(dr_env["data_root"] if dr_env else part_root))
     stats = MemoryStats()
     ops = build_ops(sc)
     try:
@@ -482,11 +585,13 @@ def run_scenario(sc: Scenario, target=None, out: str | None = None,
             t.join(timeout=30)
         after = _cluster_counters(target)
 
+        part_section = (_partition_epilogue(sc, target)
+                        if has_partition else None)
         dr_section = (_dr_epilogue(sc, target, dr_env)
                       if dr_env is not None else None)
         report = _build_report(sc, target, stats, ops, elapsed, dispatched,
                                max_lag, before, after, ingest_totals,
-                               chaos_applied, dr_section)
+                               chaos_applied, dr_section, part_section)
     finally:
         if owned:
             target.close()
@@ -494,6 +599,9 @@ def run_scenario(sc: Scenario, target=None, out: str | None = None,
             import shutil
             dr_env["srv"].close()
             shutil.rmtree(dr_env["data_root"], ignore_errors=True)
+        if part_root is not None:
+            import shutil
+            shutil.rmtree(part_root, ignore_errors=True)
     errs = validate_report(report)
     if errs:
         raise RuntimeError(f"SLO report failed its own schema: {errs}")
@@ -507,7 +615,7 @@ def run_scenario(sc: Scenario, target=None, out: str | None = None,
 
 def _build_report(sc: Scenario, target, stats, ops, elapsed, dispatched,
                   max_lag, before, after, ingest_totals, chaos_applied,
-                  dr=None):
+                  dr=None, partition=None):
     delta = {k: after[k] - before[k] for k in after}
     server_hists = _server_class_hists(target)
 
@@ -636,5 +744,9 @@ def _build_report(sc: Scenario, target, stats, ops, elapsed, dispatched,
         "dr": (None if dr is None else dict(
             dr, failedQueries=int(sum(per_class[c]["counts"]["error"]
                                       for c in per_class)))),
+        "partition": (None if partition is None else dict(
+            partition,
+            failedQueries=int(sum(per_class[c]["counts"]["error"]
+                                  for c in per_class)))),
         "exemplars": exemplars,
     }

@@ -67,18 +67,27 @@ class ChaosAction:
     resize grow), ``remove_node`` (live resize shrink), ``dr_backup``
     (force one scheduled-backup cycle now), ``dr_destroy_data``
     (resize a member out and destroy its data directory — the DR
-    drill's disaster)."""
+    drill's disaster), ``partition`` (cut the network between
+    ``group`` — node indices — and the rest of the ring; ``mode`` is
+    ``drop``/``timeout`` for a symmetric cut or ``oneway`` for an
+    asymmetric link where only the group's outbound traffic is lost),
+    ``heal_partition`` (clear every injected partition fault)."""
 
     at_s: float
     action: str
     node: int = 1           # index into the target's node list
     value: float = 0.0
+    group: list[int] = field(default_factory=list)  # partition side
+    mode: str = "drop"      # partition flavor: drop | timeout | oneway
 
     def __post_init__(self):
         if self.action not in ("slow_peer", "heal_peer",
                                "add_node", "remove_node",
-                               "dr_backup", "dr_destroy_data"):
+                               "dr_backup", "dr_destroy_data",
+                               "partition", "heal_partition"):
             raise ValueError(f"unknown chaos action {self.action!r}")
+        if self.mode not in ("drop", "timeout", "oneway"):
+            raise ValueError(f"unknown partition mode {self.mode!r}")
 
 
 @dataclass

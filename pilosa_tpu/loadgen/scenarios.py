@@ -199,6 +199,53 @@ def dr_drill() -> Scenario:
     )
 
 
+def partition_drill() -> Scenario:
+    """Split-brain under traffic: five nodes, replica 3, steady mixed
+    load while the network is cut three ways in sequence — a 2-node
+    minority island (the majority keeps serving, the minority fences
+    and 503s), a cut that strands the COORDINATOR in the minority (its
+    backup scheduler must suspend the duty: skipped-fenced, not a
+    second capture racing the majority), and an asymmetric one-way
+    link (the isolated node fences itself; nobody false-positives it
+    DOWN because indirect probes still reach it). Each cut heals
+    before the next. The engine's partition epilogue then proves every
+    node un-fenced, forces a repair pass, and requires every
+    fragment's replicas to be bit-identical — a healed split that
+    leaves divergent replicas fails the drill."""
+    return Scenario(
+        name="partition_drill", seed=61, duration_s=18.0, rate=25.0,
+        nodes=5, replica_n=3, shards=6, rows=32, density=0.004,
+        tenants=10, tenant_s=1.2,
+        legs=[QueryLeg(name="dashboard", weight=4.0, kind="dashboard",
+                       qos_class="interactive", population=16),
+              QueryLeg(name="adhoc", weight=2.0, kind="adhoc",
+                       qos_class="batch", population=32, no_cache=True),
+              QueryLeg(name="bsi_agg", weight=1.0, kind="bsi",
+                       qos_class="batch", population=8)],
+        chaos=[ChaosAction(at_s=2.5, action="partition", group=[3, 4]),
+               ChaosAction(at_s=6.5, action="heal_partition"),
+               ChaosAction(at_s=8.5, action="partition", group=[0, 1],
+                           mode="timeout", value=150.0),
+               ChaosAction(at_s=12.0, action="heal_partition"),
+               ChaosAction(at_s=13.0, action="partition", group=[1],
+                           mode="oneway"),
+               ChaosAction(at_s=15.5, action="heal_partition")],
+        # The failure detector must actually sweep (fencing hangs off
+        # it); breakers + short deadlines keep majority-side legs into
+        # the dead island from stalling the client pool; the 0.5s
+        # backup cadence guarantees scheduler ticks land inside the
+        # coordinator's fenced window even after detection latency
+        # (the engine supplies a directory archive when backups are on
+        # and the scenario has partitions).
+        node_opts={"qos_max_concurrent": 8,
+                   "check_nodes_interval": 0.5,
+                   "anti_entropy_interval": 4.0,
+                   "breaker_threshold": 3, "breaker_cooldown": 1.0,
+                   "backup_interval": 0.5, "backup_full_every": 1,
+                   "backup_keep_chains": 2},
+    )
+
+
 SCENARIOS = {
     "smoke": smoke,
     "smoke3": smoke3,
@@ -208,6 +255,7 @@ SCENARIOS = {
     "ingest_under_query": ingest_under_query,
     "elastic": elastic,
     "dr_drill": dr_drill,
+    "partition_drill": partition_drill,
 }
 
 

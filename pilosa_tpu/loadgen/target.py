@@ -149,6 +149,62 @@ class _HTTPTargetBase:
     def heal_peer(self, node: int) -> bool:
         return self.slow_peer(node, 0.0)
 
+    def _node_ids(self) -> list[str] | None:
+        """Cluster node id per base URL (via /debug/membership), or
+        None when any node can't answer — partition faults address
+        peers by id, not by URL."""
+        ids = []
+        for u in self.base_urls:
+            try:
+                doc = json.loads(self._get(f"{u}/debug/membership"))
+            except (urllib.error.URLError, OSError, ValueError):
+                return None
+            if not doc.get("localId"):
+                return None
+            ids.append(doc["localId"])
+        return ids
+
+    def partition(self, group: list[int], mode: str = "drop",
+                  delay_ms: float = 0.0) -> bool:
+        """Cut the network between ``group`` (node indices) and the
+        rest. ``drop``/``timeout`` fault both directions; ``oneway``
+        faults only the group's outbound links — the asymmetric case
+        where A can't reach B but B still reaches A."""
+        ids = self._node_ids()
+        if ids is None:
+            return False
+        n = len(self.base_urls)
+        side = {i % n for i in group}
+        fault_mode = "drop" if mode == "oneway" else mode
+        ok = True
+        for i, url in enumerate(self.base_urls):
+            if i in side:
+                peers = [ids[j] for j in range(n) if j not in side]
+            elif mode != "oneway":
+                peers = [ids[j] for j in sorted(side)]
+            else:
+                continue
+            if not peers:
+                continue
+            try:
+                self._post(f"{url}/internal/fault",
+                           json.dumps({"partition": {
+                               "peers": peers, "mode": fault_mode,
+                               "delayMs": delay_ms}}))
+            except (urllib.error.URLError, OSError):
+                ok = False
+        return ok
+
+    def heal_partition(self) -> bool:
+        ok = True
+        for url in self.base_urls:
+            try:
+                self._post(f"{url}/internal/fault",
+                           json.dumps({"healPartition": True}))
+            except (urllib.error.URLError, OSError):
+                ok = False
+        return ok
+
     def add_node(self) -> bool:
         return False
 

@@ -28,6 +28,7 @@ from pilosa_tpu.core.index import IndexOptions
 from pilosa_tpu.core.row import Row
 from pilosa_tpu.errors import (
     ApiMethodNotAllowedError,
+    ClusterFencedError,
     FieldNotFoundError,
     FragmentNotFoundError,
     IndexNotFoundError,
@@ -74,11 +75,36 @@ class API:
     #: PTS1 import stream now.)
     _METHODS_RESIZING = frozenset({"resize-abort"})
 
-    def _validate(self, method: str) -> None:
+    #: read-only methods a FENCED node may keep serving when the
+    #: operator opts into staleness (Cluster.fence_stale_reads) — a
+    #: minority partition's data can be arbitrarily behind the majority.
+    _METHODS_FENCED_READS = frozenset({"query", "export-csv"})
+
+    def _validate(self, method: str, internal: bool = False) -> None:
         if self.cluster is None:
             return  # standalone node: always NORMAL
+        if getattr(self.cluster, "fenced", False) and not internal:
+            # Quorum fence: this node cannot see a majority of the ring,
+            # so accepting client traffic risks split-brain writes the
+            # majority will never learn about. Internal traffic
+            # (peer-forwarded imports, remote query legs, repair pushes
+            # from the majority) is exempt — it is how the fence heals.
+            if not (self.cluster.fence_stale_reads
+                    and method in self._METHODS_FENCED_READS):
+                raise ClusterFencedError(
+                    f"api method {method} refused: node is fenced "
+                    f"(no quorum)")
         state = self.cluster.state
         if state in (STATE_NORMAL, STATE_DEGRADED):
+            return
+        if (internal and method in self._METHODS_FENCED_READS
+                and state != STATE_REMOVED):
+            # A partitioned minority sees >= replicaN peers DOWN and
+            # sits in STARTING by the ladder below — but the majority's
+            # detector may already have healed and resumed fanning read
+            # legs here, and our local fragments are still its replica
+            # copies. Internal reads stay up; writes stay gated (a
+            # joiner's grant is the migration-table carve-out below).
             return
         if state == STATE_RESIZING and method in self._METHODS_RESIZING:
             return
@@ -127,7 +153,10 @@ class API:
             # old-ring placement doesn't know joiners exist.
             pass
         else:
-            self._validate("query")
+            # Remote legs are coordinator-internal: a fenced node must
+            # still answer the majority's fan-out (it may be THEIR
+            # replica), only client-facing traffic is gated.
+            self._validate("query", internal=remote)
         opt = ExecOptions(remote=remote, column_attrs=column_attrs,
                           exclude_row_attrs=exclude_row_attrs,
                           exclude_columns=exclude_columns)

@@ -301,6 +301,11 @@ class HTTPInternalClient:
         #: Peers that rejected the PTS1 import stream (older version);
         #: they get per-batch /internal/import requests instead.
         self._stream_unsupported: set[str] = set()
+        #: Optional PartitionFaults (cluster.faults): chaos-injected
+        #: outbound link cuts, consulted before any wire traffic so a
+        #: drill's "partition" behaves like the network it models —
+        #: drop fails the dial, timeout burns the delay first.
+        self.faults = None
         self._leg_local = threading.local()
         # Verification policy (reference tls.skip-verify,
         # server/config.go): with a CA bundle, verify by default; the
@@ -317,6 +322,19 @@ class HTTPInternalClient:
 
     def _url(self, node: Node, path: str) -> str:
         return f"{node.uri}{path}"
+
+    def _check_fault(self, node: Node) -> None:
+        """Injected-partition gate: raise ConnectionError (feeding the
+        breaker, like any real connection failure) when the link to
+        this peer is faulted."""
+        if self.faults is None:
+            return
+        try:
+            self.faults.check(node.id)
+        except ConnectionError:
+            if self.breakers is not None:
+                self.breakers.record_failure(node.id)
+            raise
 
     def _ctx(self, url: str):
         """SSL context for https peers. Plain http gets None."""
@@ -425,6 +443,7 @@ class HTTPInternalClient:
         """
         if self.breakers is not None:
             self.breakers.check(node.id)
+        self._check_fault(node)
         attempt = 0
         try:
             while True:
@@ -607,6 +626,12 @@ class HTTPInternalClient:
         try:
             if self.breakers is not None:
                 self.breakers.check(node.id)
+            try:
+                self._check_fault(node)
+            except ConnectionError as err:
+                for leg in batch:
+                    leg.error = err
+                return
             body = wire.encode_mux_request([leg.to_json() for leg in batch])
             # The envelope waits for its slowest leg: socket timeout is
             # the largest per-leg budget (deadline-capped by callers).
@@ -867,6 +892,7 @@ class HTTPInternalClient:
             body = _RewindableChunks(chunks) if chunked else b"".join(chunks)
             if self.breakers is not None:
                 self.breakers.check(node.id)
+            self._check_fault(node)
             hdrs = {"Content-Type": wire.STREAM_CONTENT_TYPE}
             if qos_class:
                 hdrs["X-Qos-Class"] = qos_class
@@ -960,6 +986,7 @@ class HTTPInternalClient:
         the peer's pooled connections are invalidated so data legs can't
         keep riding them either.
         """
+        self._check_fault(node)
         url = self._url(node, "/version")
         scheme, host, port, path = _split_url(url)
         timeout = min(self.PROBE_TIMEOUT, self.timeout)
@@ -984,6 +1011,10 @@ class HTTPInternalClient:
         indirect ping, gossip/gossip.go:43-443): distinguishes "target
         is dead" from "the link between US and target is down".  True
         iff the intermediary reached the target."""
+        try:
+            self._check_fault(via)
+        except ConnectionError:
+            return False  # can't even reach the intermediary
         q = urllib.parse.urlencode({"scheme": target.uri.scheme,
                                     "host": target.uri.host,
                                     "port": target.uri.port})

@@ -19,6 +19,7 @@ from urllib.parse import parse_qs, urlparse
 
 from pilosa_tpu.errors import (
     ApiMethodNotAllowedError,
+    ClusterFencedError,
     FieldExistsError,
     FieldNotFoundError,
     FragmentNotFoundError,
@@ -44,6 +45,7 @@ from pilosa_tpu.qos import (
 from pilosa_tpu.obs import profile as _profile
 from pilosa_tpu.qos import deadline as qos_deadline
 from pilosa_tpu.server.api import API
+from pilosa_tpu.cluster.cluster import ShardUnavailableError
 from pilosa_tpu.storage.quarantine import ShardCorruptError
 
 _CONFLICTS = (IndexExistsError, FieldExistsError)
@@ -203,6 +205,20 @@ def _make_handler(api: API):
                     # quarantined — a server-side condition a replica or
                     # the scrubber will clear, not a bad request.
                     status, payload = 503, {"error": str(e)}
+                except ClusterFencedError as e:
+                    # 503 + Retry-After (also before the catch-all): the
+                    # node fenced itself off a minority partition —
+                    # retry-able server-side unavailability, same family
+                    # as load shed, NOT a client error.
+                    status, payload = 503, {"error": str(e)}
+                    headers = {"Retry-After": str(int(e.retry_after))}
+                except ShardUnavailableError as e:
+                    # Every live owner of some shard is unreachable from
+                    # here — transient membership trouble (a partition
+                    # the failure detector hasn't fenced yet), not a bad
+                    # request: retryable 503, same family as fenced.
+                    status, payload = 503, {"error": str(e)}
+                    headers = {"Retry-After": "1"}
                 except (QueryError, ParseError, ValueError, PilosaError) as e:
                     status, payload = 400, {"error": str(e)}
                 except Exception as e:  # pragma: no cover
@@ -589,6 +605,12 @@ def _build_routes(api: API):
                 # ladder maps this to 503 (quarantined, not a bad query).
                 status = "error"
                 raise
+            except (ClusterFencedError, ShardUnavailableError):
+                # Also past the PilosaError catch: the dispatch ladder
+                # maps both to 503 + Retry-After (partition-era server
+                # unavailability, not a bad query).
+                status = "shed"
+                raise
             except (QueryError, ParseError, PilosaError, ValueError) as e:
                 status = "error"
                 return 400, {"error": str(e)}
@@ -876,6 +898,48 @@ def _build_routes(api: API):
             "cache": rcache.snapshot() if rcache is not None else None,
         }
 
+    def get_debug_membership(pv, params, body):
+        """One document for 'what does THIS node think of the ring':
+        per-peer state with the failure detector's last probe outcome
+        and indirect-probe verdicts, per-peer breaker state, and the
+        quorum-fence status — the first stop when a partition drill (or
+        a real one) leaves nodes disagreeing about who is alive."""
+        cluster = getattr(api, "cluster", None)
+        if cluster is None:
+            return 200, {"cluster": False}
+        breakers = getattr(cluster.client, "breakers", None)
+        bpeers = (breakers.snapshot().get("peers", {})
+                  if breakers is not None else {})
+        log = getattr(cluster, "membership_log", {}) or {}
+        peers = []
+        for n in list(cluster.nodes):
+            obs = log.get(n.id, {})
+            peers.append({
+                "id": n.id,
+                "state": n.state,
+                "isCoordinator": bool(n.is_coordinator),
+                "self": n.id == cluster.local_id,
+                "lastProbeOk": obs.get("lastProbeOk"),
+                "lastProbeDirect": obs.get("lastProbeDirect"),
+                "lastProbeEpoch": obs.get("lastProbeAt"),
+                "indirect": obs.get("indirect", {}),
+                "breaker": bpeers.get(n.id),
+            })
+        faults = getattr(cluster.client, "faults", None)
+        return 200, {
+            "cluster": True,
+            "localId": cluster.local_id,
+            "state": cluster.state,
+            "topologyVersion": cluster.topology_version,
+            "fenced": bool(getattr(cluster, "fenced", False)),
+            "fenceStaleReads": bool(getattr(cluster, "fence_stale_reads",
+                                            False)),
+            "fencingToken": cluster.fencing_token(),
+            "injectedFaults": (faults.snapshot()
+                               if faults is not None else {}),
+            "peers": peers,
+        }
+
     def get_debug_cache(pv, params, body):
         """Result-cache snapshot: global byte/entry occupancy, hit and
         eviction counters, per-tenant partition sizes, and the remote
@@ -891,15 +955,36 @@ def _build_routes(api: API):
         return 200, snap
 
     def post_fault(pv, params, body):
-        """Chaos fault injection: currently the slow-peer gray failure
-        — {"slowMs": N} delays every subsequent /query on this node by
-        N ms; 0 heals it. Only mounted when the node was started with
-        chaos faults enabled (--chaos-faults / PILOSA_TPU_CHAOS_FAULTS)
-        — a one-request degradation lever must not ship armed."""
+        """Chaos fault injection. {"slowMs": N} delays every subsequent
+        /query on this node by N ms (0 heals); {"partition": {"peers":
+        [...ids...], "mode": "drop"|"timeout", "delayMs": N}} cuts this
+        node's OUTBOUND links to the named peers (asymmetric by
+        construction — the chaos driver faults both sides for a
+        symmetric split); {"healPartition": true} clears every link
+        fault. Only mounted when the node was started with chaos faults
+        enabled (--chaos-faults / PILOSA_TPU_CHAOS_FAULTS) — a
+        one-request degradation lever must not ship armed."""
         req = jbody(body)
         if "slowMs" in req:
             api.fault_slow_s = max(0.0, float(req["slowMs"]) / 1000.0)
-        return 200, {"slowMs": getattr(api, "fault_slow_s", 0.0) * 1000.0}
+        cluster = getattr(api, "cluster", None)
+        faults = (getattr(cluster.client, "faults", None)
+                  if cluster is not None else None)
+        part = req.get("partition")
+        if part is not None or req.get("healPartition"):
+            if faults is None:
+                return 400, {"error": "node has no partition fault table "
+                                      "(standalone?)"}
+            if req.get("healPartition"):
+                faults.clear()
+            if part is not None:
+                mode = part.get("mode", "drop")
+                delay_s = float(part.get("delayMs", 0.0)) / 1000.0
+                for peer in part.get("peers", []):
+                    faults.set_fault(str(peer), mode=mode, delay_s=delay_s)
+        return 200, {"slowMs": getattr(api, "fault_slow_s", 0.0) * 1000.0,
+                     "partition": (faults.snapshot()
+                                   if faults is not None else {})}
 
     def get_debug_quarantine(pv, params, body):
         """Corruption quarantine view: which fragments failed integrity
@@ -1211,6 +1296,7 @@ def _build_routes(api: API):
         (r"/version", {"GET": get_version}),
         (r"/metrics", {"GET": get_metrics}),
         (r"/debug/vars", {"GET": get_debug_vars}),
+        (r"/debug/membership", {"GET": get_debug_membership}),
         (r"/debug/queries/(?P<trace>[^/]+)",
          {"GET": get_debug_query_profile}),
         (r"/debug/queries", {"GET": get_debug_queries}),

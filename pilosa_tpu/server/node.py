@@ -83,6 +83,7 @@ class ServerNode:
                  hedge_delay_ms: float = 0.0,
                  hedge_budget_pct: float = 5.0,
                  chaos_faults: bool = False,
+                 fence_stale_reads: bool = False,
                  compile_cache_dir: str | None = None,
                  plan_buckets: str = "pow2",
                  result_cache_mb: int = 64,
@@ -150,6 +151,8 @@ class ServerNode:
 
         from pilosa_tpu.obs import MemoryStats
         self.stats = MemoryStats()
+        from pilosa_tpu.obs.logger import StandardLogger
+        self.logger = StandardLogger()
         self.tracer = None
         if trace_endpoint:
             # Concrete exporter behind the Tracer protocol (reference
@@ -281,6 +284,14 @@ class ServerNode:
         #: opted in (chaos_faults); the route is not mounted otherwise.
         self.api.fault_slow_s = 0.0
         self.api.chaos_faults = bool(chaos_faults)
+        if self.cluster is not None:
+            # Quorum fencing knobs + the chaos partition fault table
+            # (the table is always present; only the chaos-gated
+            # /internal/fault route can arm it).
+            self.cluster.fence_stale_reads = bool(fence_stale_reads)
+            self.cluster.on_unfence = self._on_unfence
+            from pilosa_tpu.cluster.faults import PartitionFaults
+            self.cluster.client.faults = PartitionFaults()
         self._qos_warmup = qos_warmup
         self._qos_warmup_shards = qos_warmup_shards
         self.warmup = None
@@ -407,7 +418,8 @@ class ServerNode:
             self.scrubber = Scrubber(
                 self.holder, self.cluster,
                 self.cluster.client if self.cluster is not None else None,
-                self.store, stats=self.stats, admission=self.qos)
+                self.store, stats=self.stats, logger=self.logger,
+                admission=self.qos)
         # Backup/restore driver hooks (POST /backup, /restore). One run
         # of each at a time; jobs run off the request thread and
         # /backup/status, /restore/status read their live progress.
@@ -539,7 +551,7 @@ class ServerNode:
                         if self.cluster is not None else None),
                 store=self.store, archive=self.backup_archive,
                 interval=self._backup_interval, node_id=self.id,
-                stats=self.stats, admission=self.qos,
+                stats=self.stats, logger=self.logger, admission=self.qos,
                 full_every=self._backup_full_every,
                 keep_chains=self._backup_keep_chains)
             self._schedule_backup()
@@ -628,6 +640,45 @@ class ServerNode:
         import random
         return interval * random.uniform(0.8, 1.2)
 
+    def _timer_tick_error(self, timer: str, err: BaseException) -> None:
+        """A background sweep (anti-entropy, scrub, backup, liveness)
+        blew up. The tick must survive — the next one retries — but a
+        wedged sweep has to be VISIBLE: silent passes here turn 'the
+        failure detector died an hour ago' into an unexplained outage."""
+        self.stats.count("node.timerTickError")
+        self.logger.printf("%s timer tick failed: %s: %s",
+                           timer, type(err).__name__, err)
+
+    def _on_unfence(self) -> None:
+        """Fence lifted (the liveness sweep sees a majority again):
+        this node just rejoined from a minority partition, so its data
+        AND caches may be behind the majority's writes. Kick an
+        immediate dirty-sync — schema adoption + fragment anti-entropy
+        — and flush epoch-validated result caches, off the sweep
+        thread (same shape as the READY-event repair)."""
+        if self._closed:
+            return
+        self.logger.printf("quorum regained: un-fenced, starting "
+                           "rejoin dirty-sync")
+
+        def resync():
+            try:
+                for iname in self.holder.index_names():
+                    idx = self.holder.index(iname)
+                    if idx is not None:
+                        # Local caches validated against pre-partition
+                        # epochs would serve stale reads until the next
+                        # write; bump first so repaired bits are seen.
+                        idx.epoch.bump(notify=False)
+                if self.cluster is not None:
+                    self._sync_schema()
+                if self.syncer is not None:
+                    self.syncer.sync_holder()
+            except Exception:
+                pass  # the anti-entropy ticker retries
+        threading.Thread(target=resync, name="unfence-resync",
+                         daemon=True).start()
+
     def _on_node_event(self, ev) -> None:
         """NodeEvent consumer (reference ReceiveEvent, cluster.go:1754):
         count the stream, and when a peer comes BACK, kick an immediate
@@ -711,8 +762,10 @@ class ServerNode:
                 if repaired:
                     self.stats.count("antiEntropyRepaired", repaired)
                 self.stats.count("antiEntropyPasses")
-            except Exception:
-                pass  # next tick retries; repairs must never kill the node
+            except Exception as e:
+                # Next tick retries; repairs must never kill the node —
+                # but the failure must be visible, not swallowed.
+                self._timer_tick_error("anti-entropy", e)
             finally:
                 if not self._closed:
                     self._schedule_sync()
@@ -728,8 +781,9 @@ class ServerNode:
                 if res.get("mismatch"):
                     self.stats.count("integrity.scrubMismatchFragments",
                                      res["mismatch"])
-            except Exception:
-                pass  # next tick retries; the scrub must never kill the node
+            except Exception as e:
+                # Next tick retries; the scrub must never kill the node.
+                self._timer_tick_error("scrub", e)
             finally:
                 if not self._closed:
                     self._schedule_scrub()
@@ -749,8 +803,9 @@ class ServerNode:
                         self.backup_scheduler.tick()
                     finally:
                         self._backup_gate.release()
-            except Exception:
-                pass  # scheduler.tick never raises; belt and braces
+            except Exception as e:
+                # scheduler.tick never raises; belt and braces.
+                self._timer_tick_error("backup", e)
             finally:
                 if not self._closed:
                     self._schedule_backup()
@@ -774,8 +829,10 @@ class ServerNode:
                               self.DISCOVER_EVERY_N_SWEEPS == 0))
                 if changed:
                     self.stats.count("checkNodesChanged", len(changed))
-            except Exception:
-                pass
+            except Exception as e:
+                # A dead failure detector is the worst silent failure:
+                # DOWN peers never get marked, writes hang on them.
+                self._timer_tick_error("check-nodes", e)
             finally:
                 if not self._closed:
                     self._schedule_check_nodes()
@@ -858,6 +915,9 @@ class ServerNode:
             from pilosa_tpu.cluster.resize import deliver_completion
             deliver_completion(message)
         elif t == "index-dirty":
+            if (self.cluster is not None
+                    and not self.cluster.check_fencing_token(message)):
+                return  # stale coordinator's dirty coordination
             from pilosa_tpu.cluster.dirty import apply_index_dirty
             apply_index_dirty(self.holder, message,
                               self.executor.remote_epochs)
@@ -1076,8 +1136,12 @@ class ServerNode:
         diff push) or field-level (routed import). Gated by cluster
         state like the public import surface (reference api.Import
         validates on the RECEIVING node too): a forwarded write must
-        not land on a RESIZING owner whose fragments are mid-move."""
-        self.api._validate("import")
+        not land on a RESIZING owner whose fragments are mid-move.
+        internal=True: peer-forwarded writes (replica fan-out legs,
+        anti-entropy pushes, dual-apply) must land even on a FENCED
+        receiver — they are how a minority heals, and the SENDER's
+        fence already gated the client-facing write."""
+        self.api._validate("import", internal=True)
         index, field = req["index"], req["field"]
         f = self.holder.field(index, field)
         if f is None:

@@ -16,6 +16,7 @@ import pytest
 from pilosa_tpu.analysis import witness as witness_mod
 from pilosa_tpu.analysis.checkers import (
     contextvar_hygiene,
+    coordinator_fence,
     epoch_audit,
     executor_lifecycle,
     jit_purity,
@@ -598,6 +599,94 @@ def test_residency_pairing_non_table_module_ignored():
     """
     assert run_rule(residency_pairing, src,
                     path="pilosa_tpu/exec/fuse.py") == []
+
+
+# -- coordinator-fence -------------------------------------------------------
+
+UNFENCED_SCHEDULER = """
+class BackupScheduler:
+    def run_once(self, force=False):
+        if not self._is_coordinator():
+            return "skipped-not-coordinator"
+        return self._capture()
+"""
+
+
+def test_coordinator_fence_catches_unfenced_duty():
+    # The split-brain hazard this rule encodes: a minority-side
+    # coordinator keeps capturing into the shared archive while the
+    # majority's successor does the same.
+    fs = run_rule(coordinator_fence, UNFENCED_SCHEDULER,
+                  path="pilosa_tpu/backup/scheduler.py")
+    assert len(fs) == 1 and "run_once" in fs[0].message
+    assert fs[0].rule == "coordinator-fence"
+
+
+def test_coordinator_fence_identifier_gate_passes():
+    src = UNFENCED_SCHEDULER.replace(
+        "return self._capture()",
+        "if self._is_fenced():\n"
+        "            return \"skipped-fenced\"\n"
+        "        return self._capture()")
+    assert run_rule(coordinator_fence, src,
+                    path="pilosa_tpu/backup/scheduler.py") == []
+
+
+def test_coordinator_fence_getattr_gate_passes():
+    # The runtime's own spelling in resize/scrub: a getattr read with
+    # a fence-named literal is a consultation too.
+    src = """
+    class ResizeJob:
+        def run(self, new_nodes):
+            if getattr(self.cluster, "fenced", False):
+                self.state = "FAILED"
+                return self.state
+            return self._begin(new_nodes)
+    """
+    assert run_rule(coordinator_fence, src,
+                    path="pilosa_tpu/cluster/resize.py") == []
+
+
+def test_coordinator_fence_token_literal_is_not_a_gate():
+    # Building a payload that CARRIES a fencing token is not checking
+    # one — a string literal alone must still be flagged.
+    src = """
+    def prune_archive(archive, keep_chains):
+        journal = {"fencingToken": 7}
+        return archive.sweep(journal)
+    """
+    fs = run_rule(coordinator_fence, src,
+                  path="pilosa_tpu/backup/retention.py")
+    assert len(fs) == 1 and "prune_archive" in fs[0].message
+
+
+def test_coordinator_fence_renamed_duty_flagged():
+    # A rename that silently drops a duty off the roster is itself a
+    # finding: the gate must follow the function.
+    src = """
+    class Scrubber:
+        def _scrub_fragment_v2(self, key):
+            if self.cluster.fenced:
+                return False
+            return True
+    """
+    fs = run_rule(coordinator_fence, src,
+                  path="pilosa_tpu/cluster/scrub.py")
+    assert len(fs) == 1 and "_scrub_fragment" in fs[0].message
+
+
+def test_coordinator_fence_out_of_scope_module_ignored():
+    assert run_rule(coordinator_fence, UNFENCED_SCHEDULER,
+                    path="pilosa_tpu/server/api.py") == []
+
+
+def test_coordinator_fence_pragma_suppresses():
+    src = UNFENCED_SCHEDULER.replace(
+        "def run_once(self, force=False):",
+        "def run_once(self, force=False):"
+        "  # analysis: ignore[coordinator-fence] -- fixture")
+    assert run_rule(coordinator_fence, src,
+                    path="pilosa_tpu/backup/scheduler.py") == []
 
 
 # -- engine: pragmas + the tree-is-clean contract ----------------------------

@@ -1,0 +1,474 @@
+"""Partition tolerance: quorum self-fencing, SWIM-style indirect
+probes, fencing tokens on coordinator broadcasts, fenced coordinator
+duties, and split-brain heal convergence — all over the deterministic
+LocalCluster harness (pair faults on the shared transport, failure-
+detector sweeps run by hand)."""
+
+import pytest
+
+from pilosa_tpu.cluster.cluster import Cluster
+from pilosa_tpu.cluster.harness import LocalCluster
+from pilosa_tpu.cluster.node import URI, Node
+from pilosa_tpu.cluster.resize import check_nodes
+from pilosa_tpu.config import SHARD_WIDTH
+from pilosa_tpu.obs.stats import MemoryStats
+
+
+def _ring(n: int, local: int = 0, replica_n: int = 1) -> Cluster:
+    """Bare membership view (no transport): enough for the fence and
+    token state machines, which are pure Cluster-side logic."""
+    from pilosa_tpu.cluster.cluster import STATE_NORMAL
+    nodes = [Node(id=f"node{i}", uri=URI(host="localhost", port=10101 + i),
+                  is_coordinator=(i == 0)) for i in range(n)]
+    c = Cluster(local_id=f"node{local}", nodes=nodes, replica_n=replica_n)
+    c.set_state(STATE_NORMAL)
+    c.stats = MemoryStats()
+    return c
+
+
+# -- quorum fence state machine -------------------------------------------
+
+
+def test_observe_quorum_fences_minority_and_unfences_on_majority():
+    c = _ring(5)
+    fired = []
+    c.on_unfence = lambda: fired.append(1)
+
+    assert c.observe_quorum(3, 5) is False
+    assert not c.fenced
+
+    # Losing the majority fences; staying fenced doesn't re-count.
+    assert c.observe_quorum(2, 5) is True
+    assert c.fenced
+    assert c.stats.counter_value("cluster.fenced") == 1
+    assert c.observe_quorum(1, 5) is True
+    assert c.stats.counter_value("cluster.fenced") == 1
+    assert not fired
+
+    # Regaining the majority un-fences and fires the rejoin-repair hook.
+    assert c.observe_quorum(3, 5) is False
+    assert not c.fenced
+    assert c.stats.counter_value("cluster.unfenced") == 1
+    assert fired == [1]
+
+    # Exactly half is NOT a strict majority: 3 of 6 stays fenced.
+    c.observe_quorum(2, 6)
+    assert c.fenced
+    assert c.observe_quorum(3, 6) is True
+
+
+def test_quorum_fence_exempts_rings_smaller_than_three():
+    # With 2 nodes a single peer loss has no majority on either side;
+    # fencing would turn every degraded-replica situation into an
+    # outage, so small rings never fence.
+    c2 = _ring(2)
+    assert c2.observe_quorum(1, 2) is False
+    assert not c2.fenced
+    c1 = _ring(1)
+    assert c1.observe_quorum(1, 1) is False
+    # 3 nodes is the smallest ring where the fence engages.
+    c3 = _ring(3)
+    assert c3.observe_quorum(1, 3) is True
+
+
+def test_fencing_token_is_monotonic_and_stale_tokens_rejected():
+    c = _ring(3)
+    c.topology_version = 4
+    assert c.fencing_token() == 4
+
+    # No token (peer-to-peer / legacy senders) and current-or-newer
+    # tokens pass; older-than-our-topology tokens are rejected.
+    assert c.check_fencing_token({}) is True
+    assert c.check_fencing_token({"fencingToken": 4}) is True
+    assert c.check_fencing_token({"fencingToken": 7}) is True
+    assert c.check_fencing_token({"fencingToken": 3}) is False
+    assert c.stats.counter_value("cluster.staleTokenRejected") == 1
+
+    # A takeover/commit bumps the topology: the deposed coordinator's
+    # previously-valid token goes stale.
+    c.topology_version += 1
+    assert c.check_fencing_token({"fencingToken": 4}) is False
+    assert c.stats.counter_value("cluster.staleTokenRejected") == 2
+
+
+# -- fencing tokens on coordinator broadcasts -----------------------------
+
+
+def test_stale_fencing_token_rejects_resize_begin():
+    from pilosa_tpu.cluster.resize import apply_resize_begin
+    lc = LocalCluster(3, replica_n=2)
+    peer = lc[1]
+    peer.cluster.stats = MemoryStats()
+    peer.cluster.topology_version = 5
+
+    begin = {"type": "resize-begin", "job": "stale-job",
+             "coordinator": {"id": "node0"},
+             "nodes": [n.to_json() for n in peer.cluster.nodes],
+             "replicaN": 2, "partitionN": peer.cluster.partition_n,
+             "fencingToken": 4}
+    apply_resize_begin(peer.cluster, begin)
+    assert peer.cluster.migration is None
+    assert peer.cluster.stats.counter_value(
+        "cluster.staleTokenRejected") == 1
+
+    # The same begin with a current token installs the table.
+    begin["fencingToken"] = 5
+    apply_resize_begin(peer.cluster, begin)
+    assert peer.cluster.migration is not None
+    assert peer.cluster.migration.job_id == "stale-job"
+
+
+def test_stale_fencing_token_rejects_index_dirty_coordination():
+    lc = LocalCluster(2, replica_n=2)
+    lc.create_index("pt")
+    lc.create_field("pt", "f")
+    receiver = lc[1]
+    receiver.cluster.stats = MemoryStats()
+    receiver.cluster.topology_version = 3
+    idx = receiver.holder.index("pt")
+    before = idx.epoch.value
+
+    receiver.handle_message({"type": "index-dirty", "index": "pt",
+                             "sender": "node0", "fencingToken": 2})
+    assert idx.epoch.value == before
+    assert receiver.cluster.stats.counter_value(
+        "cluster.staleTokenRejected") == 1
+
+    # Current token applies (and an untokened legacy sender would too).
+    receiver.handle_message({"type": "index-dirty", "index": "pt",
+                             "sender": "node0", "fencingToken": 3})
+    assert idx.epoch.value > before
+
+
+# -- failure detector: indirect probes ------------------------------------
+
+
+def test_indirect_probe_saves_suspect_in_asymmetric_partition():
+    # node0 cannot reach node2, but node1 can: SWIM indirect
+    # confirmation must keep node2 READY and count it reachable.
+    lc = LocalCluster(3, replica_n=2)
+    a = lc[0]
+    a.cluster.stats = MemoryStats()
+    lc.block_link(0, 2)
+
+    changed = check_nodes(a.cluster, a.cluster.client, retries=1,
+                          discover=False)
+    assert changed == []
+    assert a.cluster.node_by_id("node2").state != "DOWN"
+    obs = a.cluster.membership_log["node2"]
+    assert obs["lastProbeOk"] is True
+    assert obs["lastProbeDirect"] is False
+    assert obs["indirect"] == {"node1": True}
+    # Indirectly-alive peers count toward quorum: no fence.
+    assert not a.cluster.fenced
+    assert a.cluster.stats.counter_value("cluster.nodeDown") == 0
+
+
+def test_indirect_probes_confirm_down_then_nodeup_on_heal():
+    lc = LocalCluster(3, replica_n=2)
+    a = lc[0]
+    a.cluster.stats = MemoryStats()
+    lc.client.down.add("node2")
+
+    changed = check_nodes(a.cluster, a.cluster.client, retries=1,
+                          discover=False)
+    assert changed == ["node2"]
+    assert a.cluster.node_by_id("node2").state == "DOWN"
+    obs = a.cluster.membership_log["node2"]
+    assert obs["lastProbeOk"] is False
+    assert obs["lastProbeDirect"] is False
+    assert obs["indirect"] == {"node1": False}
+    assert a.cluster.stats.counter_value("cluster.nodeDown") == 1
+    # Majority of 3 still reachable (self + node1): no self-fence.
+    assert not a.cluster.fenced
+
+    # An already-DOWN corpse is not re-confirmed every sweep.
+    check_nodes(a.cluster, a.cluster.client, retries=1, discover=False)
+    assert a.cluster.membership_log["node2"]["indirect"] == {}
+
+    lc.client.down.discard("node2")
+    changed = check_nodes(a.cluster, a.cluster.client, retries=1,
+                          discover=False)
+    assert changed == ["node2"]
+    assert a.cluster.node_by_id("node2").state == "READY"
+    assert a.cluster.stats.counter_value("cluster.nodeUp") == 1
+
+
+def test_indirect_probe_degenerate_two_node_ring_has_no_intermediaries():
+    lc = LocalCluster(2, replica_n=2)
+    a = lc[0]
+    a.cluster.stats = MemoryStats()
+    lc.client.down.add("node1")
+
+    changed = check_nodes(a.cluster, a.cluster.client, retries=1,
+                          discover=False)
+    assert changed == ["node1"]
+    assert a.cluster.membership_log["node1"]["indirect"] == {}
+    # 2-node rings are exempt from the quorum fence.
+    assert not a.cluster.fenced
+
+
+# -- transport pair faults ------------------------------------------------
+
+
+def test_partition_pair_faults_are_directional():
+    lc = LocalCluster(3, replica_n=2)
+    lc.block_link("node0", "node2")
+    n0_view_of_2 = lc[0].cluster.node_by_id("node2")
+    n2_view_of_0 = lc[2].cluster.node_by_id("node0")
+
+    with pytest.raises(ConnectionError):
+        lc[0].cluster.client.probe(n0_view_of_2)
+    # The reverse direction is untouched (asymmetric by construction).
+    lc[2].cluster.client.probe(n2_view_of_0)
+
+    lc.heal_partition()
+    lc[0].cluster.client.probe(n0_view_of_2)
+
+
+def test_minority_island_self_fences_while_majority_keeps_it_ready():
+    # Cut ONLY node2's outbound links: node2 sees nobody (fences), but
+    # the majority still reaches node2 directly, so no DOWN churn.
+    lc = LocalCluster(3, replica_n=2)
+    lc.block_link(2, 0)
+    lc.block_link(2, 1)
+    lc.check_all_nodes()
+
+    assert lc[2].cluster.fenced
+    assert not lc[0].cluster.fenced and not lc[1].cluster.fenced
+    assert lc[0].cluster.node_by_id("node2").state != "DOWN"
+    assert lc[1].cluster.node_by_id("node2").state != "DOWN"
+
+    lc.heal_partition()
+    lc.check_all_nodes()
+    assert not lc[2].cluster.fenced
+
+
+def test_split_brain_partition_fences_minority_majority_serves_quorum():
+    lc = LocalCluster(5, replica_n=3)
+    for cn in lc.nodes:
+        cn.cluster.stats = MemoryStats()
+    lc.create_index("pt")
+    lc.create_field("pt", "f")
+    for col in (1, SHARD_WIDTH + 2, 2 * SHARD_WIDTH + 3):
+        lc.query("pt", f"Set({col}, f=1)")
+
+    lc.partition([3, 4])
+    lc.check_all_nodes()
+
+    # Each side discovered the split on its own: the 2-node island
+    # fenced itself, the 3-node majority did not.
+    assert lc[3].cluster.fenced and lc[4].cluster.fenced
+    assert not any(lc[i].cluster.fenced for i in (0, 1, 2))
+    assert lc[3].cluster.stats.counter_value("cluster.fenced") == 1
+    # Majority placement (replica 3 of 5, consecutive) always keeps at
+    # least one live owner per shard: reads keep flowing.
+    assert lc.query("pt", "Count(Row(f=1))")[0] == 3
+
+    lc.heal_partition()
+    lc.check_all_nodes()
+    assert not any(cn.cluster.fenced for cn in lc.nodes)
+    assert lc[3].cluster.stats.counter_value("cluster.unfenced") == 1
+    assert lc.query("pt", "Count(Row(f=1))")[0] == 3
+
+
+# -- API fence gate -------------------------------------------------------
+
+
+def test_api_fence_refuses_traffic_allows_opted_in_stale_reads():
+    from pilosa_tpu.errors import ClusterFencedError
+    from pilosa_tpu.server.api import API
+
+    lc = LocalCluster(3, replica_n=2)
+    a = lc[0]
+    api = API(a.holder, a.executor, cluster=a.cluster)
+    api.create_index("fz")
+    api.create_field("fz", "f")
+    api.query("fz", "Set(1, f=1)")
+
+    a.cluster.fenced = True
+    with pytest.raises(ClusterFencedError) as ei:
+        api.query("fz", "Count(Row(f=1))")
+    assert ei.value.retry_after > 0
+    with pytest.raises(ClusterFencedError):
+        api.create_index("fz2")
+    # Internal traffic (peer forwards, repair pushes from the majority)
+    # is exempt — it is how the fence heals.
+    api._validate("import", internal=True)
+
+    # Operator opt-in: reads (and only reads) flow while fenced.
+    a.cluster.fence_stale_reads = True
+    api.query("fz", "Count(Row(f=1))")
+    with pytest.raises(ClusterFencedError):
+        api.create_index("fz2")
+
+    a.cluster.fenced = False
+    api.create_index("fz2")
+
+
+# -- fenced coordinator duties --------------------------------------------
+
+
+def test_backup_scheduler_fence_suspends_capture_single_ticker():
+    from pilosa_tpu.backup.scheduler import (
+        SKIP_FENCED,
+        SKIP_NOT_COORDINATOR,
+        BackupScheduler,
+    )
+
+    lc = LocalCluster(3, replica_n=2)
+    stats = MemoryStats()
+    fenced_coord = BackupScheduler(
+        holder=lc[0].holder, cluster=lc[0].cluster,
+        client=lc[0].cluster.client, store=None, archive=None,
+        interval=3600.0, node_id="node0", stats=stats)
+    lc[0].cluster.fenced = True
+    assert fenced_coord.run_once(force=True) == SKIP_FENCED
+    assert stats.counter_value("backup.scheduler.skippedFenced") == 1
+    assert fenced_coord.last_status == SKIP_FENCED
+
+    # Non-coordinators skip regardless: a fenced coordinator plus
+    # deferring peers means at most one scheduler ever captures.
+    peer = BackupScheduler(
+        holder=lc[2].holder, cluster=lc[2].cluster,
+        client=lc[2].cluster.client, store=None, archive=None,
+        interval=3600.0, node_id="node2", stats=MemoryStats())
+    assert peer.run_once(force=True) == SKIP_NOT_COORDINATOR
+
+
+def test_retention_prune_fence_gate_deletes_nothing():
+    from pilosa_tpu.backup.retention import prune_archive
+
+    stats = MemoryStats()
+    # fence=True aborts before the archive is touched at all.
+    summary = prune_archive(None, 1, stats=stats, fence=lambda: True)
+    assert summary["aborted"] == "fenced"
+    assert summary["pruned"] == 0 and summary["victims"] == []
+    assert stats.counter_value("backup.retention.fenced") == 1
+
+
+def test_resize_job_refuses_to_run_while_fenced():
+    from pilosa_tpu.cluster.resize import ResizeJob
+
+    lc = LocalCluster(3, replica_n=2)
+    coord = lc[0]
+    coord.cluster.fenced = True
+    job = ResizeJob(coord.cluster, coord.holder, coord.cluster.client)
+    new_ring = [Node(id=n.id, uri=n.uri, is_coordinator=n.is_coordinator)
+                for n in coord.cluster.nodes]
+    assert job.run(new_ring) == "FAILED"
+    assert coord.cluster.migration is None
+
+
+def test_scrub_fence_preserves_dirty_marks_and_refuses_push_repair():
+    from pilosa_tpu.cluster.scrub import Scrubber
+
+    class _StubQuarantine:
+        @staticmethod
+        def keys():
+            return []
+
+        @staticmethod
+        def get(key):
+            return None
+
+    class _StubStore:
+        quarantine = _StubQuarantine()
+
+        @staticmethod
+        def _all_keys():
+            return []
+
+    lc = LocalCluster(3, replica_n=2)
+    a = lc[0]
+    stats = MemoryStats()
+    scr = Scrubber(a.holder, a.cluster, a.cluster.client, _StubStore(),
+                   stats=stats)
+
+    a.cluster.dirty_shards.mark("pt", 0)
+    a.cluster.fenced = True
+    scr.scrub_pass()
+    # Fenced: the dirty mark survives as the rejoin repair's worklist...
+    assert ("pt", 0) in a.cluster.dirty_shards.peek()
+    # ...and a targeted push-repair is refused outright.
+    assert scr._scrub_fragment(("pt", "f", "standard", 0)) is False
+    assert stats.counter_value("integrity.scrubFenced") == 1
+
+    a.cluster.fenced = False
+    lc.create_index("pt")
+    lc.create_field("pt", "f")
+    scr.scrub_pass()
+    assert ("pt", 0) not in a.cluster.dirty_shards.peek()
+
+
+# -- heal convergence -----------------------------------------------------
+
+
+def _fragment_digests(lc: LocalCluster) -> dict:
+    """(index, field, view, shard) -> {node_id: block-checksum digest}
+    across every node holding the fragment."""
+    out: dict = {}
+    for cn in lc.nodes:
+        for iname in sorted(cn.holder.indexes):
+            idx = cn.holder.index(iname)
+            for fname, f in sorted(idx.fields.items()):
+                for vname, v in sorted(f.views.items()):
+                    for shard, frag in sorted(v.fragments.items()):
+                        key = (iname, fname, vname, shard)
+                        digest = tuple(sorted(
+                            frag.checksum_blocks().items()))
+                        out.setdefault(key, {})[cn.id] = digest
+    return out
+
+
+@pytest.mark.slow
+def test_partition_heal_three_seed_bitwise_convergence():
+    """Control run vs partitioned-then-healed run, same seeded write
+    sequence: after heal + anti-entropy every replica must be
+    bit-identical to the never-partitioned control."""
+    import random as _random
+
+    from pilosa_tpu.cluster.sync import HolderSyncer
+
+    def run(seed: int, partitioned: bool) -> dict:
+        lc = LocalCluster(3, replica_n=3)
+        lc.create_index("pt")
+        lc.create_field("pt", "f")
+        rng = _random.Random(seed)
+
+        def write():
+            col = rng.randrange(4 * SHARD_WIDTH)
+            row = rng.randrange(8)
+            lc.query("pt", f"Set({col}, f={row})")
+
+        for _ in range(40):
+            write()
+        if partitioned:
+            lc.partition([2])
+            # The sweep marks node2 DOWN on the majority (so writes
+            # skip it and mark dirty) and fences the minority.
+            lc.check_all_nodes()
+            assert lc[2].cluster.fenced
+            assert lc[0].cluster.node_by_id("node2").state == "DOWN"
+        for _ in range(40):
+            write()
+        if partitioned:
+            lc.heal_partition()
+            lc.check_all_nodes()
+            assert not lc[2].cluster.fenced
+            # Two anti-entropy passes over every node: the first pushes
+            # majority consensus onto the rejoined minority (creating
+            # any fragments it never saw), the second settles.
+            for _ in range(2):
+                for cn in lc.nodes:
+                    HolderSyncer(cn.holder, cn.cluster,
+                                 cn.cluster.client).sync_holder()
+        return _fragment_digests(lc)
+
+    for seed in (1, 2, 3):
+        control = run(seed, partitioned=False)
+        healed = run(seed, partitioned=True)
+        assert healed == control, f"seed {seed}: diverged after heal"
+        for key, per_node in healed.items():
+            assert len(set(per_node.values())) == 1, \
+                f"seed {seed}: replicas of {key} diverged"
