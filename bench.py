@@ -1142,6 +1142,132 @@ def bench_dispatch(extra):
 
 
 # ---------------------------------------------------------------------------
+# config 2c: key translation (ISSUE 20 — device key planes + batched
+# host path)
+# ---------------------------------------------------------------------------
+
+
+def bench_translate(extra):
+    """Keyed/id parity and the forward-translate fast paths.
+
+    * translate_keyed_count_dispatches — device launches for one warm
+      keyed Count (MUST be 1: the translation stage must stay on the
+      host snapshot for small batches, never grow a second launch).
+    * translate_keyed_vs_id_p50_ratio — warm keyed Count p50 over the
+      identical id-addressed Count p50 (the keyed/id parity headline).
+    * translate_batch_alloc_speedup_10k — batched translate_keys vs a
+      per-key loop, ALLOCATING 10k fresh keys: the per-key loop pays
+      one COW snapshot publish per key, the batch pays one total.
+      Asserted >= 10x (measures ~100x+).
+    * translate_batch_read_speedup_10k — same A/B on the all-hits read
+      path (both lock-free; the batch amortizes call overhead).
+    * translate_storm_keys_per_s_planes_{on,off} — 4096-key resolve
+      storms through the executor's batched resolver with the device
+      plane forced on vs off. On the CPU backend the plane's gather
+      competes with a host dict walk, so the ratio is reported, not
+      gated — the plane exists for HBM-resident deployments.
+    """
+    from pilosa_tpu.config import SHARD_WIDTH
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.core.field import FieldOptions
+    from pilosa_tpu.core.index import IndexOptions
+    from pilosa_tpu.core.translate import TranslateStore
+    from pilosa_tpu.exec import Executor
+    from pilosa_tpu.parallel import MeshPlanner, make_mesh
+
+    rng = np.random.default_rng(29)
+    n_bits, n_cols = 400_000, SHARD_WIDTH * 2
+    h = Holder()
+    kidx = h.create_index("tk", IndexOptions(keys=True))
+    kf = kidx.create_field("f", FieldOptions(keys=True))
+    oidx = h.create_index("ti")
+    of = oidx.create_field("f")
+    rows = rng.integers(1, 5, n_bits)
+    cols = rng.integers(0, n_cols, n_bits, dtype=np.uint64)
+    row_ids = kf.translate_store.translate_keys(
+        [f"r{r}" for r in range(1, 5)])
+    row_map = {r: row_ids[r - 1] for r in range(1, 5)}
+    kf.import_bits(np.array([row_map[r] for r in rows.tolist()],
+                            dtype=np.uint64), cols)
+    of.import_bits(rows.astype(np.uint64), cols)
+
+    planner = MeshPlanner(h, make_mesh())
+    ex = Executor(h, planner=planner)
+    kq, oq = 'Count(Row(f="r1"))', "Count(Row(f=1))"
+    ex.execute("tk", kq, cache=False)
+    ex.execute("tk", kq, cache=False)   # warm compile + stacks
+    d0 = planner.dispatches
+    ex.execute("tk", kq, cache=False)
+    dpq = planner.dispatches - d0
+    extra["translate_keyed_count_dispatches"] = dpq
+    assert dpq == 1, f"warm keyed Count took {dpq} dispatches, want 1"
+
+    _, keyed50, _ = _timer(lambda: ex.execute("tk", kq, cache=False),
+                           max(20, N_LAT))
+    ex.execute("ti", oq, cache=False)
+    _, id50, _ = _timer(lambda: ex.execute("ti", oq, cache=False),
+                        max(20, N_LAT))
+    extra["translate_keyed_p50_ms"] = round(keyed50, 3)
+    extra["translate_id_p50_ms"] = round(id50, 3)
+    extra["translate_keyed_vs_id_p50_ratio"] = round(keyed50 / id50, 2)
+
+    # Batched vs per-key host path, 10k keys (satellite a's whole point).
+    n_keys = 10_000
+    fresh = [f"alloc-{i}" for i in range(n_keys)]
+    s_batch, s_loop = TranslateStore(), TranslateStore()
+    t0 = time.perf_counter()
+    s_batch.translate_keys(fresh)
+    t_batch = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for k in fresh:
+        s_loop.translate_key(k)
+    t_loop = time.perf_counter() - t0
+    alloc_speedup = t_loop / t_batch
+    extra["translate_batch_alloc_speedup_10k"] = round(alloc_speedup, 1)
+    assert alloc_speedup >= 10, \
+        f"batched alloc only {alloc_speedup:.1f}x per-key, want >= 10x"
+    t_read_b = min(_t_once(lambda: s_batch.translate_keys(fresh))
+                   for _ in range(5))
+    t_read_l = min(_t_once(lambda: [s_batch.translate_key(k)
+                                    for k in fresh]) for _ in range(5))
+    extra["translate_batch_read_speedup_10k"] = round(t_read_l / t_read_b, 1)
+
+    # Resolver storm: 4096 existing keys per call, planes on vs off.
+    storm_keys = [f"c{int(c)}" for c in
+                  rng.choice(n_cols, 4096, replace=False)]
+    kidx.translate_store.translate_keys(storm_keys)
+
+    def storm():
+        lats = _hist()
+        for _ in range(30):
+            t0 = time.perf_counter()
+            ids = ex._resolve_keys(kidx, None, storm_keys)
+            lats.observe(time.perf_counter() - t0)
+        assert all(v is not None for v in ids)
+        return len(storm_keys) / (_p50(lats) / 1e3)
+
+    os.environ["PILOSA_TPU_TRANSLATE_PLANES"] = "on"
+    try:
+        storm()   # warm: plane build + probe compile outside the timing
+        on_kps = storm()
+        os.environ["PILOSA_TPU_TRANSLATE_PLANES"] = "off"
+        off_kps = storm()
+    finally:
+        del os.environ["PILOSA_TPU_TRANSLATE_PLANES"]
+    extra["translate_storm_keys_per_s_planes_on"] = round(on_kps)
+    extra["translate_storm_keys_per_s_planes_off"] = round(off_kps)
+    extra["translate_storm_planes_ratio"] = round(on_kps / off_kps, 2)
+    extra["translate_plane_debug"] = ex.keyplanes.debug()
+    planner.close()
+
+
+def _t_once(fn):
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
+# ---------------------------------------------------------------------------
 # config 3b: streaming ingestion (import stream + WAL group commit +
 # ingest/query isolation)
 # ---------------------------------------------------------------------------
@@ -1757,8 +1883,8 @@ def main() -> None:
 
     want = (set(c.strip() for c in CONFIGS.split(","))
             if CONFIGS != "all"
-            else {"star", "topn", "bsi", "sketch", "dispatch", "ingest",
-                  "time", "cluster", "cache", "oversub", "backup",
+            else {"star", "topn", "bsi", "sketch", "dispatch", "translate",
+                  "ingest", "time", "cluster", "cache", "oversub", "backup",
                   "overload", "obs", "elastic"})
     extra: dict = {"backend": jax.default_backend(),
                    "devices": len(jax.devices())}
@@ -1793,6 +1919,7 @@ def main() -> None:
     for name, fn in (("topn", bench_topn), ("bsi", bench_bsi),
                      ("sketch", bench_sketch),
                      ("dispatch", bench_dispatch),
+                     ("translate", bench_translate),
                      ("ingest", bench_ingest),
                      ("time", bench_time), ("cluster", bench_cluster),
                      ("cache", bench_cache),

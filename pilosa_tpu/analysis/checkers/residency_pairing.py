@@ -25,7 +25,14 @@ Checked, per module that declares BOTH tables at top level:
   non-callable, which converts the loud plan-time KeyError this rule
   exists to prevent into a confusing TypeError deep inside a traced
   program (the hll row grew this way: each sketch op must point at a
-  real kernel in pilosa_tpu/sketch/kernels.py, never a placeholder).
+  real kernel in pilosa_tpu/sketch/kernels.py, never a placeholder);
+* no ``(class, op)`` key appears twice in the ``KERNELS`` literal — a
+  duplicate key is legal Python (the last binding silently wins), so a
+  copy-pasted row that re-registers an existing pair shadows the
+  earlier kernel without any error, and the pairing check above still
+  passes. Grew teeth with the keyplane row: four classes × four ops of
+  near-identical lines is exactly where a pasted row keeps its old
+  class constant.
 """
 
 from __future__ import annotations
@@ -99,6 +106,7 @@ def check(mod: ModuleInfo, project: Mapping[str, ModuleInfo]) -> list[Finding]:
     # (class, op) pairs actually registered in the dispatch dict.
     table: dict[str, set[str]] = {}
     stubs: list[tuple[str, str, int]] = []
+    dups: list[tuple[str, str, int]] = []
     if isinstance(kernels_node.value, ast.Dict):
         for key, value in zip(kernels_node.value.keys,
                               kernels_node.value.values):
@@ -107,12 +115,21 @@ def check(mod: ModuleInfo, project: Mapping[str, ModuleInfo]) -> list[Finding]:
             klass = _resolve(key.elts[0], env)
             op = _resolve(key.elts[1], env)
             if klass is not None and op is not None:
+                if op in table.get(klass, ()):
+                    dups.append((klass, op, key.lineno))
                 table.setdefault(klass, set()).add(op)
                 if (isinstance(value, ast.Constant)
                         and value.value is None):
                     stubs.append((klass, op, value.lineno))
 
     findings: list[Finding] = []
+    for klass, op, lineno in dups:
+        findings.append(Finding(
+            RULE, mod.path, lineno,
+            f"KERNELS registers ({klass!r}, {op!r}) more than once — "
+            f"Python keeps the LAST binding silently, so this entry "
+            f"shadows an earlier kernel (copy-pasted row with a stale "
+            f"class constant?)"))
     for klass, op, lineno in stubs:
         findings.append(Finding(
             RULE, mod.path, lineno,

@@ -156,6 +156,11 @@ _DEFAULTS = {
     # non-resident leaf stacks.
     "residency_packed": "auto",
     "prefetch": "on",
+    # Device key planes (pilosa_tpu/exec/keyplane): forward key
+    # translation via a resident sorted-hash plane for large keyed
+    # batches ("auto" probes on device only for batches of 256+ keys;
+    # "off" keeps the lock-free host snapshot path only).
+    "translate_planes": "auto",
     # Approximate analytics (pilosa_tpu/sketch): HLL precision for
     # Count(Distinct(...)) — 2^p registers, ~1.04/sqrt(2^p) relative
     # error — and the estimated cardinality below which the answer is
@@ -290,6 +295,8 @@ def cmd_server(args) -> int:
         cfg["residency_packed"] = args.residency_packed
     if args.prefetch is not None:
         cfg["prefetch"] = args.prefetch
+    if args.translate_planes is not None:
+        cfg["translate_planes"] = args.translate_planes
     if args.sketch_precision is not None:
         cfg["sketch_precision"] = args.sketch_precision
     if args.sketch_exact_threshold is not None:
@@ -358,6 +365,7 @@ def cmd_server(args) -> int:
         inline_transfer=str(cfg["inline_transfer"]) or "auto",
         residency_packed=str(cfg["residency_packed"]) or "auto",
         prefetch=str(cfg["prefetch"]) or "on",
+        translate_planes=str(cfg["translate_planes"]) or "auto",
         sketch_precision=int(cfg["sketch_precision"]),
         sketch_exact_threshold=int(cfg["sketch_exact_threshold"]),
         profile_ring_n=int(cfg["profile_ring_n"]),
@@ -815,6 +823,9 @@ def cmd_generate_config(args) -> int:
           '# uploads for non-resident leaf stacks (on|off)\n'
           'residency-packed = "auto"\n'
           'prefetch = "on"\n'
+          '# key translation: device-resident sorted-hash planes for\n'
+          '# large keyed batches (auto = device probe for 256+ keys)\n'
+          'translate-planes = "auto"\n'
           '# approximate analytics: HLL precision for Count(Distinct)\n'
           '# (2^p registers, ~1.04/sqrt(2^p) error) and the estimated\n'
           '# cardinality below which the answer is computed exactly\n'
@@ -966,6 +977,12 @@ def main(argv: list[str] | None = None) -> int:
     s.add_argument("--prefetch", choices=("on", "off"), default=None,
                    help="upload non-resident leaf stacks asynchronously "
                         "ahead of query execution (default on)")
+    s.add_argument("--translate-planes", choices=("on", "off", "auto"),
+                   default=None,
+                   help="forward key translation via device-resident "
+                        "sorted-hash planes (default auto = device probe "
+                        "for batches of 256+ keys, async plane rebuild; "
+                        "off = host snapshot path only)")
     s.add_argument("--sketch-precision", type=int, default=None,
                    help="HLL precision p for Count(Distinct(...)): 2^p "
                         "registers, ~1.04/sqrt(2^p) relative error "

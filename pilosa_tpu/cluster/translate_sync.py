@@ -47,21 +47,30 @@ class ClusterKeyTranslator:
 
     def __call__(self, index: str, field: str | None,
                  keys: list[str]) -> list[int]:
+        keys = list(keys)
         store = _store(self.holder, index, field)
         coord = self.cluster.coordinator()
         if coord is None or coord.id == self.cluster.local_id:
-            return [store.translate_key(k) for k in keys]
-        try:
-            ids = self.client.translate_keys(coord, index, field, keys)
-        except ConnectionError:
-            # Coordinator unreachable: resolve what we already know, but
-            # never allocate locally (that is how stores diverge).
-            ids = [store.translate_key(k, create=False) for k in keys]
-            missing = [k for k, i in zip(keys, ids) if i is None]
-            if missing:
-                raise
+            # One batched allocation: one lock, one epoch bump.
+            return store.translate_keys(keys)
+        # Replica-local read path: every key already in the synced local
+        # store (anything at or below the replication watermark, plus
+        # entries applied by earlier lookups) resolves from the lock-free
+        # snapshot with ZERO coordinator traffic; only the misses — the
+        # keys that may need allocation — travel, in ONE batched RPC per
+        # call instead of one round-trip per key.
+        ids = store.translate_keys(keys, create=False)
+        missing = [i for i, v in enumerate(ids) if v is None]
+        if not missing:
             return ids
-        store.apply_entries(zip(ids, keys))
+        # Coordinator unreachable: serve what the replica knows, but
+        # never allocate locally (that is how stores diverge) — with
+        # unresolved keys the error propagates.
+        sub = [keys[i] for i in missing]
+        got = self.client.translate_keys(coord, index, field, sub)
+        store.apply_entries(zip(got, sub))
+        for i, v in zip(missing, got):
+            ids[i] = v
         return ids
 
 

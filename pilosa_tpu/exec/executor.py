@@ -113,6 +113,11 @@ class Executor:
         #: cluster key-allocation hook: (index, field|None, keys) -> ids.
         #: None = allocate in the local store (standalone / coordinator).
         self.translator = None
+        #: device key planes (exec/keyplane): read-through forward
+        #: translation for large key batches; arrays live in the
+        #: planner's budgeted stack cache when a planner is attached.
+        from pilosa_tpu.exec.keyplane import KeyPlaneCache
+        self.keyplanes = KeyPlaneCache(planner)
         from pilosa_tpu.obs import NopStats
         self.stats = stats or NopStats()
         #: query-string -> parsed Query. Parsed trees are shared across
@@ -1809,31 +1814,78 @@ class Executor:
     # ------------------------------------------------------------------
 
     def _xlate(self, idx: Index, f, key: str) -> int:
-        """Allocate/lookup one key's id. With a cluster translator set,
-        allocation routes to the coordinator (the sole id authority,
-        reference translate.go:93 primary model); standalone nodes
-        allocate locally."""
-        if self.translator is not None:
-            return self.translator(idx.name,
-                                   f.name if f is not None else None,
-                                   [key])[0]
+        """Allocate/lookup one key's id (single-key convenience over the
+        batched resolver)."""
+        return self._resolve_keys(idx, f, [key])[0]
+
+    def _resolve_keys(self, idx: Index, f, keys: list[str]) -> list[int]:
+        """Batched key → id resolution, the one forward-translate path.
+
+        Read-through order: the device key plane first (exec/keyplane —
+        no lock, no allocation, no coordinator), then ONE batched host
+        pass for the misses: the cluster translator when set (the
+        coordinator is the sole id authority; a replica's translator
+        serves its synced local snapshot before batching the remaining
+        misses into one RPC) or the local store's ``translate_keys``
+        (one lock acquisition, one epoch bump for the whole batch).
+        Plane misses are re-checked under the store lock before any
+        allocation, so a stale plane costs a host fallback, never a
+        duplicate id."""
+        fname = f.name if f is not None else None
         store = (f if f is not None else idx).translate_store
-        return store.translate_key(key)
+        ids = self.keyplanes.lookup(idx, fname, store, keys)
+        if ids is None:
+            if self.translator is not None:
+                return self.translator(idx.name, fname, list(keys))
+            return store.translate_keys(keys)
+        missing = [i for i, v in enumerate(ids) if v is None]
+        if missing:
+            sub = [keys[i] for i in missing]
+            if self.translator is not None:
+                got = self.translator(idx.name, fname, sub)
+            else:
+                got = store.translate_keys(sub)
+            for i, v in zip(missing, got):
+                ids[i] = v
+        return ids
 
     def _translate_call(self, idx: Index, c: Call) -> Call:
-        """Map string keys to ids in-place on a clone."""
+        """Map string keys to ids in-place on a clone.
+
+        Two passes: collect every string-key slot in the tree (with the
+        per-slot validation the reference does in translateCall), then
+        resolve all of a field's keys in ONE ``_resolve_keys`` batch per
+        (field|index) group — a keyed tree costs one lock/plane/RPC
+        round per distinct store instead of one per key."""
         c = c.clone()
-        self._translate_call_rec(idx, c)
+        slots: list[tuple[Call, str, str | None, str]] = []
+        self._collect_key_slots(idx, c, slots)
+        if slots:
+            groups: dict[str | None, list[int]] = {}
+            for i, (_, _, fname, _) in enumerate(slots):
+                groups.setdefault(fname, []).append(i)
+            for fname, positions in groups.items():
+                f = idx.field(fname) if fname is not None else None
+                ids = self._resolve_keys(idx, f,
+                                         [slots[i][3] for i in positions])
+                for i, id_ in zip(positions, ids):
+                    call, arg, _, _ = slots[i]
+                    call.args[arg] = id_
         return c
 
-    def _translate_call_rec(self, idx: Index, c: Call) -> None:
+    def _collect_key_slots(self, idx: Index, c: Call,
+                           slots: list[tuple[Call, str, str | None, str]]) \
+            -> None:
+        """Gather (call, arg, field-name|None, key) for every string key
+        in the tree; validation mirrors reference translateCall
+        (executor.go:2634-2637 for the Rows cursor args)."""
         # Column key (index-level).
         col = c.args.get("_col")
         if isinstance(col, str):
             if not idx.options.keys:
                 raise QueryError(f"string 'col' value not allowed unless "
                                  f"index 'keys' option enabled: {col!r}")
-            c.args["_col"] = self._xlate(idx, None, col)
+            slots.append((c, "_col", None, col))
         # Row keys (field-level).
         for key in list(c.args):
             if pql_ast.is_reserved_arg(key):
@@ -1843,7 +1895,7 @@ class Executor:
                 continue
             val = c.args[key]
             if isinstance(val, str) and f.keys:
-                c.args[key] = self._xlate(idx, f, val)
+                slots.append((c, key, f.name, val))
         row = c.args.get("_row")
         if isinstance(row, str):
             fname = c.args.get("_field")
@@ -1851,9 +1903,7 @@ class Executor:
             if f is None or not f.keys:
                 raise QueryError("string 'row' value not allowed unless "
                                  "field 'keys' option enabled")
-            c.args["_row"] = self._xlate(idx, f, row)
-        # Rows()/GroupBy-child cursor args (reference translateCall
-        # executor.go:2634-2637: rowKey="previous", colKey="column").
+            slots.append((c, "_row", f.name, row))
         if c.name == "Rows":
             fname = c.args.get("_field") or c.args.get("field")
             f = idx.field(fname) if isinstance(fname, str) else None
@@ -1862,31 +1912,36 @@ class Executor:
                 if f is None or not f.keys:
                     raise QueryError("string 'previous' value not allowed "
                                      "unless field 'keys' option enabled")
-                c.args["previous"] = self._xlate(idx, f, p)
+                slots.append((c, "previous", f.name, p))
             col = c.args.get("column")
             if isinstance(col, str):
                 if not idx.options.keys:
                     raise QueryError("string 'column' value not allowed "
                                      "unless index 'keys' option enabled")
-                c.args["column"] = self._xlate(idx, None, col)
+                slots.append((c, "column", None, col))
         for ch in c.children:
-            self._translate_call_rec(idx, ch)
+            self._collect_key_slots(idx, ch, slots)
         for v in c.args.values():
             if isinstance(v, Call):
-                self._translate_call_rec(idx, v)
+                self._collect_key_slots(idx, v, slots)
 
     def _translate_result(self, idx: Index, c: Call, result: Any) -> Any:
-        """Map ids back to keys on results (reference :2781)."""
+        """Map ids back to keys on results (reference :2781) — one
+        ``translate_ids`` snapshot pass per result set, not one locked
+        lookup per id."""
         if isinstance(result, Row) and idx.options.keys:
-            result.keys = [idx.translate_store.translate_id(int(i)) or str(i)
-                           for i in result.columns()]
+            cols = [int(i) for i in result.columns()]
+            names = idx.translate_store.translate_ids(cols)
+            result.keys = [n if n is not None else str(i)
+                           for n, i in zip(names, cols)]
         elif c.name == "Rows" and isinstance(result, list):
             fname = c.args.get("_field") or c.args.get("field")
             f = idx.field(fname) if isinstance(fname, str) else None
             if f is not None and f.keys:
+                names = f.translate_store.translate_ids(list(result))
                 result = RowIdentifiers(
-                    keys=[f.translate_store.translate_id(r) or str(r)
-                          for r in result])
+                    keys=[n if n is not None else str(r)
+                          for n, r in zip(names, result)])
             else:
                 result = RowIdentifiers(rows=list(result))
         elif isinstance(result, Pair) and c.name in ("MinRow", "MaxRow"):
@@ -1898,12 +1953,22 @@ class Executor:
             fname = c.args.get("_field")
             f = idx.field(fname) if isinstance(fname, str) else None
             if f is not None and f.keys:
-                for p in result:
-                    p.key = f.translate_store.translate_id(p.id) or str(p.id)
+                names = f.translate_store.translate_ids(
+                    [p.id for p in result])
+                for p, n in zip(result, names):
+                    p.key = n if n is not None else str(p.id)
         elif isinstance(result, list) and result and isinstance(result[0], GroupCount):
+            # One reverse batch per keyed field across ALL groups.
+            by_field: dict[str, list] = {}
             for gc in result:
                 for fr in gc.group:
-                    f = idx.field(fr.field)
-                    if f is not None and f.keys:
-                        fr.row_key = f.translate_store.translate_id(fr.row_id) or ""
+                    by_field.setdefault(fr.field, []).append(fr)
+            for fname, frs in by_field.items():
+                f = idx.field(fname)
+                if f is None or not f.keys:
+                    continue
+                names = f.translate_store.translate_ids(
+                    [fr.row_id for fr in frs])
+                for fr, n in zip(frs, names):
+                    fr.row_key = n if n is not None else ""
         return result

@@ -47,6 +47,7 @@ import jax
 import jax.numpy as jnp
 
 from pilosa_tpu.config import SHARD_WIDTH, WORDS_PER_SHARD
+from pilosa_tpu.exec import keyplane as _keyplane
 from pilosa_tpu.ops import bitops
 from pilosa_tpu.sketch import kernels as sketch_kernels
 
@@ -61,7 +62,12 @@ PACKED = "packed"
 #: stacks plus packed [S, C] bucket|rho column planes for the filtered
 #: distinct path — Count(Distinct(...)) never materializes a row set.
 HLL = "hll"
-REPR_CLASSES = (DENSE, PACKED, HLL)
+#: key-translation planes (exec/keyplane): one [3, H] uint32 stack per
+#: translate store — sorted splitmix64 hash halves plus the id lane,
+#: probed by a lexicographic binary search. Forward translation shares
+#: the stack cache, the budget, and this accounting with row stacks.
+KEYPLANE = "keyplane"
+REPR_CLASSES = (DENSE, PACKED, HLL, KEYPLANE)
 
 #: padding value for packed index stacks: one past the last valid
 #: in-shard column. Chosen so ``idx >> 5`` lands exactly on the trash
@@ -222,6 +228,10 @@ KERNELS = {
     (HLL, "count"): sketch_kernels.hll_count,
     (HLL, "and_count"): sketch_kernels.hll_and_count,
     (HLL, "pair_count"): sketch_kernels.hll_pair_count,
+    (KEYPLANE, "expand"): _keyplane.plane_expand,
+    (KEYPLANE, "count"): _keyplane.plane_count,
+    (KEYPLANE, "and_count"): _keyplane.plane_and_count,
+    (KEYPLANE, "pair_count"): _keyplane.plane_pair_count,
 }
 
 

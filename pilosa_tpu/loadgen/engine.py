@@ -429,6 +429,51 @@ def _partition_epilogue(sc: Scenario, target) -> dict:
     return out
 
 
+def _translate_epilogue(sc: Scenario, target) -> dict:
+    """After a run with a keyed leg: per-node key-plane counters from
+    ``/debug/translate`` plus the cross-node translation-agreement
+    check (every node's store for the keyed index reports the same
+    maxId once traffic stops — diverging ids is THE keyed-cluster
+    failure mode). Returns the report's numeric ``translate`` section."""
+    names = ("planes", "builds", "deviceBatches", "deviceKeys",
+             "collisionHits", "staleServed", "rebuildsScheduled")
+    sums = dict.fromkeys(names, 0)
+    coord_max = 0
+    replica_max: list[int] = []
+    watermarks: list[int] = []
+    nodes_seen = 0
+    for i in range(len(target.base_urls)):
+        try:
+            doc = json.loads(target._get(
+                target.base_urls[i] + "/debug/translate"))
+        except Exception:
+            continue
+        nodes_seen += 1
+        p = doc.get("planes") or {}
+        for n in names:
+            sums[n] += int(p.get(n, 0))
+        ks = (doc.get("stores") or {}).get(f"{INDEX_KEYED}/kf")
+        if ks is not None:
+            mid = int(ks.get("maxId", 0))
+            if doc.get("coordinator"):
+                coord_max = max(coord_max, mid)
+            else:
+                replica_max.append(mid)
+            watermarks.append(int(ks.get("watermark", 0)))
+    out: dict = {"nodesReporting": nodes_seen}
+    for n in names:
+        out[n] = sums[n]
+    # Replicas only hold the mappings their traffic touched, so maxId
+    # may trail the coordinator — but no node may be AHEAD of it
+    # (local allocation on a replica is how stores diverge).
+    out["keyedMaxId"] = max([coord_max] + replica_max)
+    out["replicaAheadOfCoordinator"] = (
+        1 if coord_max and replica_max
+        and max(replica_max) > coord_max else 0)
+    out["keyedWatermarkMin"] = min(watermarks) if watermarks else 0
+    return out
+
+
 # -- counters ------------------------------------------------------------
 
 
@@ -589,9 +634,13 @@ def run_scenario(sc: Scenario, target=None, out: str | None = None,
                         if has_partition else None)
         dr_section = (_dr_epilogue(sc, target, dr_env)
                       if dr_env is not None else None)
+        translate_section = (_translate_epilogue(sc, target)
+                             if any(leg.kind == "keyed" for leg in sc.legs)
+                             else None)
         report = _build_report(sc, target, stats, ops, elapsed, dispatched,
                                max_lag, before, after, ingest_totals,
-                               chaos_applied, dr_section, part_section)
+                               chaos_applied, dr_section, part_section,
+                               translate_section)
     finally:
         if owned:
             target.close()
@@ -615,7 +664,7 @@ def run_scenario(sc: Scenario, target=None, out: str | None = None,
 
 def _build_report(sc: Scenario, target, stats, ops, elapsed, dispatched,
                   max_lag, before, after, ingest_totals, chaos_applied,
-                  dr=None, partition=None):
+                  dr=None, partition=None, translate=None):
     delta = {k: after[k] - before[k] for k in after}
     server_hists = _server_class_hists(target)
 
@@ -748,5 +797,6 @@ def _build_report(sc: Scenario, target, stats, ops, elapsed, dispatched,
             partition,
             failedQueries=int(sum(per_class[c]["counts"]["error"]
                                   for c in per_class)))),
+        "translate": translate,
         "exemplars": exemplars,
     }

@@ -129,8 +129,9 @@ class API:
         if self.translator is not None:
             return self.translator(idx.name,
                                    f.name if f is not None else None, keys)
+        # One batched allocation: one lock, one epoch bump per batch.
         store = (f if f is not None else idx).translate_store
-        return [store.translate_key(k) for k in keys]
+        return store.translate_keys(keys)
 
     # -- query (api.go:135) ------------------------------------------------
 
@@ -555,19 +556,33 @@ class API:
         frag = self.holder.fragment(index, field, "standard", shard)
         if frag is None:
             raise FragmentNotFoundError()
+        # Reverse translation is batched: ONE snapshot pass per store
+        # over the shard's distinct ids, then a dict render per bit —
+        # the per-bit translate_id loop this replaces took a lock round
+        # per cell.
+        rows = [(rid, positions) for rid, positions in frag.rows_snapshot()]
+        base = shard * SHARD_WIDTH
+        row_names: dict[int, str] = {}
+        if f.keys:
+            rids = [rid for rid, _ in rows]
+            row_names = {
+                rid: (name if name is not None else str(rid))
+                for rid, name in zip(rids,
+                                     f.translate_store.translate_ids(rids))}
+        col_names: dict[int, str] = {}
+        if idx.options.keys:
+            cols = sorted({int(pos) + base
+                           for _, positions in rows for pos in positions})
+            col_names = {
+                col: (name if name is not None else str(col))
+                for col, name in zip(
+                    cols, idx.translate_store.translate_ids(cols))}
         buf = io.StringIO()
-        for rid, positions in frag.rows_snapshot():
-            base = shard * SHARD_WIDTH
+        for rid, positions in rows:
+            rk = row_names.get(rid) if f.keys else str(rid)
             for pos in positions:
                 col = int(pos) + base
-                if f.keys:
-                    rk = f.translate_store.translate_id(rid) or str(rid)
-                else:
-                    rk = str(rid)
-                if idx.options.keys:
-                    ck = idx.translate_store.translate_id(col) or str(col)
-                else:
-                    ck = str(col)
+                ck = col_names.get(col) if idx.options.keys else str(col)
                 buf.write(f"{rk},{ck}\n")
         return buf.getvalue()
 

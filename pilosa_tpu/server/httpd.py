@@ -870,6 +870,38 @@ def _build_routes(api: API):
         out["enabled"] = True
         return 200, out
 
+    def get_debug_translate(pv, params, body):
+        """Key-translation telemetry: the device key-plane cache
+        (builds, device batches, collision-bucket hits, stale serves,
+        async rebuilds) plus per-store sizes and watermarks — the first
+        stop when the keyed leg trails the id legs."""
+        planes = getattr(api.executor, "keyplanes", None)
+        stores = {}
+        for name in api.holder.index_names():
+            idx = api.holder.index(name)
+            if idx is None:
+                continue
+            targets = [("", idx.translate_store)]
+            targets += [(fname, f.translate_store)
+                        for fname, f in sorted(idx.fields.items())]
+            for fname, store in targets:
+                if store.max_id() == 0:
+                    continue
+                stores[f"{name}/{fname}" if fname else name] = {
+                    "maxId": store.max_id(),
+                    "watermark": store.replication_watermark(),
+                    "version": store.version,
+                }
+        coord = None
+        if api.cluster is not None:
+            c = api.cluster.coordinator()
+            coord = (c is not None and c.id == api.cluster.local_id)
+        return 200, {
+            "coordinator": coord,
+            "planes": planes.debug() if planes is not None else None,
+            "stores": stores,
+        }
+
     def get_debug_overload(pv, params, body):
         """One view of the whole overload-resilience layer: adaptive
         admission limit, per-tenant quota buckets, per-peer breaker
@@ -1301,6 +1333,7 @@ def _build_routes(api: API):
          {"GET": get_debug_query_profile}),
         (r"/debug/queries", {"GET": get_debug_queries}),
         (r"/debug/device", {"GET": get_debug_device}),
+        (r"/debug/translate", {"GET": get_debug_translate}),
         (r"/debug/slow-queries", {"GET": get_debug_slow_queries}),
         (r"/debug/overload", {"GET": get_debug_overload}),
         (r"/debug/cache", {"GET": get_debug_cache}),

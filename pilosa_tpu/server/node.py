@@ -99,6 +99,7 @@ class ServerNode:
                  inline_transfer: str = "auto",
                  residency_packed: str = "auto",
                  prefetch: str = "on",
+                 translate_planes: str = "auto",
                  sketch_precision: int = 12,
                  sketch_exact_threshold: int = 1024,
                  profile_ring_n: int = 64,
@@ -363,6 +364,10 @@ class ServerNode:
         _residency.set_mode(residency_packed)
         from pilosa_tpu.parallel import prefetch as _prefetch
         _prefetch.set_mode(prefetch)
+        # Key-translation planes (README "Key translation"); env var
+        # PILOSA_TPU_TRANSLATE_PLANES overrides per-run.
+        from pilosa_tpu.exec import keyplane as _keyplane
+        _keyplane.set_mode(translate_planes)
         # Approximate-analytics knobs (README "Approximate analytics");
         # PILOSA_TPU_SKETCH_PRECISION / _SKETCH_EXACT_THRESHOLD
         # override per-run.

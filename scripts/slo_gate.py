@@ -22,6 +22,12 @@ Check fields (any combination):
   value + relTol expected value with a relative band: |got - value|
                  must be <= relTol * |value| (absTol adds a floor for
                  near-zero expectations)
+  ratioOf + maxRatio
+                 relative bound against ANOTHER metric in the same
+                 report: got / lookup(ratioOf) must be <= maxRatio
+                 (e.g. the keyed leg's p50 may not exceed 1.5x the
+                 dashboard leg's p50 — an absolute bound would drift
+                 with runner speed, the ratio does not)
   minLen         lower bound on a list's length
 """
 
@@ -70,6 +76,16 @@ def run_check(report: dict, check: dict) -> str | None:
             return (f"{path}: {got} outside {want} ± {band:g} "
                     f"(relTol={check.get('relTol', 0)}, "
                     f"absTol={check.get('absTol', 0)})")
+    if "ratioOf" in check:
+        base = lookup(report, check["ratioOf"])
+        if not isinstance(base, (int, float)) or isinstance(base, bool):
+            return f"{check['ratioOf']}: ratio base missing or not a number"
+        if base <= 0:
+            return None   # a zero base means the baseline leg is free
+        ratio = got / base
+        if ratio > check["maxRatio"]:
+            return (f"{path}: {got} is {ratio:.2f}x {check['ratioOf']} "
+                    f"({base}), max ratio {check['maxRatio']}")
     return None
 
 

@@ -549,6 +549,33 @@ def test_residency_pairing_catches_none_stub():
     assert "'and_count'" in fs[0].message and "'packed'" in fs[0].message
 
 
+def test_residency_pairing_catches_duplicate_key():
+    # A pasted row that re-registers an existing (class, op) pair is
+    # legal Python — the last binding wins silently — and the width
+    # check still passes; the duplicate sub-check makes it loud.
+    src = PAIRING_BUG.replace(
+        '    (PACKED, "count"): pk_count,',
+        '    (PACKED, "count"): pk_count,\n'
+        '    (PACKED, "and_count"): pk_and_count,\n'
+        '    (PACKED, "count"): pk_count_v2,')
+    fs = run_rule(residency_pairing, src,
+                  path="pilosa_tpu/exec/residency.py")
+    assert len(fs) == 1 and "more than once" in fs[0].message
+    assert "'packed'" in fs[0].message and "'count'" in fs[0].message
+
+
+def test_residency_pairing_keyplane_row_stays_full():
+    # The live table: the keyplane class must keep its full kernel row
+    # (and no duplicates) as future classes are pasted around it.
+    import pilosa_tpu.exec.residency as live
+    src = open(live.__file__).read()
+    assert run_rule(residency_pairing, src,
+                    path="pilosa_tpu/exec/residency.py") == []
+    from pilosa_tpu.exec import keyplane as kp
+    for op in ("expand", "count", "and_count", "pair_count"):
+        assert callable(live.kernel(kp.KEYPLANE, op))
+
+
 def test_residency_pairing_hll_full_row_passes():
     # The sketch class as wired: hll declares a variant for every op
     # in the dense contract, all pointing at real kernels.
